@@ -1,0 +1,18 @@
+"""areal_tpu — a TPU-native asynchronous RL training framework.
+
+Re-designed from scratch for JAX/XLA/Pallas/pjit with the capabilities of
+AReaL (reference: /root/reference): fully-asynchronous GRPO/PPO training for
+large reasoning models, with an SPMD trainer (GSPMD over a jax.sharding.Mesh)
+and an asynchronous rollout pipeline with staleness control, interruptible
+generation, and decoupled-PPO losses.
+
+Layering mirrors the reference's areal-lite architecture (areal/README.md):
+
+    Entry points      examples/*.py
+    Customization     areal_tpu.engine.ppo / areal_tpu.engine.sft / areal_tpu.workflow
+    API               areal_tpu.api  (engine_api, workflow_api, cli_args, alloc_mode, io_struct)
+    Backends          areal_tpu.engine (jax_engine), areal_tpu.core (workflow_executor, ...)
+    Infra             areal_tpu.launcher, areal_tpu.platforms, areal_tpu.utils
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,435 @@
+"""Allocation-mode expressions: how devices are split between training and
+generation, and how each side is parallelised.
+
+Parity target: areal/api/alloc_mode.py:34 (ParallelStrategy), :241
+(AllocationMode), :312 (grammar). We keep the same expression syntax so
+reference configs port unchanged, and add the TPU-native backend name
+``jax`` (in-process GSPMD engine for both decode and training) alongside
+the reference names (``sglang``/``vllm`` for inference, ``fsdp``/``megatron``
+for training — accepted and mapped onto the jax engine's mesh dims).
+
+Examples::
+
+    d4t2p1                      # colocated / training-only (SFT)
+    jax:d4t2+jax:d8             # decoupled: 8-chip decode + 8-chip trainer
+    sglang:d4t2+fsdp:d8         # reference syntax, accepted verbatim
+    jax:d2t4|jax:d2t4           # colocated RL (train & gen share chips)
+    jax:d4t2+eval               # LLM server + CPU eval workers
+    (attn:d2t2|ffn:d2e2)        # MoE hybrid: attention vs expert sharding
+
+Semantics are positional: in ``A+B`` and ``A|B``, the left side is always
+the inference deployment and the right side the trainer. A standalone
+``<backend>:<dims>`` expression is an inference-only deployment when the
+backend serves inference (jax/jetstream/sglang/vllm) and a training-only
+deployment when it is train-specific (fsdp/megatron); a standalone
+training-only allocation is normally written as bare dims (``d4t2p1``).
+Because ``jax`` serves both roles, ``jax:<dims>`` standalone is ALWAYS
+inference-only — write bare dims for a jax trainer.
+
+On TPU the 5-D strategy maps onto a single `jax.sharding.Mesh` with named
+axes; see areal_tpu/parallel/mesh.py.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from lark import Lark, Transformer
+
+
+class AllocationType(enum.Enum):
+    COLOCATE = 0
+    DECOUPLED_TRAIN = 1
+    LLM_SERVER_ONLY = 2
+    DECOUPLED_EVAL = 3
+
+
+class AllocationValidationError(Exception):
+    pass
+
+
+class InvalidAllocationModeError(Exception):
+    pass
+
+
+@dataclass
+class ParallelStrategy:
+    """5-D parallel strategy (TP, PP, DP, CP, EP + expert-TP).
+
+    Mirrors reference areal/api/alloc_mode.py:34. On TPU these become mesh
+    axis sizes rather than process-group sizes:
+
+    - tensor_parallel_size   → mesh axis "tp" (MXU-sharded matmuls)
+    - pipeline_parallel_size → mesh axis "pp" (layer-sharded stages)
+    - data_parallel_size     → mesh axis "dp"/"fsdp" (batch + param shards)
+    - context_parallel_size  → mesh axis "sp" (sequence sharding / ring attn)
+    - expert_parallel_size   → mesh axis "ep" (MoE expert sharding)
+    """
+
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    data_parallel_size: int = 1
+    context_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    expert_tensor_parallel_size: int = 1
+
+    def __post_init__(self):
+        if self.expert_parallel_size > 1:
+            emp = (
+                self.pipeline_parallel_size
+                * self.expert_tensor_parallel_size
+                * self.expert_parallel_size
+            )
+            if self.world_size % emp != 0:
+                raise AllocationValidationError(
+                    f"Expert model parallel size {emp} does not divide "
+                    f"world size {self.world_size}"
+                )
+
+    # -- sizes ----------------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return (
+            self.tensor_parallel_size
+            * self.pipeline_parallel_size
+            * self.data_parallel_size
+            * self.context_parallel_size
+        )
+
+    @property
+    def expert_model_parallel_size(self) -> int:
+        return (
+            self.pipeline_parallel_size
+            * self.expert_tensor_parallel_size
+            * self.expert_parallel_size
+        )
+
+    @property
+    def expert_data_parallel_size(self) -> int:
+        if self.expert_parallel_size <= 1:
+            return self.data_parallel_size
+        return self.world_size // self.expert_model_parallel_size
+
+    # -- abbreviations --------------------------------------------------
+    @property
+    def tp_size(self) -> int:
+        return self.tensor_parallel_size
+
+    @property
+    def pp_size(self) -> int:
+        return self.pipeline_parallel_size
+
+    @property
+    def dp_size(self) -> int:
+        return self.data_parallel_size
+
+    @property
+    def cp_size(self) -> int:
+        return self.context_parallel_size
+
+    @property
+    def ep_size(self) -> int:
+        return self.expert_parallel_size
+
+    @property
+    def etp_size(self) -> int:
+        return self.expert_tensor_parallel_size
+
+    def __str__(self):
+        dims = []
+        for tag, size in (
+            ("d", self.data_parallel_size),
+            ("t", self.tensor_parallel_size),
+            ("p", self.pipeline_parallel_size),
+            ("c", self.context_parallel_size),
+            ("e", self.expert_parallel_size),
+        ):
+            if size != 1 or tag == "d":
+                dims.append(f"{tag}{size}")
+        return "".join(dims)
+
+
+INFERENCE_BACKENDS = ("jax", "jetstream", "sglang", "vllm")
+TRAIN_BACKENDS = ("jax", "fsdp", "megatron")
+# Dims an inference deployment may specify (no context/expert parallel: the
+# decode engine derives those internally).
+_INF_DIMS = ("d", "t", "p")
+
+# One backend token set; role is decided by position (left of +/| = inference,
+# right = train) which keeps the grammar unambiguous even though "jax" can
+# serve either role.
+ALLOCATION_GRAMMAR = r"""
+    start: expression
+
+    expression: disaggregate_expr | colocate_expr | eval_expr | backend_para | plain_train
+
+    disaggregate_expr: backend_para "+" rhs_para
+    colocate_expr: backend_para "|" rhs_para
+    eval_expr: backend_para "+" EVAL
+
+    rhs_para: backend_para | plain_train
+    backend_para: BACKEND ":" common_dim+
+        | BACKEND ":" hybrid_moe
+    plain_train: common_dim+
+        | hybrid_moe
+
+    hybrid_moe: "(" attn_section "|" ffn_section ")"
+        | attn_section "|" ffn_section
+    attn_section: "attn" ":" attn_dim+
+    ffn_section: "ffn" ":" ffn_dim+
+
+    common_dim: DIM_TYPE NUMBER
+    attn_dim: ATTN_DIM_TYPE NUMBER
+    ffn_dim: FFN_DIM_TYPE NUMBER
+
+    DIM_TYPE: "p" | "d" | "t" | "c" | "e"
+    ATTN_DIM_TYPE: "c" | "d" | "t" | "p"
+    FFN_DIM_TYPE: "d" | "e" | "t" | "p"
+
+    EVAL: "cpu" | "eval"
+    BACKEND: "jetstream" | "sglang" | "vllm" | "megatron" | "fsdp" | "jax"
+    NUMBER: /[1-9][0-9]*/
+
+    %import common.WS
+    %ignore WS
+"""
+
+_DIM_FIELD = {
+    "d": "data_parallel_size",
+    "t": "tensor_parallel_size",
+    "p": "pipeline_parallel_size",
+    "c": "context_parallel_size",
+    "e": "expert_parallel_size",
+}
+
+
+def _strategy_from_dims(dims: list[tuple[str, int]], what: str) -> ParallelStrategy:
+    kwargs: dict[str, int] = {}
+    for tag, size in dims:
+        fieldname = _DIM_FIELD[tag]
+        if fieldname in kwargs:
+            raise AllocationValidationError(
+                f"duplicate dimension '{tag}' in {what} strategy"
+            )
+        kwargs[fieldname] = size
+    return ParallelStrategy(**kwargs)
+
+
+class _AllocTransformer(Transformer):
+    def NUMBER(self, tok):
+        return int(tok)
+
+    def common_dim(self, items):
+        return (str(items[0]), items[1])
+
+    attn_dim = common_dim
+    ffn_dim = common_dim
+
+    def backend_para(self, items):
+        backend = str(items[0])
+        rest = items[1:]
+        if len(rest) == 1 and isinstance(rest[0], tuple) and rest[0][0] == "moe":
+            return ("para", backend, rest[0][1], ())
+        dims = list(rest)
+        return ("para", backend, _strategy_from_dims(dims, backend), tuple(t for t, _ in dims))
+
+    def plain_train(self, items):
+        if len(items) == 1 and isinstance(items[0], tuple) and items[0][0] == "moe":
+            return ("para", None, items[0][1], ())
+        dims = list(items)
+        return ("para", None, _strategy_from_dims(dims, "train"), tuple(t for t, _ in dims))
+
+    def rhs_para(self, items):
+        return items[0]
+
+    def attn_section(self, items):
+        return ("attn", list(items))
+
+    def ffn_section(self, items):
+        return ("ffn", list(items))
+
+    def hybrid_moe(self, items):
+        sections = dict(items)
+        attn = _strategy_from_dims(sections["attn"], "attention")
+        ffn_dims = dict(sections["ffn"])
+        # In the hybrid syntax, the ffn section re-expresses the same device
+        # grid with expert dims; fold e/etp into the attention strategy.
+        strategy = ParallelStrategy(
+            tensor_parallel_size=attn.tensor_parallel_size,
+            pipeline_parallel_size=attn.pipeline_parallel_size,
+            data_parallel_size=attn.data_parallel_size,
+            context_parallel_size=attn.context_parallel_size,
+            expert_parallel_size=ffn_dims.get("e", 1),
+            expert_tensor_parallel_size=ffn_dims.get("t", 1),
+        )
+        ffn_world = (
+            ffn_dims.get("d", 1)
+            * ffn_dims.get("e", 1)
+            * ffn_dims.get("t", 1)
+            * ffn_dims.get("p", 1)
+        )
+        if ffn_world != strategy.world_size:
+            raise AllocationValidationError(
+                f"MoE hybrid: ffn world size {ffn_world} != attn world size "
+                f"{strategy.world_size}"
+            )
+        if ffn_dims.get("p", 1) != attn.pipeline_parallel_size:
+            raise AllocationValidationError(
+                "MoE hybrid: ffn and attn pipeline sizes must match"
+            )
+        return ("moe", strategy)
+
+    def disaggregate_expr(self, items):
+        return ("disagg", items[0], items[1])
+
+    def colocate_expr(self, items):
+        return ("colo", items[0], items[1])
+
+    def eval_expr(self, items):
+        return ("eval", items[0])
+
+    def expression(self, items):
+        return items[0]
+
+    def start(self, items):
+        return items[0]
+
+
+_parser = Lark(ALLOCATION_GRAMMAR, parser="earley")
+_transformer = _AllocTransformer()
+
+
+def _check_inference_para(node, expr: str):
+    _, backend, strategy, dim_tags = node
+    if backend is None:
+        raise AllocationValidationError(
+            f"inference side of {expr!r} must name a backend "
+            f"(one of {INFERENCE_BACKENDS})"
+        )
+    if backend not in INFERENCE_BACKENDS:
+        raise AllocationValidationError(
+            f"{backend!r} is not an inference backend (expected one of "
+            f"{INFERENCE_BACKENDS}); in 'A+B' / 'A|B' the left side is the "
+            "inference deployment"
+        )
+    bad = [t for t in dim_tags if t not in _INF_DIMS]
+    # Validate on strategy values too so MoE-hybrid syntax (which carries no
+    # dim tags) cannot smuggle cp/ep onto the inference side.
+    if strategy.context_parallel_size > 1 or strategy.expert_parallel_size > 1:
+        bad += [
+            t
+            for t, sz in (
+                ("c", strategy.context_parallel_size),
+                ("e", strategy.expert_parallel_size),
+            )
+            if sz > 1 and t not in bad
+        ]
+    if bad:
+        raise AllocationValidationError(
+            f"dimension(s) {bad} are not valid for an inference deployment "
+            f"(allowed: {_INF_DIMS}); for a train-only allocation write bare "
+            f"dims, e.g. 'd4c2'"
+        )
+    return backend, strategy
+
+
+def _check_train_para(node, expr: str):
+    _, backend, strategy, _ = node
+    if backend is None:
+        backend = "jax"
+    if backend not in TRAIN_BACKENDS:
+        raise AllocationValidationError(
+            f"{backend!r} is not a train backend (expected one of "
+            f"{TRAIN_BACKENDS}); in 'A+B' / 'A|B' the right side is the trainer"
+        )
+    return backend, strategy
+
+
+@dataclass
+class AllocationMode:
+    """Parsed allocation configuration (parity: areal/api/alloc_mode.py:241)."""
+
+    type_: AllocationType
+    gen: ParallelStrategy = field(default_factory=ParallelStrategy)
+    train: ParallelStrategy | None = None
+    gen_backend: str | None = None
+    train_backend: str | None = None
+
+    @property
+    def gen_instance_size(self) -> int:
+        """Devices per inference instance (tp × pp; dp counts instances)."""
+        return self.gen.tp_size * self.gen.pp_size
+
+    @property
+    def gen_world_size(self) -> int:
+        return self.gen.world_size if self.gen is not None else 0
+
+    @property
+    def train_world_size(self) -> int:
+        return self.train.world_size if self.train is not None else 0
+
+    @classmethod
+    def from_str(cls, allocation_mode: str) -> "AllocationMode":
+        try:
+            tree = _parser.parse(allocation_mode)
+            node = _transformer.transform(tree)
+        except AllocationValidationError:
+            raise
+        except Exception as e:  # lark raises many exception types
+            raise InvalidAllocationModeError(
+                f"cannot parse allocation mode {allocation_mode!r}: {e}"
+            ) from e
+        return cls._from_node(node, allocation_mode)
+
+    @classmethod
+    def _from_node(cls, node, expr: str) -> "AllocationMode":
+        kind = node[0]
+        if kind == "para":
+            _, backend, strategy, dim_tags = node
+            if backend is None or backend not in INFERENCE_BACKENDS:
+                # bare dims, or a train-only backend like fsdp/megatron
+                backend, strategy = _check_train_para(node, expr)
+                return cls(
+                    type_=AllocationType.COLOCATE,
+                    gen=ParallelStrategy(),
+                    train=strategy,
+                    train_backend=backend,
+                )
+            # Standalone backend-qualified expression → inference-only.
+            # ("jax" standalone is always inference; see module docstring.)
+            backend, strategy = _check_inference_para(node, expr)
+            return cls(
+                type_=AllocationType.LLM_SERVER_ONLY,
+                gen=strategy,
+                gen_backend=backend,
+            )
+        if kind == "eval":
+            backend, strategy = _check_inference_para(node[1], expr)
+            return cls(
+                type_=AllocationType.DECOUPLED_EVAL,
+                gen=strategy,
+                gen_backend=backend,
+            )
+        if kind in ("disagg", "colo"):
+            gen_backend, gen = _check_inference_para(node[1], expr)
+            train_backend, train = _check_train_para(node[2], expr)
+            if kind == "colo" and gen.world_size != train.world_size:
+                # COLOCATE means gen and train share the same chips; the
+                # reference enforces matching world sizes and so do we.
+                raise AllocationValidationError(
+                    f"colocated allocation {expr!r} requires matching world "
+                    f"sizes, got gen={gen.world_size} train={train.world_size}"
+                )
+            return cls(
+                type_=(
+                    AllocationType.DECOUPLED_TRAIN
+                    if kind == "disagg"
+                    else AllocationType.COLOCATE
+                ),
+                gen=gen,
+                gen_backend=gen_backend,
+                train=train,
+                train_backend=train_backend,
+            )
+        raise InvalidAllocationModeError(f"unknown node {node!r}")

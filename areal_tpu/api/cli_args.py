@@ -1,0 +1,499 @@
+"""Experiment configuration tree + CLI/YAML loader.
+
+Parity target: areal/api/cli_args.py (~35 dataclasses, OmegaConf merge,
+`--config file.yaml key=value` overrides). Field names are kept identical to
+the reference wherever the concept carries over (GenerationHyperparameters,
+OptimizerConfig, TrainEngineConfig, PPOActorConfig incl. `use_decoupled_loss`,
+`recompute_logprob`, `max_head_offpolicyness`, `group_size`,
+`dynamic_sampling`, SaverConfig, …) so that reference configs port with only
+backend-name changes. CUDA-server configs (SGLangConfig/vLLMConfig) are
+replaced by `JaxDecodeConfig` — the TPU-native decode engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import getpass
+import os
+from dataclasses import dataclass, field
+
+import yaml
+
+from areal_tpu.utils import structured
+from areal_tpu.utils.name_resolve import NameResolveConfig
+
+__all__ = [
+    "NormConfig",
+    "MicroBatchSpec",
+    "GenerationHyperparameters",
+    "OptimizerConfig",
+    "JaxEngineConfig",
+    "TrainEngineConfig",
+    "PPOActorConfig",
+    "PPOCriticConfig",
+    "JaxDecodeConfig",
+    "InferenceEngineConfig",
+    "SaverConfig",
+    "EvaluatorConfig",
+    "RecoverConfig",
+    "WandBConfig",
+    "SwanlabConfig",
+    "TensorBoardConfig",
+    "StatsLoggerConfig",
+    "NameResolveConfig",
+    "ClusterSpecConfig",
+    "DatasetConfig",
+    "LauncherConfig",
+    "SlurmLauncherConfig",
+    "BaseExperimentConfig",
+    "SFTConfig",
+    "RWConfig",
+    "GRPOConfig",
+    "PPOConfig",
+    "parse_cli_args",
+    "load_expr_config",
+    "save_config",
+]
+
+
+@dataclass
+class NormConfig:
+    """Normalization spec for rewards/advantages (reference cli_args.py:22)."""
+
+    mean_level: str | None = "batch"  # "batch" | "group" | None
+    mean_leave1out: bool = False
+    std_level: str | None = "batch"  # "batch" | "group" | None
+    std_unbiased: bool = False
+    eps: float = 1e-5
+    group_size: int = 1
+
+
+@dataclass
+class MicroBatchSpec:
+    """Micro-batch splitting spec (reference cli_args.py:61)."""
+
+    n_mbs: int | None = 1
+    granularity: int = 1
+    max_tokens_per_mb: int | None = None
+
+
+@dataclass
+class GenerationHyperparameters:
+    """Sampling hyperparameters (reference cli_args.py:96)."""
+
+    n_samples: int = 1
+    max_new_tokens: int = 16384
+    min_new_tokens: int = 0
+    max_tokens: int | None = None
+    greedy: bool = False
+    top_p: float = 1.0
+    top_k: int = int(1e8)
+    temperature: float = 1.0
+    stop_token_ids: list[int] = field(default_factory=list)
+    stop: list[str] | None = None
+    frequency_penalty: float = 0.0
+
+    def new(self, **kwargs) -> "GenerationHyperparameters":
+        out = dataclasses.replace(self)
+        for k, v in kwargs.items():
+            setattr(out, k, v)
+        return out
+
+
+@dataclass
+class OptimizerConfig:
+    """Optax optimizer + schedule spec (reference cli_args.py:160).
+
+    `type` supports "adamw" (AnyPrecision-equivalent: bf16 params, fp32
+    moments by default) and "sgd"; schedules: cosine/linear/constant with
+    linear warmup.
+    """
+
+    type: str = "adamw"
+    lr: float = 2e-5
+    weight_decay: float = 0.05
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-5
+    min_lr_ratio: float = 0.0
+    lr_scheduler_type: str = "constant"  # "cosine" | "linear" | "constant"
+    warmup_steps_proportion: float = 0.001
+    offload: bool = False
+    gradient_clipping: float = 1.0
+    # dtype of Adam moments; fp32 is the AnyPrecisionAdamW default.
+    moment_dtype: str = "float32"
+
+
+@dataclass
+class JaxEngineConfig:
+    """TPU/GSPMD engine knobs (replaces FSDPEngineConfig/MegatronEngineConfig).
+
+    The reference's FSDP2 wrap policy and Megatron DDP flags have no TPU
+    analogue: parameter sharding is a NamedSharding over the mesh's
+    ("fsdp",) axis; rematerialisation replaces activation checkpointing.
+    """
+
+    # Which mesh axes shard parameters ZeRO-style; () replicates.
+    fsdp_axes: list[str] = field(default_factory=lambda: ["fsdp"])
+    # jax.checkpoint policy: "none" | "full" | "dots_saveable" |
+    # "dots_with_no_batch_dims_saveable"
+    remat_policy: str = "full"
+    # Use scan-over-layers for fast compiles and PP-friendly stacking.
+    scan_layers: bool = True
+    # Offload optimizer state to host memory (jax.device_put w/ host sharding).
+    offload_params: bool = False
+
+
+@dataclass
+class TrainEngineConfig:
+    """Train engine contract config (reference cli_args.py:315)."""
+
+    experiment_name: str = ""
+    trial_name: str = ""
+    path: str = ""  # HF model path or local checkpoint dir
+    attn_impl: str = "auto"  # "auto" | "pallas" | "xla"
+    init_from_scratch: bool = False
+    is_critic: bool = False
+    mb_spec: MicroBatchSpec = field(default_factory=MicroBatchSpec)
+    pad_to_maximum: bool = False
+    disable_dropout: bool = True
+    gradient_checkpointing: bool = True
+    dtype: str = "bfloat16"
+    grad_reduce_dtype: str = "float32"
+    optimizer: OptimizerConfig | None = None
+    weight_update_mode: str = "memory"  # "memory" (device_put) | "disk"
+    backend: str = "jax"
+    jax: JaxEngineConfig = field(default_factory=JaxEngineConfig)
+    use_lora: bool = False
+    lora_rank: int = 32
+    lora_alpha: int = 16
+    target_modules: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PPOActorConfig(TrainEngineConfig):
+    """PPO/GRPO actor config (reference cli_args.py:390)."""
+
+    group_size: int = 1
+    ppo_n_minibatches: int = 4
+    eps_clip: float = 0.2
+    eps_clip_higher: float | None = None
+    c_clip: float | None = None
+    temperature: float = 1.0
+    # reward shaping
+    reward_norm: NormConfig | None = None
+    reward_scaling: float = 1.0
+    reward_bias: float = 0.0
+    reward_clip: float = 20.0
+    overlong_reward_penalty: bool = False
+    overlong_tokens: int | None = None
+    overlong_penalty_factor: float | None = None
+    mask_no_eos_with_zero: bool = False
+    # advantage estimation
+    discount: float = 1.0
+    gae_lambda: float = 1.0
+    adv_norm: NormConfig | None = None
+    # KL regularization
+    kl_ctl: float = 0.1
+    kl_estimator: str = "k1"  # "k1" | "k2" | "k3"
+    # asynchronous / decoupled-PPO controls
+    recompute_logprob: bool = False
+    use_decoupled_loss: bool = False
+    behav_imp_weight_cap: float | None = None
+    dynamic_sampling: bool = False
+    log_agent_stats: bool = False
+    log_agent_stats_keys: list[str] = field(default_factory=list)
+    max_new_tokens: int = 1024
+
+
+@dataclass
+class PPOCriticConfig(TrainEngineConfig):
+    """PPO critic config (reference cli_args.py:513)."""
+
+    ppo_n_minibatches: int = 4
+    eps_clip: float = 0.5
+    mask_no_eos_with_zero: bool = False
+
+
+@dataclass
+class JaxDecodeConfig:
+    """TPU-native decode engine config (replaces SGLangConfig/vLLMConfig).
+
+    Continuous batching over a static [max_running_requests, pages] KV layout
+    so XLA compiles once; paged KV cache with prefix reuse; interruptible
+    generation via chunked decode loops.
+    """
+
+    model_path: str = ""
+    random_seed: int = 1
+    dtype: str = "bfloat16"
+    kv_cache_dtype: str = "bfloat16"
+    context_length: int = 32768
+    max_running_requests: int = 64
+    page_size: int = 128  # tokens per KV page (TPU-friendly multiple of 128)
+    hbm_utilization: float = 0.85
+    max_prefill_tokens: int = 8192
+    # tokens generated per decode-loop dispatch; interrupts land on chunk
+    # boundaries (parity: partial rollout `new_tokens_per_chunk`)
+    new_tokens_per_chunk: int = 128
+    enable_prefix_caching: bool = True
+    disable_radix_cache: bool = False
+    schedule_policy: str = "fcfs"
+    skip_tokenizer_init: bool = False
+    log_level: str = "info"
+    enable_metrics: bool = False
+    decode_log_interval: int = 40
+
+
+@dataclass
+class InferenceEngineConfig:
+    """Rollout-side engine config (reference cli_args.py:785)."""
+
+    experiment_name: str | None = None
+    trial_name: str | None = None
+    max_concurrent_rollouts: None | int = None
+    queue_size: None | int = None
+    consumer_batch_size: int = 1
+    max_head_offpolicyness: int = 0
+    enable_rollout_tracing: bool = False
+    check_trajectory_format: bool = False
+    schedule_policy: str = "round_robin"
+    setup_timeout: float = 120.0
+    request_timeout: float = 3600.0
+    request_retries: int = 3
+    pause_grace_period: float = 0.0
+
+
+@dataclass
+class _Timer:
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = ""
+    freq_epochs: int | None = None
+    freq_steps: int | None = None
+    freq_secs: int | None = None
+
+
+@dataclass
+class EvaluatorConfig(_Timer):
+    pass
+
+
+@dataclass
+class SaverConfig(_Timer):
+    pass
+
+
+@dataclass
+class RecoverConfig(_Timer):
+    mode: str = "disabled"  # "disabled" | "auto" | "fault" | "resume"
+    retries: int = 3
+
+
+@dataclass
+class WandBConfig:
+    mode: str = "disabled"
+    wandb_base_url: str = ""
+    wandb_api_key: str = ""
+    entity: str | None = None
+    project: str | None = None
+    name: str | None = None
+    job_type: str | None = None
+    group: str | None = None
+    notes: str | None = None
+    tags: list[str] | None = None
+    config: dict | None = None
+    id_suffix: str | None = "train"
+
+
+@dataclass
+class SwanlabConfig:
+    project: str | None = None
+    name: str | None = None
+    config: dict | None = None
+    logdir: str | None = None
+    mode: str | None = "disabled"
+    api_key: str | None = None
+
+
+@dataclass
+class TensorBoardConfig:
+    path: str | None = None
+
+
+@dataclass
+class StatsLoggerConfig:
+    experiment_name: str = ""
+    trial_name: str = ""
+    fileroot: str = ""
+    wandb: WandBConfig = field(default_factory=WandBConfig)
+    swanlab: SwanlabConfig = field(default_factory=SwanlabConfig)
+    tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
+
+
+@dataclass
+class ClusterSpecConfig:
+    name_resolve: NameResolveConfig = field(default_factory=NameResolveConfig)
+    cluster_name: str = "local"
+    fileroot: str = "/tmp/areal_tpu"
+    n_nodes: int = 1
+    n_accelerators_per_node: int = 8  # chips per host (v5p host = 4, v5e = 8)
+
+
+@dataclass
+class DatasetConfig:
+    path: str = ""
+    type: str = ""
+    batch_size: int = 1
+    shuffle: bool = True
+    pin_memory: bool = False
+    num_workers: int = 0
+    drop_last: bool = True
+    max_length: int | None = None
+
+
+@dataclass
+class SlurmLauncherConfig:
+    srun_additional_args: str = ""
+    additional_bash_cmds: list[str] | None = None
+    container_type: str = "none"
+    mount: str = ""
+    trainer_image: str | None = None
+    inference_server_image: str | None = None
+
+
+@dataclass
+class LauncherConfig:
+    inference_server_cpus_per_accelerator: int = 4
+    inference_server_mem_per_accelerator: int = 32 * 1024
+    trainer_cpus_per_accelerator: int = 4
+    trainer_mem_per_accelerator: int = 32 * 1024
+    inference_server_env_vars: str = ""
+    trainer_env_vars: str = ""
+    slurm: SlurmLauncherConfig = field(default_factory=SlurmLauncherConfig)
+
+
+@dataclass
+class BaseExperimentConfig:
+    """Root experiment config (reference cli_args.py:1145)."""
+
+    experiment_name: str = "experiment"
+    trial_name: str = "trial"
+    cluster: ClusterSpecConfig = field(default_factory=ClusterSpecConfig)
+    allocation_mode: str = ""
+    seed: int = 1
+    total_train_epochs: int = 1
+    total_train_steps: int | None = None
+    total_train_n_seqs: int | None = None
+    tokenizer_path: str = ""
+    train_dataset: DatasetConfig = field(default_factory=DatasetConfig)
+    valid_dataset: DatasetConfig | None = None
+    saver: SaverConfig = field(default_factory=SaverConfig)
+    evaluator: EvaluatorConfig = field(default_factory=EvaluatorConfig)
+    stats_logger: StatsLoggerConfig = field(default_factory=StatsLoggerConfig)
+    recover: RecoverConfig = field(default_factory=RecoverConfig)
+    decode: JaxDecodeConfig = field(default_factory=JaxDecodeConfig)
+    launcher: LauncherConfig = field(default_factory=LauncherConfig)
+
+
+@dataclass
+class SFTConfig(BaseExperimentConfig):
+    model: TrainEngineConfig = field(default_factory=TrainEngineConfig)
+
+
+@dataclass
+class RWConfig(BaseExperimentConfig):
+    model: TrainEngineConfig = field(default_factory=TrainEngineConfig)
+
+
+@dataclass
+class GRPOConfig(BaseExperimentConfig):
+    async_training: bool = True
+    gconfig: GenerationHyperparameters = field(
+        default_factory=GenerationHyperparameters
+    )
+    rollout: InferenceEngineConfig = field(default_factory=InferenceEngineConfig)
+    actor: PPOActorConfig = field(default_factory=PPOActorConfig)
+    ref: PPOActorConfig = field(default_factory=PPOActorConfig)
+
+
+@dataclass
+class PPOConfig(GRPOConfig):
+    critic: PPOCriticConfig = field(default_factory=PPOCriticConfig)
+
+
+# ---------------------------------------------------------------------------
+# CLI / YAML loading (reference cli_args.py:1247-1314)
+# ---------------------------------------------------------------------------
+
+
+def parse_cli_args(argv: list[str]):
+    """Parse ``--config file.yaml key=value ...`` into (dict, overrides)."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, default=None, help="YAML config file")
+    args, overrides = parser.parse_known_args(argv)
+    cfg_dict = {}
+    if args.config is not None:
+        with open(args.config) as f:
+            cfg_dict = yaml.safe_load(f) or {}
+    kv = []
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"override {item!r} must be of the form key=value")
+        k, v = item.split("=", 1)
+        kv.append((k, v))
+    return cfg_dict, kv
+
+
+def load_expr_config(argv: list[str], config_cls):
+    """Load a structured experiment config from CLI argv.
+
+    Returns (config, config_file_dict) like the reference's
+    `load_expr_config` (cli_args.py:1280).
+    """
+    cfg_dict, overrides = parse_cli_args(argv)
+    config = structured.from_dict(config_cls, cfg_dict)
+    for k, v in overrides:
+        structured.apply_override(config, k, v)
+    # propagate experiment/trial names into nested configs that need them
+    for attr in ("saver", "evaluator", "stats_logger", "recover"):
+        sub = getattr(config, attr, None)
+        if sub is not None:
+            if not sub.experiment_name:
+                sub.experiment_name = config.experiment_name
+            if not sub.trial_name:
+                sub.trial_name = config.trial_name
+            if hasattr(sub, "fileroot") and not sub.fileroot:
+                sub.fileroot = config.cluster.fileroot
+    for attr in ("rollout",):
+        sub = getattr(config, attr, None)
+        if sub is not None:
+            if sub.experiment_name is None:
+                sub.experiment_name = config.experiment_name
+            if sub.trial_name is None:
+                sub.trial_name = config.trial_name
+    for attr in ("actor", "ref", "critic", "model"):
+        sub = getattr(config, attr, None)
+        if sub is not None:
+            if not sub.experiment_name:
+                sub.experiment_name = config.experiment_name
+            if not sub.trial_name:
+                sub.trial_name = config.trial_name
+    return config, cfg_dict
+
+
+def save_config(config, save_dir: str) -> str:
+    """Persist the resolved config as YAML in the run directory."""
+    os.makedirs(save_dir, exist_ok=True)
+    path = os.path.join(save_dir, "config.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump(structured.to_dict(config), f, sort_keys=False)
+    return path
+
+
+def get_user() -> str:
+    try:
+        return getpass.getuser()
+    except Exception:
+        return "unknown"

@@ -1,0 +1,69 @@
+"""Hardware platform abstraction (parity: areal/platforms/platform.py:10).
+
+The reference abstracts CUDA vs NPU vs CPU behind a `Platform` object whose
+most important field is `communication_backend` ("nccl"/"hccl"). On TPU the
+collective fabric is ICI (intra-slice) / DCN (inter-slice) and collectives are
+emitted by XLA from sharding annotations, so the platform object mostly
+carries topology facts and device bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class Platform:
+    device_type: str  # "tpu" | "cpu" | "gpu"
+    communication_backend: str  # "ici" | "host" | "nccl"
+    device_control_env_var: str = "JAX_PLATFORMS"
+
+    @property
+    def is_accelerator(self) -> bool:
+        return self.device_type != "cpu"
+
+    def device_count(self) -> int:
+        import jax
+
+        return jax.device_count()
+
+    def local_device_count(self) -> int:
+        import jax
+
+        return jax.local_device_count()
+
+    def process_index(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    def process_count(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+
+TpuPlatform = Platform(device_type="tpu", communication_backend="ici")
+CpuPlatform = Platform(device_type="cpu", communication_backend="host")
+GpuPlatform = Platform(device_type="gpu", communication_backend="nccl")
+
+_platform: Platform | None = None
+
+
+def current_platform() -> Platform:
+    """Detect the platform lazily (importing jax initializes the backend)."""
+    global _platform
+    if _platform is None:
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            _platform = CpuPlatform
+        else:
+            import jax
+
+            kind = jax.devices()[0].platform
+            _platform = {
+                "tpu": TpuPlatform,
+                "cpu": CpuPlatform,
+                "gpu": GpuPlatform,
+            }.get(kind, TpuPlatform)
+    return _platform

@@ -1,0 +1,58 @@
+"""Structured, colored logging (parity: areal/utils/logging.py).
+
+A thin wrapper over the stdlib logging module that gives every framework
+module a consistent `[timestamp] [name] [level]` format, with ANSI colors
+on TTYs and plain text otherwise.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(levelname)s: %(message)s"
+_DATE_FORMAT = "%Y%m%d-%H:%M:%S"
+
+_COLORS = {
+    "DEBUG": "\033[36m",  # cyan
+    "INFO": "\033[32m",  # green
+    "WARNING": "\033[33m",  # yellow
+    "ERROR": "\033[31m",  # red
+    "CRITICAL": "\033[41m",  # red background
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        color = _COLORS.get(record.levelname)
+        if color and sys.stderr.isatty():
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+_configured = False
+
+
+def _configure_root() -> None:
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(stream=sys.stderr)
+    handler.setFormatter(_ColorFormatter(fmt=_FORMAT, datefmt=_DATE_FORMAT))
+    root = logging.getLogger("areal_tpu")
+    root.handlers.clear()
+    root.addHandler(handler)
+    root.setLevel(os.environ.get("AREAL_TPU_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _configured = True
+
+
+def getLogger(name: str | None = None) -> logging.Logger:
+    """Return a logger under the `areal_tpu` hierarchy."""
+    _configure_root()
+    if not name:
+        return logging.getLogger("areal_tpu")
+    return logging.getLogger(f"areal_tpu.{name}")

@@ -1,0 +1,385 @@
+"""Distributed key-value discovery service.
+
+Parity: areal/utils/name_resolve.py (NameRecordRepository with Memory / NFS /
+etcd3 / ray backends, TTL + keepalive threads, watch callbacks, reconfigure()).
+
+The TPU build keeps the same contract with two always-available backends:
+
+- ``MemoryNameRecordRepository`` — in-process dict; for single-process tests.
+- ``NfsNameRecordRepository``    — one file per key under a shared filesystem
+  root (NFS/GCS-fuse); the portable multi-host backend.
+
+etcd3/ray backends from the reference are optional extras and are gated behind
+imports (not available in this image).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import threading
+import time
+import uuid
+from pathlib import Path
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("name_resolve")
+
+
+class NameEntryExistsError(Exception):
+    pass
+
+
+class NameEntryNotFoundError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class NameResolveConfig:
+    """Mirror of reference NameResolveConfig (areal/api/cli_args.py:964)."""
+
+    type: str = "nfs"  # "memory" | "nfs"
+    nfs_record_root: str = "/tmp/areal_tpu/name_resolve"
+    etcd3_addr: str = "localhost:2379"
+    ray_actor_name: str = "name_resolve"
+
+
+class NameRecordRepository:
+    """Abstract name-record store. Keys are slash-separated paths."""
+
+    def add(
+        self,
+        name: str,
+        value: str,
+        delete_on_exit: bool = True,
+        keepalive_ttl: float | None = None,
+        replace: bool = False,
+    ) -> None:
+        raise NotImplementedError()
+
+    def get(self, name: str) -> str:
+        raise NotImplementedError()
+
+    def get_subtree(self, name_root: str) -> list[str]:
+        """All values whose key is under `name_root`."""
+        raise NotImplementedError()
+
+    def find_subtree(self, name_root: str) -> list[str]:
+        """All keys under `name_root` (sorted)."""
+        raise NotImplementedError()
+
+    def delete(self, name: str) -> None:
+        raise NotImplementedError()
+
+    def clear_subtree(self, name_root: str) -> None:
+        raise NotImplementedError()
+
+    def wait(
+        self, name: str, timeout: float | None = None, poll_frequency: float = 0.1
+    ) -> str:
+        """Block until `name` appears, then return its value."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                return self.get(name)
+            except NameEntryNotFoundError:
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(f"name_resolve.wait({name}) timed out")
+                time.sleep(poll_frequency)
+
+    def watch_names(
+        self,
+        names: list[str] | str,
+        call_back,
+        poll_frequency: float = 5.0,
+        wait_timeout: float = 300.0,
+    ) -> threading.Thread:
+        """Invoke `call_back()` once any watched name disappears."""
+        if isinstance(names, str):
+            names = [names]
+
+        def _watcher():
+            for name in names:
+                self.wait(name, timeout=wait_timeout)
+            while True:
+                try:
+                    for name in names:
+                        self.get(name)
+                except NameEntryNotFoundError:
+                    call_back()
+                    return
+                time.sleep(poll_frequency)
+
+        t = threading.Thread(target=_watcher, daemon=True)
+        t.start()
+        return t
+
+    def reset(self) -> None:
+        """Remove all entries this process registered with delete_on_exit."""
+        raise NotImplementedError()
+
+
+class MemoryNameRecordRepository(NameRecordRepository):
+    def __init__(self):
+        self._store: dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._owned: set[str] = set()
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        name = name.rstrip("/")
+        with self._lock:
+            if name in self._store and not replace:
+                raise NameEntryExistsError(name)
+            self._store[name] = str(value)
+            if delete_on_exit:
+                self._owned.add(name)
+
+    def get(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            return self._store[name]
+
+    def get_subtree(self, name_root):
+        prefix = name_root.rstrip("/")
+        with self._lock:
+            keys = sorted(
+                k for k in self._store if k == prefix or k.startswith(prefix + "/")
+            )
+            return [self._store[k] for k in keys]
+
+    def find_subtree(self, name_root):
+        prefix = name_root.rstrip("/")
+        with self._lock:
+            return sorted(
+                k for k in self._store if k == prefix or k.startswith(prefix + "/")
+            )
+
+    def delete(self, name):
+        name = name.rstrip("/")
+        with self._lock:
+            if name not in self._store:
+                raise NameEntryNotFoundError(name)
+            del self._store[name]
+            self._owned.discard(name)
+
+    def clear_subtree(self, name_root):
+        for k in self.find_subtree(name_root):
+            with self._lock:
+                self._store.pop(k, None)
+                self._owned.discard(k)
+
+    def reset(self):
+        with self._lock:
+            for k in list(self._owned):
+                self._store.pop(k, None)
+            self._owned.clear()
+
+
+class NfsNameRecordRepository(NameRecordRepository):
+    """One file per key under `record_root`; atomic writes via rename.
+
+    TTL entries are refreshed by a keepalive thread touching mtime; readers
+    treat entries with expired TTL as missing.
+    """
+
+    TTL_SUFFIX = ".ttl"
+
+    def __init__(self, record_root: str = "/tmp/areal_tpu/name_resolve"):
+        self.record_root = Path(record_root)
+        self.record_root.mkdir(parents=True, exist_ok=True)
+        self._owned: set[str] = set()
+        self._keepalive_stop = threading.Event()
+        self._keepalive_entries: dict[str, float] = {}
+        self._keepalive_thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    def _path(self, name: str) -> Path:
+        name = name.strip("/")
+        return self.record_root / name / "ENTRY"
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        p = self._path(name)
+        if p.exists() and not self._expired(p) and not replace:
+            raise NameEntryExistsError(name)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.parent / f".tmp-{uuid.uuid4().hex}"
+        tmp.write_text(str(value))
+        os.replace(tmp, p)
+        ttl_file = Path(str(p) + self.TTL_SUFFIX)
+        if keepalive_ttl is not None:
+            ttl_file.write_text(str(float(keepalive_ttl)))
+            with self._lock:
+                self._keepalive_entries[str(p)] = float(keepalive_ttl)
+            self._ensure_keepalive_thread()
+        else:
+            if ttl_file.exists():
+                ttl_file.unlink()
+            # The previous incarnation of this entry may have had a TTL; stop
+            # refreshing it or the keepalive thread holds it forever.
+            with self._lock:
+                self._keepalive_entries.pop(str(p), None)
+        if delete_on_exit:
+            self._owned.add(name)
+
+    def _expired(self, p: Path) -> bool:
+        ttl_file = Path(str(p) + self.TTL_SUFFIX)
+        if not ttl_file.exists():
+            return False
+        try:
+            ttl = float(ttl_file.read_text())
+            return time.time() - p.stat().st_mtime > ttl
+        except (OSError, ValueError):
+            return False
+
+    def _ensure_keepalive_thread(self):
+        if self._keepalive_thread is not None and self._keepalive_thread.is_alive():
+            return
+        # A previous reset() may have stopped the thread; re-arm the event so
+        # entries added after a reset still get keepalive refreshes.
+        self._keepalive_stop.clear()
+
+        def _loop():
+            while True:
+                with self._lock:
+                    entries = dict(self._keepalive_entries)
+                # Refresh well within the smallest TTL so entries never lapse
+                # while their owner is alive.
+                interval = min([1.0] + [ttl / 3.0 for ttl in entries.values()])
+                if self._keepalive_stop.wait(timeout=max(interval, 0.01)):
+                    return
+                with self._lock:
+                    entries = dict(self._keepalive_entries)
+                for path in entries:
+                    try:
+                        os.utime(path)
+                    except OSError:
+                        pass
+
+        self._keepalive_thread = threading.Thread(target=_loop, daemon=True)
+        self._keepalive_thread.start()
+
+    def get(self, name):
+        p = self._path(name)
+        if not p.exists() or self._expired(p):
+            raise NameEntryNotFoundError(name)
+        return p.read_text()
+
+    def find_subtree(self, name_root):
+        root = self.record_root / name_root.strip("/")
+        if not root.exists():
+            return []
+        out = []
+        for entry in root.rglob("ENTRY"):
+            if not self._expired(entry):
+                out.append(str(entry.parent.relative_to(self.record_root)))
+        return sorted(out)
+
+    def get_subtree(self, name_root):
+        out = []
+        for k in self.find_subtree(name_root):
+            # A peer may delete its entry (or its TTL may lapse) between the
+            # listing and the read; skip dead entries instead of crashing.
+            try:
+                out.append(self.get(k))
+            except NameEntryNotFoundError:
+                continue
+        return out
+
+    def delete(self, name):
+        p = self._path(name)
+        if not p.exists():
+            raise NameEntryNotFoundError(name)
+        p.unlink()
+        ttl_file = Path(str(p) + self.TTL_SUFFIX)
+        if ttl_file.exists():
+            ttl_file.unlink()
+        with self._lock:
+            self._keepalive_entries.pop(str(p), None)
+        self._owned.discard(name)
+
+    def clear_subtree(self, name_root):
+        root = self.record_root / name_root.strip("/")
+        if root.exists():
+            shutil.rmtree(root, ignore_errors=True)
+        prefix = name_root.strip("/")
+        self._owned = {
+            n
+            for n in self._owned
+            if n.strip("/") != prefix and not n.strip("/").startswith(prefix + "/")
+        }
+
+    def reset(self):
+        # Stop and reap the keepalive thread, then re-arm the event so the
+        # repository remains usable (a later add() may need keepalive again).
+        self._keepalive_stop.set()
+        if self._keepalive_thread is not None:
+            self._keepalive_thread.join(timeout=2.0)
+            self._keepalive_thread = None
+        self._keepalive_stop.clear()
+        for name in list(self._owned):
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+        self._owned.clear()
+
+
+# Module-level default repository, reconfigurable like the reference.
+_default_repo: NameRecordRepository = MemoryNameRecordRepository()
+
+
+def reconfigure(config: NameResolveConfig) -> None:
+    global _default_repo
+    if config.type == "memory":
+        _default_repo = MemoryNameRecordRepository()
+    elif config.type == "nfs":
+        _default_repo = NfsNameRecordRepository(config.nfs_record_root)
+    else:
+        raise NotImplementedError(
+            f"name_resolve backend {config.type!r} not available in the TPU build "
+            "(supported: memory, nfs)"
+        )
+
+
+def default_repo() -> NameRecordRepository:
+    return _default_repo
+
+
+def add(name, value, **kwargs):
+    return _default_repo.add(name, value, **kwargs)
+
+
+def get(name):
+    return _default_repo.get(name)
+
+
+def get_subtree(name_root):
+    return _default_repo.get_subtree(name_root)
+
+
+def find_subtree(name_root):
+    return _default_repo.find_subtree(name_root)
+
+
+def delete(name):
+    return _default_repo.delete(name)
+
+
+def clear_subtree(name_root):
+    return _default_repo.clear_subtree(name_root)
+
+
+def wait(name, timeout=None, poll_frequency=0.1):
+    return _default_repo.wait(name, timeout=timeout, poll_frequency=poll_frequency)
+
+
+def watch_names(names, call_back, poll_frequency=5.0, wait_timeout=300.0):
+    return _default_repo.watch_names(names, call_back, poll_frequency, wait_timeout)
+
+
+def reset():
+    return _default_repo.reset()

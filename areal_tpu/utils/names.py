@@ -1,0 +1,46 @@
+"""Key layout for the name_resolve discovery service.
+
+Parity: areal/utils/names.py — all keys live under /{experiment}/{trial}.
+"""
+
+from __future__ import annotations
+
+ROOT = "areal_tpu"
+
+
+def _base(experiment_name: str, trial_name: str) -> str:
+    return f"{ROOT}/{experiment_name}/{trial_name}"
+
+
+def gen_servers(experiment_name: str, trial_name: str) -> str:
+    return f"{_base(experiment_name, trial_name)}/gen_servers"
+
+
+def gen_server(experiment_name: str, trial_name: str, server_id: str) -> str:
+    return f"{_base(experiment_name, trial_name)}/gen_servers/{server_id}"
+
+
+def update_weights_from_disk(
+    experiment_name: str, trial_name: str, model_version: int
+) -> str:
+    return f"{_base(experiment_name, trial_name)}/update_weights_from_disk/{model_version}"
+
+
+def experiment_status(experiment_name: str, trial_name: str) -> str:
+    return f"{_base(experiment_name, trial_name)}/experiment_status"
+
+
+def trainer_rank(experiment_name: str, trial_name: str, rank: int) -> str:
+    return f"{_base(experiment_name, trial_name)}/trainer/{rank}"
+
+
+def distributed_peer(experiment_name: str, trial_name: str, group: str, rank: int) -> str:
+    return f"{_base(experiment_name, trial_name)}/peers/{group}/{rank}"
+
+
+def distributed_barrier(experiment_name: str, trial_name: str, barrier: str) -> str:
+    return f"{_base(experiment_name, trial_name)}/barrier/{barrier}"
+
+
+def model_version(experiment_name: str, trial_name: str, role: str = "default") -> str:
+    return f"{_base(experiment_name, trial_name)}/model_version/{role}"

@@ -1,0 +1,137 @@
+"""Chrome-trace (catapult) JSON performance tracer.
+
+Parity target: areal/utils/perf_tracer.py:127 (PerfTracer) — sync/async trace
+scopes with categories (compute/comm/io/sync/scheduler), per-rank trace files
+merged into one, env-var initialisation, atexit save. Viewable in
+chrome://tracing or Perfetto; complements (does not replace) jax.profiler
+xprof traces for on-device kernel timing.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from pathlib import Path
+
+_CATEGORIES = ("compute", "comm", "io", "sync", "scheduler", "misc")
+
+
+class PerfTracer:
+    def __init__(self, rank: int = 0, save_path: str | None = None, enabled: bool = True):
+        self.rank = rank
+        self.save_path = save_path
+        self.enabled = enabled
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        if enabled and save_path:
+            atexit.register(self.save)
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextmanager
+    def trace_scope(self, name: str, category: str = "compute", **args):
+        if not self.enabled:
+            yield
+            return
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            end = self._now_us()
+            with self._lock:
+                self._events.append(
+                    dict(
+                        name=name,
+                        cat=category if category in _CATEGORIES else "misc",
+                        ph="X",
+                        ts=start,
+                        dur=end - start,
+                        pid=self.rank,
+                        tid=threading.get_ident() % 100000,
+                        args=args,
+                    )
+                )
+
+    # Async (flow) events for cross-thread spans, e.g. a rollout's lifetime.
+    def atrace_begin(self, name: str, aid: str, category: str = "scheduler"):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                dict(name=name, cat=category, ph="b", id=aid, ts=self._now_us(),
+                     pid=self.rank, tid=0)
+            )
+
+    def atrace_end(self, name: str, aid: str, category: str = "scheduler"):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                dict(name=name, cat=category, ph="e", id=aid, ts=self._now_us(),
+                     pid=self.rank, tid=0)
+            )
+
+    def instant(self, name: str, category: str = "misc", **args):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                dict(name=name, cat=category, ph="i", ts=self._now_us(),
+                     pid=self.rank, tid=0, s="p", args=args)
+            )
+
+    def save(self, path: str | None = None) -> str | None:
+        path = path or self.save_path
+        if not path or not self.enabled:
+            return None
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with self._lock:
+            events = list(self._events)
+        with open(p, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        return str(p)
+
+    @staticmethod
+    def merge(rank_files: list[str], out_path: str) -> str:
+        """Merge per-rank trace files into one (reference merges under flock)."""
+        merged: list[dict] = []
+        for rf in rank_files:
+            try:
+                with open(rf) as f:
+                    merged.extend(json.load(f).get("traceEvents", []))
+            except (OSError, json.JSONDecodeError):
+                continue
+        with open(out_path, "w") as f:
+            json.dump({"traceEvents": merged, "displayTimeUnit": "ms"}, f)
+        return out_path
+
+
+_tracer: PerfTracer | None = None
+
+
+def init_from_env(rank: int = 0) -> PerfTracer:
+    """Initialise the global tracer from AREAL_TPU_PERF_TRACE* env vars."""
+    global _tracer
+    enabled = os.environ.get("AREAL_TPU_PERF_TRACE", "0") in ("1", "true")
+    trace_dir = os.environ.get("AREAL_TPU_PERF_TRACE_DIR", "/tmp/areal_tpu/traces")
+    path = os.path.join(trace_dir, f"trace-rank{rank}.json") if enabled else None
+    _tracer = PerfTracer(rank=rank, save_path=path, enabled=enabled)
+    return _tracer
+
+
+def get() -> PerfTracer:
+    global _tracer
+    if _tracer is None:
+        _tracer = init_from_env()
+    return _tracer
+
+
+def trace_scope(name: str, category: str = "compute", **args):
+    return get().trace_scope(name, category, **args)

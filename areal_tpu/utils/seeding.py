@@ -1,0 +1,45 @@
+"""Deterministic per-role seeding (parity: areal/utils/seeding.py).
+
+In JAX, randomness is explicit: we derive a root `jax.random.PRNGKey` from
+(seed, key) and hand sub-keys out. We still seed `random`/`numpy` for host-side
+shuffling (dataset order, rollout scheduling jitter).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+import numpy as np
+
+_BASE_SEED: int | None = None
+_SEED_KEY: str = ""
+
+
+def _fold(seed: int, key: str) -> int:
+    digest = hashlib.sha256(f"{seed}/{key}".encode()).digest()
+    return int.from_bytes(digest[:8], "little") % (2**31 - 1)
+
+
+def set_random_seed(seed: int, key: str) -> None:
+    """Seed host-side RNGs deterministically per (seed, role-key) pair."""
+    global _BASE_SEED, _SEED_KEY
+    _BASE_SEED, _SEED_KEY = seed, key
+    folded = _fold(seed, key)
+    random.seed(folded)
+    np.random.seed(folded % (2**32 - 1))
+
+
+def get_seed() -> int:
+    if _BASE_SEED is None:
+        raise RuntimeError("set_random_seed() has not been called")
+    return _fold(_BASE_SEED, _SEED_KEY)
+
+
+def new_prng_key(subkey: str = ""):
+    """Derive a jax PRNGKey from the global (seed, key) plus an optional subkey."""
+    import jax
+
+    if _BASE_SEED is None:
+        raise RuntimeError("set_random_seed() has not been called")
+    return jax.random.PRNGKey(_fold(_BASE_SEED, f"{_SEED_KEY}/{subkey}"))

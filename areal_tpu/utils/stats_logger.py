@@ -1,0 +1,108 @@
+"""Rank-0 metrics fan-out: console table + tensorboard (+ wandb/swanlab when
+installed).
+
+Parity target: areal/utils/stats_logger.py:20 (StatsLogger). wandb and
+swanlab are optional — gated imports, "disabled" by default, matching the
+reference's default modes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from areal_tpu.api.cli_args import StatsLoggerConfig
+from areal_tpu.api.io_struct import FinetuneSpec
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("stats_logger")
+
+
+class StatsLogger:
+    def __init__(self, config: StatsLoggerConfig, ft_spec: FinetuneSpec | None = None):
+        self.config = config
+        self.ft_spec = ft_spec
+        self._tb_writer = None
+        self._wandb = None
+        self._swanlab = None
+        self._init_backends()
+
+    def _log_dir(self) -> str:
+        return os.path.join(
+            self.config.fileroot or "/tmp/areal_tpu",
+            "logs",
+            self.config.experiment_name,
+            self.config.trial_name,
+        )
+
+    def _init_backends(self):
+        cfg = self.config
+        if cfg.tensorboard.path is not None:
+            try:
+                from tensorboardX import SummaryWriter
+
+                self._tb_writer = SummaryWriter(logdir=cfg.tensorboard.path)
+            except ImportError:
+                logger.warning("tensorboardX not available; tensorboard disabled")
+        if cfg.wandb.mode != "disabled":
+            try:
+                import wandb
+
+                wandb.init(
+                    mode=cfg.wandb.mode,
+                    entity=cfg.wandb.entity,
+                    project=cfg.wandb.project or cfg.experiment_name,
+                    name=cfg.wandb.name or cfg.trial_name,
+                    group=cfg.wandb.group,
+                    notes=cfg.wandb.notes,
+                    tags=cfg.wandb.tags,
+                    config=cfg.wandb.config,
+                )
+                self._wandb = wandb
+            except ImportError:
+                logger.warning("wandb not installed; wandb logging disabled")
+        if cfg.swanlab.mode not in (None, "disabled"):
+            try:
+                import swanlab
+
+                if cfg.swanlab.api_key:
+                    swanlab.login(cfg.swanlab.api_key)
+                swanlab.init(
+                    project=cfg.swanlab.project or cfg.experiment_name,
+                    experiment_name=cfg.swanlab.name or cfg.trial_name,
+                    config=cfg.swanlab.config,
+                    logdir=cfg.swanlab.logdir,
+                    mode=cfg.swanlab.mode,
+                )
+                self._swanlab = swanlab
+            except ImportError:
+                logger.warning("swanlab not installed; swanlab logging disabled")
+
+    def commit(
+        self, epoch: int, step: int, global_step: int, data: dict[str, Any]
+    ) -> None:
+        """Log one training step's stats to all backends + console."""
+        flat = {k: float(v) for k, v in data.items()}
+        lines = [
+            f"Epoch {epoch} step {step} (global step {global_step}):",
+        ]
+        width = max((len(k) for k in flat), default=0)
+        for k in sorted(flat):
+            lines.append(f"  {k:<{width}} = {flat[k]:.6g}")
+        logger.info("\n".join(lines))
+        if self._tb_writer is not None:
+            for k, v in flat.items():
+                self._tb_writer.add_scalar(k, v, global_step)
+            self._tb_writer.flush()
+        if self._wandb is not None:
+            self._wandb.log(flat, step=global_step)
+        if self._swanlab is not None:
+            self._swanlab.log(flat, step=global_step)
+
+    def close(self):
+        if self._tb_writer is not None:
+            self._tb_writer.close()
+        if self._wandb is not None:
+            self._wandb.finish()
+        if self._swanlab is not None:
+            self._swanlab.finish()

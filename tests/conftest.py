@@ -1,0 +1,29 @@
+"""Test harness configuration.
+
+All tests run on CPU with 8 virtual XLA devices so multi-chip shardings
+(dp/fsdp/tp/sp meshes) are exercised without TPU hardware — the JAX analogue
+of the reference's StandaloneTestingProcess multi-rank-on-one-GPU pattern
+(realhf/base/testing.py:37-120).
+
+Must set env vars BEFORE jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
+    return devices

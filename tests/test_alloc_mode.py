@@ -1,0 +1,132 @@
+"""Allocation-mode grammar tests (parity: areal/tests/test_allocation_mode.py)."""
+
+import pytest
+
+from areal_tpu.api.alloc_mode import (
+    AllocationMode,
+    AllocationType,
+    InvalidAllocationModeError,
+    ParallelStrategy,
+)
+
+
+def test_train_only_colocate():
+    m = AllocationMode.from_str("d4t2p1")
+    assert m.type_ == AllocationType.COLOCATE
+    assert m.train.dp_size == 4
+    assert m.train.tp_size == 2
+    assert m.train.pp_size == 1
+    assert m.train.world_size == 8
+    assert m.train_backend == "jax"
+
+
+def test_decoupled_train():
+    m = AllocationMode.from_str("jax:d4t2+jax:d8")
+    assert m.type_ == AllocationType.DECOUPLED_TRAIN
+    assert m.gen.world_size == 8
+    assert m.train.world_size == 8
+    assert m.gen_backend == "jax"
+    assert m.gen_instance_size == 2
+
+
+def test_reference_syntax_accepted():
+    m = AllocationMode.from_str("sglang:d4t2+fsdp:d8")
+    assert m.gen_backend == "sglang"
+    assert m.train_backend == "fsdp"
+
+
+def test_colocate_rl():
+    m = AllocationMode.from_str("jax:d2t4|jax:d2t4")
+    assert m.type_ == AllocationType.COLOCATE
+    assert m.gen.tp_size == 4
+    assert m.train.tp_size == 4
+
+
+def test_llm_server_only():
+    m = AllocationMode.from_str("vllm:d2t4")
+    assert m.type_ == AllocationType.LLM_SERVER_ONLY
+    assert m.gen.world_size == 8
+
+
+def test_decoupled_eval():
+    m = AllocationMode.from_str("jax:d4t2+eval")
+    assert m.type_ == AllocationType.DECOUPLED_EVAL
+
+
+def test_context_parallel_dim():
+    m = AllocationMode.from_str("d2c2t2")
+    assert m.train.cp_size == 2
+    assert m.train.world_size == 8
+
+
+def test_moe_hybrid():
+    m = AllocationMode.from_str("jax:d4+(attn:d2t2|ffn:d2e2)")
+    assert m.train.ep_size == 2
+    assert m.train.tp_size == 2
+    assert m.train.world_size == 4
+
+
+def test_moe_hybrid_world_size_mismatch():
+    with pytest.raises(Exception):
+        AllocationMode.from_str("(attn:d4t2|ffn:d2e2)")
+
+
+def test_duplicate_dim_rejected():
+    with pytest.raises(Exception):
+        AllocationMode.from_str("d2d4")
+
+
+def test_garbage_rejected():
+    with pytest.raises(InvalidAllocationModeError):
+        AllocationMode.from_str("notavalidmode:::")
+
+
+def test_parallel_strategy_props():
+    p = ParallelStrategy(
+        tensor_parallel_size=2,
+        data_parallel_size=2,
+        context_parallel_size=2,
+        expert_parallel_size=2,
+    )
+    assert p.world_size == 8
+    assert p.expert_model_parallel_size == 2
+    assert p.expert_data_parallel_size == 4
+    assert str(ParallelStrategy(data_parallel_size=4)) == "d4"
+
+
+def test_standalone_jax_is_inference_only():
+    # "jax" serves both roles; standalone it is ALWAYS inference (documented).
+    m = AllocationMode.from_str("jax:d8")
+    assert m.type_ == AllocationType.LLM_SERVER_ONLY
+    assert m.train is None
+
+
+def test_inference_side_rejects_cp_ep_dims():
+    with pytest.raises(Exception, match="train-only"):
+        AllocationMode.from_str("jax:d4c2")
+
+
+def test_train_backend_on_inference_side_rejected():
+    with pytest.raises(Exception, match="not an inference backend"):
+        AllocationMode.from_str("megatron:d4+jax:d4")
+
+
+def test_standalone_fsdp_is_trainer():
+    m = AllocationMode.from_str("fsdp:d8")
+    assert m.type_ == AllocationType.COLOCATE
+    assert m.train.world_size == 8
+
+
+def test_colocate_world_size_mismatch_rejected():
+    with pytest.raises(Exception, match="matching world"):
+        AllocationMode.from_str("jax:d2|d8")
+
+
+def test_moe_hybrid_rejected_on_inference_side():
+    with pytest.raises(Exception, match="not valid for an inference"):
+        AllocationMode.from_str("jax:(attn:d2t2|ffn:d2e2)")
+
+
+def test_unbalanced_parens_rejected():
+    with pytest.raises(InvalidAllocationModeError):
+        AllocationMode.from_str("(attn:d2t2|ffn:d2e2")

@@ -1,0 +1,124 @@
+import threading
+import time
+
+import pytest
+
+from areal_tpu.utils.name_resolve import (
+    MemoryNameRecordRepository,
+    NameEntryExistsError,
+    NameEntryNotFoundError,
+    NfsNameRecordRepository,
+)
+
+
+@pytest.fixture(params=["memory", "nfs"])
+def repo(request, tmp_path):
+    if request.param == "memory":
+        return MemoryNameRecordRepository()
+    return NfsNameRecordRepository(str(tmp_path / "nr"))
+
+
+def test_add_get_delete(repo):
+    repo.add("a/b/c", "v1")
+    assert repo.get("a/b/c") == "v1"
+    with pytest.raises(NameEntryExistsError):
+        repo.add("a/b/c", "v2")
+    repo.add("a/b/c", "v2", replace=True)
+    assert repo.get("a/b/c") == "v2"
+    repo.delete("a/b/c")
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("a/b/c")
+
+
+def test_subtree(repo):
+    repo.add("root/x/1", "a")
+    repo.add("root/x/2", "b")
+    repo.add("root/y/1", "c")
+    assert repo.find_subtree("root/x") == ["root/x/1", "root/x/2"]
+    assert sorted(repo.get_subtree("root")) == ["a", "b", "c"]
+    repo.clear_subtree("root/x")
+    assert repo.find_subtree("root/x") == []
+    assert repo.get_subtree("root") == ["c"]
+
+
+def test_wait_blocks_until_added(repo):
+    def _adder():
+        time.sleep(0.2)
+        repo.add("late/key", "42")
+
+    t = threading.Thread(target=_adder)
+    t.start()
+    assert repo.wait("late/key", timeout=5) == "42"
+    t.join()
+
+
+def test_wait_timeout(repo):
+    with pytest.raises(TimeoutError):
+        repo.wait("never/appears", timeout=0.2)
+
+
+def test_reset_removes_owned(repo):
+    repo.add("owned/key", "1", delete_on_exit=True)
+    repo.add("kept/key", "2", delete_on_exit=False)
+    repo.reset()
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("owned/key")
+    assert repo.get("kept/key") == "2"
+
+
+def test_nfs_ttl_expiry(tmp_path):
+    repo = NfsNameRecordRepository(str(tmp_path / "ttl"))
+    repo.add("ephemeral", "x", keepalive_ttl=0.3)
+    assert repo.get("ephemeral") == "x"
+    # Stop the keepalive to simulate owner death, entry should expire.
+    repo._keepalive_stop.set()
+    with repo._lock:
+        repo._keepalive_entries.clear()
+    time.sleep(0.6)
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("ephemeral")
+
+
+def test_nfs_keepalive_survives_reset(tmp_path):
+    # Regression: reset() used to permanently stop the keepalive thread.
+    repo = NfsNameRecordRepository(str(tmp_path / "ka"))
+    repo.add("first", "1", keepalive_ttl=10)
+    repo.reset()
+    repo.add("second", "2", keepalive_ttl=0.4)
+    time.sleep(0.8)  # > TTL; keepalive must be refreshing mtime
+    assert repo.get("second") == "2"
+
+
+def test_nfs_clear_subtree_prefix_boundary(tmp_path):
+    # Regression: clear_subtree("foo") must not orphan sibling "foobar".
+    repo = NfsNameRecordRepository(str(tmp_path / "pb"))
+    repo.add("foo/x", "1")
+    repo.add("foobar/y", "2")
+    repo.clear_subtree("foo")
+    assert repo.get("foobar/y") == "2"
+    repo.reset()  # must delete foobar/y since still owned
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("foobar/y")
+
+
+def test_nfs_get_subtree_skips_concurrently_deleted(tmp_path, monkeypatch):
+    repo = NfsNameRecordRepository(str(tmp_path / "race"))
+    repo.add("s/a", "1")
+    repo.add("s/b", "2")
+    orig_get = repo.get
+
+    def racy_get(name):
+        if name == "s/a":
+            raise NameEntryNotFoundError(name)
+        return orig_get(name)
+
+    monkeypatch.setattr(repo, "get", racy_get)
+    assert repo.get_subtree("s") == ["2"]
+
+
+def test_nfs_replace_without_ttl_stops_keepalive(tmp_path):
+    repo = NfsNameRecordRepository(str(tmp_path / "rk"))
+    repo.add("k", "1", keepalive_ttl=5)
+    repo.add("k", "2", replace=True)  # now permanent
+    assert not repo._keepalive_entries, "keepalive entry leaked after replace"
+    assert repo.get("k") == "2"

@@ -1,0 +1,86 @@
+import numpy as np
+import pytest
+
+from areal_tpu.utils.stats_tracker import DistributedStatsTracker, ReduceType
+
+
+def test_denominator_conditioned_mean():
+    t = DistributedStatsTracker()
+    mask = np.array([True, True, False, True])
+    vals = np.array([1.0, 2.0, 100.0, 3.0], dtype=np.float32)
+    t.denominator(n_tokens=mask)
+    t.stat("n_tokens", loss=vals)
+    out = t.export()
+    assert out["loss/avg"] == pytest.approx(2.0)
+    assert out["loss/min"] == pytest.approx(1.0)
+    assert out["loss/max"] == pytest.approx(3.0)
+    assert out["n_tokens"] == 3.0
+
+
+def test_scopes():
+    t = DistributedStatsTracker()
+    with t.scope("ppo"):
+        with t.scope("actor"):
+            t.scalar(lr=0.1)
+    out = t.export()
+    assert out == {"ppo/actor/lr": pytest.approx(0.1)}
+
+
+def test_record_timing():
+    t = DistributedStatsTracker()
+    with t.record_timing("rollout"):
+        pass
+    out = t.export()
+    assert "timeperf/rollout" in out
+    assert out["timeperf/rollout"] >= 0
+
+
+def test_sum_reduce():
+    t = DistributedStatsTracker()
+    mask = np.array([True, True])
+    t.denominator(m=mask)
+    t.stat("m", reward=np.array([1.0, 5.0], dtype=np.float32),
+           reduce_type=ReduceType.SUM)
+    out = t.export()
+    assert out["reward"] == pytest.approx(6.0)
+
+
+def test_export_resets():
+    t = DistributedStatsTracker()
+    t.scalar(x=1.0)
+    assert t.export() != {}
+    assert t.export() == {}
+
+
+def test_denominator_must_exist():
+    t = DistributedStatsTracker()
+    with pytest.raises(ValueError):
+        t.stat("missing", v=np.array([1.0], dtype=np.float32))
+
+
+def test_shape_mismatch_rejected():
+    t = DistributedStatsTracker()
+    t.denominator(m=np.array([True, False]))
+    with pytest.raises(ValueError):
+        t.stat("m", v=np.array([1.0], dtype=np.float32))
+
+
+def test_bad_denominator_dtype():
+    t = DistributedStatsTracker()
+    with pytest.raises(ValueError):
+        t.denominator(m=np.array([1.0, 2.0]))
+
+
+def test_denominator_alignment_across_steps():
+    # Regression: stat appended more often than denominator must pair each
+    # numerator with the mask current at stat() time, not cycle old masks.
+    t = DistributedStatsTracker()
+    t.denominator(m=np.array([True, True]))
+    t.stat("m", x=np.array([1.0, 1.0], dtype=np.float32))
+    t.denominator(m=np.array([True, False]))
+    t.stat("m", x=np.array([2.0, 2.0], dtype=np.float32))
+    t.stat("m", x=np.array([3.0, 3.0], dtype=np.float32))
+    out = t.export()
+    # masked values: [1,1] (2 elts) + [2] + [3] -> mean = 7/4 = 1.75
+    import pytest as _pytest
+    assert out["x/avg"] == _pytest.approx(1.75)

@@ -1,0 +1,49 @@
+from areal_tpu.utils.timeutil import FrequencyControl
+
+
+def test_step_gate():
+    fc = FrequencyControl(freq_step=3)
+    fires = [fc.check(steps=1) for _ in range(7)]
+    assert fires == [False, False, True, False, False, True, False]
+
+
+def test_epoch_gate():
+    fc = FrequencyControl(freq_epoch=2)
+    assert not fc.check(epochs=1)
+    assert fc.check(epochs=1)
+
+
+def test_initial_value():
+    fc = FrequencyControl(freq_step=100, initial_value=True)
+    assert fc.check(steps=1)
+    assert not fc.check(steps=1)
+
+
+def test_disabled_never_fires():
+    fc = FrequencyControl()
+    assert not any(fc.check(steps=1, epochs=1) for _ in range(10))
+
+
+def test_state_dict_roundtrip():
+    fc = FrequencyControl(freq_step=5)
+    for _ in range(4):
+        fc.check(steps=1)
+    state = fc.state_dict()
+    fc2 = FrequencyControl(freq_step=5)
+    fc2.load_state_dict(state)
+    assert fc2.check(steps=1)  # 5th step fires
+
+
+def test_gates_are_independent():
+    # Regression: a step-fire must not reset the seconds gate's baseline.
+    import time as _time
+
+    fc = FrequencyControl(freq_step=1, freq_sec=0.3)
+    t0 = _time.monotonic()
+    fired_by_time = False
+    while _time.monotonic() - t0 < 0.5:
+        fc.check(steps=1)  # fires on steps every call
+        _time.sleep(0.05)
+        if fc._last_time > t0:
+            fired_by_time = True
+    assert fired_by_time, "seconds gate was starved by step fires"
