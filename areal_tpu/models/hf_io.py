@@ -1,0 +1,264 @@
+"""HF checkpoint ⇄ areal_tpu param tree conversion.
+
+Parity target: the reference loads HF models directly via transformers
+(areal/engine/base_hf_engine.py:180-187) and converts between formats in
+realhf/api/from_hf/*. Here conversion is a declarative name/layout table:
+HF stores linear weights as [out, in] (torch convention); our kernels are
+[in, out]-shaped einsum operands with heads split out, so loading is a
+transpose + reshape per tensor.
+
+Supports Qwen2/2.5 (qkv bias), Qwen3 (qk norm), and Llama-family layouts.
+Files: model.safetensors or sharded model-*-of-*.safetensors with index.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.models.qwen2 import ModelConfig, param_shapes
+
+try:  # safetensors is baked in
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+except ImportError:  # pragma: no cover
+    safe_open = None
+    save_file = None
+
+
+def _iter_hf_tensors(model_dir: str):
+    """Yield (name, np.ndarray) from single or sharded safetensors files."""
+    index_path = os.path.join(model_dir, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        shards = sorted(set(index["weight_map"].values()))
+    else:
+        shards = ["model.safetensors"]
+    for shard in shards:
+        path = os.path.join(model_dir, shard)
+        with safe_open(path, framework="numpy") as f:
+            for name in f.keys():
+                yield name, f.get_tensor(name)
+
+
+def hf_name_to_ours(name: str) -> tuple[str, ...] | None:
+    """Map one HF tensor name to a path in our (unstacked) param tree.
+
+    Returns None for tensors we ignore (e.g. rotary inv_freq buffers).
+    """
+    name = name.removeprefix("model.")
+    if name == "embed_tokens.weight":
+        return ("embed", "embedding")
+    if name == "norm.weight":
+        return ("final_norm",)
+    if name == "lm_head.weight":
+        return ("lm_head", "kernel")
+    if name.startswith("layers."):
+        parts = name.split(".")
+        i = int(parts[1])
+        rest = ".".join(parts[2:])
+        table = {
+            "self_attn.q_proj.weight": ("attn", "q_kernel"),
+            "self_attn.k_proj.weight": ("attn", "k_kernel"),
+            "self_attn.v_proj.weight": ("attn", "v_kernel"),
+            "self_attn.o_proj.weight": ("attn", "o_kernel"),
+            "self_attn.q_proj.bias": ("attn", "q_bias"),
+            "self_attn.k_proj.bias": ("attn", "k_bias"),
+            "self_attn.v_proj.bias": ("attn", "v_bias"),
+            "self_attn.q_norm.weight": ("attn", "q_norm"),
+            "self_attn.k_norm.weight": ("attn", "k_norm"),
+            "mlp.gate_proj.weight": ("mlp", "gate_kernel"),
+            "mlp.up_proj.weight": ("mlp", "up_kernel"),
+            "mlp.down_proj.weight": ("mlp", "down_kernel"),
+            "input_layernorm.weight": ("input_norm",),
+            "post_attention_layernorm.weight": ("post_attn_norm",),
+        }
+        if rest in table:
+            return (f"layers_{i}",) + table[rest]
+    return None
+
+
+def _convert_tensor(path: tuple[str, ...], w: np.ndarray, cfg: ModelConfig) -> np.ndarray:
+    """Torch [out, in] → our einsum layout."""
+    nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    H = cfg.hidden_size
+    leaf = path[-1]
+    if leaf in ("q_kernel", "k_kernel", "v_kernel"):
+        n = nH if leaf == "q_kernel" else nKV
+        return np.ascontiguousarray(w.T).reshape(H, n, hd)
+    if leaf == "o_kernel":
+        return np.ascontiguousarray(w.T).reshape(nH, hd, H)
+    if leaf in ("q_bias",):
+        return w.reshape(nH, hd)
+    if leaf in ("k_bias", "v_bias"):
+        return w.reshape(nKV, hd)
+    if leaf in ("gate_kernel", "up_kernel", "down_kernel", "kernel"):
+        return np.ascontiguousarray(w.T)
+    return w  # norms, embedding
+
+
+def _unconvert_tensor(path: tuple[str, ...], w: np.ndarray, cfg: ModelConfig) -> np.ndarray:
+    """Our layout → torch [out, in]."""
+    H = cfg.hidden_size
+    leaf = path[-1]
+    if leaf in ("q_kernel", "k_kernel", "v_kernel"):
+        return np.ascontiguousarray(w.reshape(H, -1).T)
+    if leaf == "o_kernel":
+        return np.ascontiguousarray(w.reshape(-1, H).T)
+    if leaf in ("q_bias", "k_bias", "v_bias"):
+        return w.reshape(-1)
+    if leaf in ("gate_kernel", "up_kernel", "down_kernel", "kernel"):
+        return np.ascontiguousarray(w.T)
+    return w
+
+
+def load_hf_params(
+    model_dir: str, cfg: ModelConfig, dtype: str | None = None
+) -> dict:
+    """Load an HF checkpoint dir into our param tree (numpy leaves).
+
+    With cfg.scan_layers, per-layer tensors are stacked along axis 0.
+    """
+    dtype = dtype or cfg.param_dtype
+    flat: dict[tuple[str, ...], np.ndarray] = {}
+    for name, w in _iter_hf_tensors(model_dir):
+        path = hf_name_to_ours(name)
+        if path is None:
+            continue
+        flat[path] = _convert_tensor(path, w, cfg)
+
+    return assemble_params(flat, cfg, dtype)
+
+
+def assemble_params(
+    flat: dict[tuple[str, ...], np.ndarray], cfg: ModelConfig, dtype: str
+) -> dict:
+    """Build the (possibly layer-stacked) tree from flat unstacked entries."""
+    out: dict = {}
+
+    def put(tree, path, value):
+        for k in path[:-1]:
+            tree = tree.setdefault(k, {})
+        tree[path[-1]] = value
+
+    cast = lambda x: jnp.asarray(x, dtype=jnp.dtype(dtype))  # noqa: E731
+    if cfg.tie_word_embeddings:
+        flat = {p: w for p, w in flat.items() if p[0] != "lm_head"}
+    if cfg.scan_layers:
+        L = cfg.num_hidden_layers
+        layer_paths = sorted(
+            {p[1:] for p in flat if p[0].startswith("layers_")}
+        )
+        for sub in layer_paths:
+            stacked = np.stack(
+                [flat[(f"layers_{i}",) + sub] for i in range(L)], axis=0
+            )
+            put(out, ("layers",) + sub, cast(stacked))
+        for p, w in flat.items():
+            if not p[0].startswith("layers_"):
+                put(out, p, cast(w))
+    else:
+        for p, w in flat.items():
+            put(out, p, cast(w))
+
+    _validate_against_shapes(out, cfg)
+    return out
+
+
+def _validate_against_shapes(params: dict, cfg: ModelConfig) -> None:
+    expected = param_shapes(cfg)
+
+    def walk(exp, got, path):
+        if isinstance(exp, dict):
+            missing = set(exp) - set(got)
+            extra = set(got) - set(exp)
+            if missing or extra:
+                raise ValueError(
+                    f"param tree mismatch at {'/'.join(path)}: "
+                    f"missing={sorted(missing)} extra={sorted(extra)}"
+                )
+            for k in exp:
+                walk(exp[k], got[k], path + (k,))
+        else:
+            if tuple(got.shape) != tuple(exp):
+                raise ValueError(
+                    f"shape mismatch at {'/'.join(path)}: "
+                    f"expected {exp}, got {tuple(got.shape)}"
+                )
+
+    walk(expected, params, ())
+
+
+def flatten_params(params: dict, cfg: ModelConfig) -> dict[tuple[str, ...], np.ndarray]:
+    """Inverse of assemble_params: unstack scan layers into layers_{i}."""
+    flat: dict[tuple[str, ...], np.ndarray] = {}
+
+    def walk(tree, path):
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(v, path + (k,))
+        else:
+            flat[path] = np.asarray(tree)
+
+    walk(params, ())
+    if cfg.scan_layers:
+        out: dict[tuple[str, ...], np.ndarray] = {}
+        for p, w in flat.items():
+            if p[0] == "layers":
+                for i in range(cfg.num_hidden_layers):
+                    out[(f"layers_{i}",) + p[1:]] = w[i]
+            else:
+                out[p] = w
+        flat = out
+    return flat
+
+
+def ours_name_to_hf(path: tuple[str, ...]) -> str:
+    leaf_table = {
+        ("attn", "q_kernel"): "self_attn.q_proj.weight",
+        ("attn", "k_kernel"): "self_attn.k_proj.weight",
+        ("attn", "v_kernel"): "self_attn.v_proj.weight",
+        ("attn", "o_kernel"): "self_attn.o_proj.weight",
+        ("attn", "q_bias"): "self_attn.q_proj.bias",
+        ("attn", "k_bias"): "self_attn.k_proj.bias",
+        ("attn", "v_bias"): "self_attn.v_proj.bias",
+        ("attn", "q_norm"): "self_attn.q_norm.weight",
+        ("attn", "k_norm"): "self_attn.k_norm.weight",
+        ("mlp", "gate_kernel"): "mlp.gate_proj.weight",
+        ("mlp", "up_kernel"): "mlp.up_proj.weight",
+        ("mlp", "down_kernel"): "mlp.down_proj.weight",
+        ("input_norm",): "input_layernorm.weight",
+        ("post_attn_norm",): "post_attention_layernorm.weight",
+    }
+    if path == ("embed", "embedding"):
+        return "model.embed_tokens.weight"
+    if path == ("final_norm",):
+        return "model.norm.weight"
+    if path == ("lm_head", "kernel"):
+        return "lm_head.weight"
+    if path[0].startswith("layers_"):
+        i = int(path[0].split("_")[1])
+        return f"model.layers.{i}." + leaf_table[path[1:]]
+    raise KeyError(path)
+
+
+def save_hf_params(params: dict, cfg: ModelConfig, out_dir: str) -> str:
+    """Write the param tree as a single HF-format safetensors file +
+    config passthrough. Weights are saved in torch [out, in] layout so any
+    HF consumer (including our decode engine reload path) can read them."""
+    os.makedirs(out_dir, exist_ok=True)
+    flat = flatten_params(params, cfg)
+    tensors = {}
+    for path, w in flat.items():
+        hf_name = ours_name_to_hf(path)
+        arr = _unconvert_tensor(path, np.asarray(w), cfg)
+        # numpy safetensors cannot store bfloat16; upcast for the disk copy
+        if arr.dtype == jnp.bfloat16:
+            arr = arr.astype(np.float32)
+        tensors[hf_name] = np.ascontiguousarray(arr)
+    save_file(tensors, os.path.join(out_dir, "model.safetensors"))
+    return out_dir

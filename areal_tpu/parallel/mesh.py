@@ -1,0 +1,155 @@
+"""Device mesh construction: ParallelStrategy → jax.sharding.Mesh.
+
+This is the TPU replacement for the reference's process-group plumbing
+(realhf/base/topology.py ProcessTopology/ParallelGrid, areal/utils/fsdp/
+parallel.py ParallelHelper.world_mesh): one named mesh, and every
+parallelism dimension becomes sharding annotations over its axes. XLA then
+inserts the collectives (psum/all-gather/reduce-scatter/all-to-all) that the
+reference issues by hand through NCCL.
+
+Axis layout (order matters — later axes vary fastest, i.e. are nearest
+neighbours on the ICI torus):
+
+    ("pp", "dp", "sp", "tp")
+
+- "tp"  innermost: tensor-parallel collectives (per-layer all-reduce /
+  reduce-scatter) are the most latency-sensitive → adjacent chips.
+- "sp"  context/sequence parallelism (ring attention all-to-alls).
+- "dp"  data parallel; parameters are additionally sharded over this axis
+  ZeRO-3-style when fsdp is enabled (the reference's FSDP2 dim).
+- "pp"  outermost: pipeline stages communicate least often.
+
+Expert parallelism folds over ("dp", "sp") — the reference likewise carves
+EP out of the dp×cp ranks (Megatron MoE parallel folding,
+areal/api/alloc_mode.py expert_data_parallel_size).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from areal_tpu.api.alloc_mode import ParallelStrategy
+
+AXIS_PP = "pp"
+AXIS_DP = "dp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+MESH_AXES = (AXIS_PP, AXIS_DP, AXIS_SP, AXIS_TP)
+
+
+def build_mesh(
+    strategy: ParallelStrategy, devices: list | None = None
+) -> Mesh:
+    """Build the named device mesh for a parallel strategy.
+
+    `devices` defaults to all global devices; their count must equal the
+    strategy's world size.
+    """
+    if devices is None:
+        devices = jax.devices()
+    shape = (
+        strategy.pp_size,
+        strategy.dp_size,
+        strategy.cp_size,
+        strategy.tp_size,
+    )
+    world = int(np.prod(shape))
+    if len(devices) != world:
+        raise ValueError(
+            f"strategy world size {world} ({strategy}) != device count "
+            f"{len(devices)}"
+        )
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def strategy_from_mesh(mesh: Mesh) -> ParallelStrategy:
+    """Inverse of build_mesh (for logging / validation)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return ParallelStrategy(
+        pipeline_parallel_size=sizes.get(AXIS_PP, 1),
+        data_parallel_size=sizes.get(AXIS_DP, 1),
+        context_parallel_size=sizes.get(AXIS_SP, 1),
+        tensor_parallel_size=sizes.get(AXIS_TP, 1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Logical-axis sharding rules (t5x/maxtext convention): model code annotates
+# parameters/activations with *logical* axis names; these rules map them to
+# mesh axes. Changing the parallel layout = changing this table, not the
+# model. This one table subsumes the reference's DTensor TP plan
+# (areal/utils/fsdp/parallel.py:255-396), Megatron Column/RowParallelLinear
+# (realhf/impl/model/parallelism/tensor_parallel/modules.py), and Ulysses
+# sequence sharding (areal/utils/ulysses.py).
+# ---------------------------------------------------------------------------
+
+LogicalRules = tuple[tuple[str, str | tuple[str, ...] | None], ...]
+
+# fsdp=True: shard params' largest logical dims over the dp axis (ZeRO-3).
+def default_rules(fsdp: bool = True) -> LogicalRules:
+    fsdp_axis = AXIS_DP if fsdp else None
+    return (
+        # activations
+        ("batch", AXIS_DP),
+        ("seq", AXIS_SP),
+        ("act_embed", None),
+        ("act_heads", AXIS_TP),
+        ("act_kv_heads", AXIS_TP),
+        ("act_mlp", AXIS_TP),
+        ("act_vocab", AXIS_TP),
+        # parameters
+        ("vocab", AXIS_TP),
+        ("embed", fsdp_axis),
+        ("heads", AXIS_TP),
+        ("kv_heads", AXIS_TP),
+        ("head_dim", None),
+        ("mlp", AXIS_TP),
+        ("experts", AXIS_DP),  # EP folds over dp ranks
+        ("layers", None),  # sharded over "pp" only in pipeline mode
+        ("norm", None),
+    )
+
+
+def logical_to_mesh_axes(
+    logical_axes: tuple[str | None, ...], rules: LogicalRules
+) -> PartitionSpec:
+    """Map a tuple of logical axis names to a PartitionSpec via `rules`."""
+    table = dict(rules)
+    out = []
+    used: set[str] = set()
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        axis = table.get(name)
+        # A mesh axis may shard at most one dim of a given array.
+        if axis is not None and axis in used:
+            axis = None
+        if axis is not None:
+            used.add(axis) if isinstance(axis, str) else used.update(axis)
+        out.append(axis)
+    return PartitionSpec(*out)
+
+
+def named_sharding(
+    mesh: Mesh, logical_axes: tuple[str | None, ...], rules: LogicalRules | None = None
+) -> NamedSharding:
+    rules = rules if rules is not None else default_rules()
+    return NamedSharding(mesh, logical_to_mesh_axes(logical_axes, rules))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [B, T, ...] batches: batch over dp, sequence over sp."""
+    return NamedSharding(mesh, PartitionSpec(AXIS_DP, AXIS_SP))
+
+
+def packed_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for packed 1-D token streams: tokens over (dp, sp)."""
+    return NamedSharding(mesh, PartitionSpec((AXIS_DP, AXIS_SP)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

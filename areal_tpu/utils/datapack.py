@@ -1,0 +1,142 @@
+"""Sequence-length-balanced partitioning and bin packing.
+
+Parity target: areal/utils/datapack.py — `ffd_allocate` (first-fit-decreasing
+bin packing under a token budget, :187), `partition_balanced` (:14),
+`min_abs_diff_partition` (:77), `flat2d` (:9). These are host-side numpy
+routines that drive micro-batch splitting and cross-DP rollout
+redistribution; they never run on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "flat2d",
+    "partition_balanced",
+    "min_abs_diff_partition",
+    "ffd_allocate",
+    "reorder_to_balanced_batches",
+]
+
+
+def flat2d(arr: list[list]) -> list:
+    """Flatten one nesting level."""
+    return [x for sub in arr for x in sub]
+
+
+def partition_balanced(nums: np.ndarray, k: int, min_size: int = 1) -> list[list[int]]:
+    """Partition the *ordered* sequence `nums` into `k` contiguous pieces
+    minimising the maximum piece sum (each piece ≥ min_size elements).
+
+    Dynamic programming over prefix sums, O(n²k); n is a micro-batch count so
+    this is cheap. Returns index lists per piece.
+    """
+    nums = np.asarray(nums, dtype=np.int64)
+    n = len(nums)
+    if k <= 0 or n < k * min_size:
+        raise ValueError(f"cannot split {n} items into {k} parts of >= {min_size}")
+    prefix = np.concatenate([[0], np.cumsum(nums)])
+
+    # dp[j][i]: minimal max-sum splitting the first i items into j pieces.
+    INF = float("inf")
+    dp = np.full((k + 1, n + 1), INF)
+    choice = np.zeros((k + 1, n + 1), dtype=np.int64)
+    dp[0][0] = 0.0
+    for j in range(1, k + 1):
+        for i in range(j * min_size, n + 1):
+            # last piece covers (t, i]
+            for t in range((j - 1) * min_size, i - min_size + 1):
+                cand = max(dp[j - 1][t], prefix[i] - prefix[t])
+                if cand < dp[j][i]:
+                    dp[j][i] = cand
+                    choice[j][i] = t
+    # reconstruct
+    bounds = [n]
+    i = n
+    for j in range(k, 0, -1):
+        i = int(choice[j][i])
+        bounds.append(i)
+    bounds.reverse()
+    return [list(range(bounds[j], bounds[j + 1])) for j in range(k)]
+
+
+def min_abs_diff_partition(nums: np.ndarray, k: int) -> list[tuple[int, int]]:
+    """Split ordered `nums` into `k` contiguous spans with minimal max-sum;
+    returns (start, end) bounds per span (parity: datapack.py:77)."""
+    parts = partition_balanced(np.asarray(nums), k)
+    return [(p[0], p[-1] + 1) for p in parts]
+
+
+def ffd_allocate(
+    values: list[int], capacity: int, min_groups: int = 1
+) -> list[list[int]]:
+    """First-fit-decreasing bin packing: group indices of `values` into bins
+    whose sums stay ≤ capacity, producing at least `min_groups` bins.
+
+    The workhorse behind micro-batch allocation and cross-DP rebalancing
+    (parity: datapack.py:187). Items larger than `capacity` get singleton
+    bins (the caller is expected to have filtered or to accept overflow).
+    """
+    values = list(values)
+    if capacity <= 0:
+        raise ValueError("capacity must be positive")
+    order = sorted(range(len(values)), key=lambda i: values[i], reverse=True)
+    bins: list[list[int]] = []
+    bin_sums: list[int] = []
+    for idx in order:
+        v = values[idx]
+        placed = False
+        for b in range(len(bins)):
+            if bin_sums[b] + v <= capacity:
+                bins[b].append(idx)
+                bin_sums[b] += v
+                placed = True
+                break
+        if not placed:
+            bins.append([idx])
+            bin_sums.append(v)
+    # Meet the minimum group count by splitting the largest bins.
+    while len(bins) < min_groups:
+        # pick the bin with most items that can be split
+        cand = max(
+            (b for b in range(len(bins)) if len(bins[b]) > 1),
+            key=lambda b: bin_sums[b],
+            default=None,
+        )
+        if cand is None:
+            # all singletons; pad with empty bins
+            bins.append([])
+            bin_sums.append(0)
+            continue
+        items = bins[cand]
+        half = len(items) // 2
+        bins[cand] = items[:half]
+        bin_sums[cand] = sum(values[i] for i in items[:half])
+        bins.append(items[half:])
+        bin_sums.append(sum(values[i] for i in items[half:]))
+    # Keep deterministic order: sort each bin's indices, sort bins by first idx.
+    bins = [sorted(b) for b in bins]
+    bins.sort(key=lambda b: (b[0] if b else 1 << 60))
+    return bins
+
+
+def reorder_to_balanced_batches(
+    seqlens: np.ndarray, batch_size_per_chunk: int
+) -> list[list[int]]:
+    """Greedy longest-first round-robin into fixed-size chunks so each chunk
+    has a similar token total (parity: datapack.py:117)."""
+    order = np.argsort(-np.asarray(seqlens))
+    n_chunks = int(np.ceil(len(order) / batch_size_per_chunk))
+    chunks: list[list[int]] = [[] for _ in range(n_chunks)]
+    sums = np.zeros(n_chunks, dtype=np.int64)
+    for idx in order:
+        # place into the least-loaded chunk with room
+        cand = None
+        for c in np.argsort(sums):
+            if len(chunks[c]) < batch_size_per_chunk:
+                cand = int(c)
+                break
+        chunks[cand].append(int(idx))
+        sums[cand] += seqlens[idx]
+    return [sorted(c) for c in chunks if c]

@@ -1,0 +1,264 @@
+"""JAX loss/probability library for PPO/GRPO/SFT.
+
+Parity target: areal/utils/functional.py — gather_logprobs[_entropy] (:43,:84),
+masked_normalization (:131), ppo_actor_loss_fn with decoupled behav/proximal
+logp (:171), ppo_critic_loss_fn (:247), dynamic_sampling (:314),
+reward_overlong_penalty (:376).
+
+TPU-first notes
+---------------
+- Device functions are pure jax.numpy and jit-safe: no data-dependent Python
+  control flow, static shapes, everything fuses into the surrounding step.
+- The reference chunks its log-softmax to bound CUDA memory; under XLA the
+  [T, V] log-softmax + gather fuses with the logits matmul epilogue, so no
+  manual chunking is needed (and would only hurt fusion).
+- Under pjit/GSPMD with a fully-specified batch sharding, jnp reductions are
+  *global* — the reference's explicit dist.all_reduce disappears into the
+  compiler-inserted psum along the mesh's dp axis.
+- Host functions (dynamic_sampling, reward shaping) stay numpy: they make
+  data-dependent shape decisions, which must happen outside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "gather_logprobs",
+    "gather_logprobs_entropy",
+    "masked_normalization",
+    "ppo_actor_loss_fn",
+    "ppo_critic_loss_fn",
+    "dynamic_sampling",
+    "reward_overlong_penalty",
+]
+
+
+def gather_logprobs(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """log p(labels) from raw logits; [T, V] + [T] → [T] (float32).
+
+    Computed in float32 regardless of logits dtype — bf16 log-softmax loses
+    ~2 decimal digits which is fatal for importance ratios.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gathered = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return gathered - logz
+
+
+def gather_logprobs_entropy(
+    logits: jax.Array, labels: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """(log p(labels), entropy) in one pass; shares the logsumexp."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    logprobs_all = logits - logz[..., None]
+    probs = jnp.exp(logprobs_all)
+    entropy = -jnp.sum(probs * logprobs_all, axis=-1)
+    gathered = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return gathered - logz, entropy
+
+
+def masked_normalization(
+    x: jax.Array,
+    mask: jax.Array | None = None,
+    dim=None,
+    unbiased: bool = False,
+    eps: float = 1e-5,
+    high_precision: bool = True,
+) -> jax.Array:
+    """Zero-mean unit-var normalization over masked elements (functional.py:131).
+
+    Under pjit the reductions are global across the mesh automatically; no
+    explicit all_reduce parameter is needed.
+    """
+    dtype = jnp.float64 if (high_precision and jax.config.jax_enable_x64) else jnp.float32
+    x = x.astype(dtype)
+    if dim is None:
+        dim = tuple(range(x.ndim))
+    if mask is None:
+        factor = jnp.asarray(np.prod([x.shape[d] for d in dim]), dtype=dtype)
+    else:
+        mask = mask.astype(dtype)
+        x = x * mask
+        factor = mask.sum(axis=dim, keepdims=True)
+    x_sum = x.sum(axis=dim, keepdims=True)
+    x_sum_sq = (x**2).sum(axis=dim, keepdims=True)
+    mean = x_sum / factor
+    var = x_sum_sq / factor - mean**2
+    var = jnp.where(unbiased, var * factor / jnp.maximum(factor - 1, 1), var)
+    return ((x - mean) / (jnp.sqrt(jnp.maximum(var, 0.0)) + eps)).astype(jnp.float32)
+
+
+def ppo_actor_loss_fn(
+    logprobs: jax.Array,
+    proximal_logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    advantages: jax.Array,
+    eps_clip: float,
+    loss_mask: jax.Array,
+    eps_clip_higher: float | None = None,
+    c_clip: float | None = None,
+    behav_imp_weight_cap: float | None = None,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Clipped-ratio PPO policy loss with the decoupled-PPO split
+    (functional.py:171-237; AReaL blog "boba²" decoupled objective).
+
+    Roles of the three logprob streams:
+    - `logprobs`           π_θ  — current policy (differentiated)
+    - `proximal_logprobs`  π_prox — the proximal policy (recomputed at the
+      start of the update); equals old_logprobs in on-policy mode
+    - `old_logprobs`       π_behav — the behavior policy that generated the
+      tokens (inference engine, possibly stale)
+
+    The clipped ratio is taken against π_prox; a truncated importance weight
+    exp(π_prox − π_behav), optionally capped, corrects for staleness.
+    """
+    loss_mask = loss_mask.astype(bool)
+    loss_mask_count = jnp.maximum(loss_mask.sum(), 1)
+    ratio = jnp.where(loss_mask, jnp.exp(logprobs - proximal_logprobs), 0.0)
+
+    upper = eps_clip if eps_clip_higher is None else eps_clip_higher
+    clipped_ratio = jnp.clip(ratio, 1.0 - eps_clip, 1.0 + upper)
+
+    pg_loss1 = -advantages * ratio
+    pg_loss2 = -advantages * clipped_ratio
+    clip_mask = pg_loss1 < pg_loss2
+    pg_loss = jnp.maximum(pg_loss1, pg_loss2)
+    if c_clip is not None:
+        assert c_clip > 1.0, c_clip
+        pg_loss3 = jnp.sign(advantages) * c_clip * advantages
+        dual_clip_mask = pg_loss3 < pg_loss
+        pg_loss = jnp.minimum(pg_loss, pg_loss3)
+    else:
+        dual_clip_mask = jnp.zeros_like(clip_mask)
+
+    behav_kl = proximal_logprobs - old_logprobs
+    behav_imp_weight = jnp.exp(behav_kl)
+    if behav_imp_weight_cap is not None:
+        behav_mask = (behav_imp_weight <= behav_imp_weight_cap) & loss_mask
+    else:
+        behav_mask = loss_mask
+    behav_kl = jnp.where(behav_mask, behav_kl, 0.0)
+    behav_imp_weight = jnp.where(behav_mask, behav_imp_weight, 0.0)
+    # The behavior importance weight is a correction factor, not a gradient
+    # path: stop_gradient matches the reference where it is computed from two
+    # non-differentiated streams.
+    pg_loss = pg_loss * jax.lax.stop_gradient(behav_imp_weight)
+
+    logging_loss = jax.lax.stop_gradient(pg_loss)
+    pg_loss = jnp.where(loss_mask, pg_loss, 0.0).sum() / loss_mask_count
+    stat = dict(
+        loss=logging_loss,
+        importance_weight=jax.lax.stop_gradient(ratio),
+        approx_kl=jax.lax.stop_gradient(logprobs - proximal_logprobs),
+        clip_mask=clip_mask & loss_mask,
+        dual_clip_mask=dual_clip_mask & loss_mask,
+        behave_imp_weight=behav_imp_weight,
+        behave_approx_kl=behav_kl,
+        behave_mask=behav_mask,
+    )
+    return pg_loss, stat
+
+
+def _huber_loss(x, y, delta: float = 10.0):
+    diff = jnp.abs(x - y)
+    return jnp.where(diff < delta, 0.5 * diff**2, delta * (diff - 0.5 * delta))
+
+
+def _mse_loss(x, y):
+    return 0.5 * (x - y) ** 2
+
+
+def ppo_critic_loss_fn(
+    value: jax.Array,
+    old_value: jax.Array,
+    target_value: jax.Array,
+    value_eps_clip: float,
+    loss_mask: jax.Array | None = None,
+    loss_fn_type: str = "mse",
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Value-clipped critic loss (functional.py:247)."""
+    if loss_fn_type == "huber":
+        loss_fn = _huber_loss
+    elif loss_fn_type == "mse":
+        loss_fn = _mse_loss
+    else:
+        raise NotImplementedError(f"unknown loss fn type {loss_fn_type}")
+
+    loss_orig = loss_fn(value, target_value)
+    value_clipped = old_value + jnp.clip(
+        value - old_value, -value_eps_clip, value_eps_clip
+    )
+    loss_clip = loss_fn(value_clipped, target_value)
+    value_loss = jnp.maximum(loss_orig, loss_clip)
+
+    clip_mask = jax.lax.stop_gradient(loss_clip > loss_orig)
+    if loss_mask is not None:
+        loss_mask = loss_mask.astype(bool)
+        clip_mask = clip_mask & loss_mask
+        value_loss = (
+            jnp.where(loss_mask, value_loss, 0.0).sum()
+            / jnp.maximum(loss_mask.sum(), 1)
+        )
+    else:
+        value_loss = value_loss.mean()
+    stat = dict(clip_mask=clip_mask, loss=jax.lax.stop_gradient(value_loss))
+    return value_loss, stat
+
+
+# ---------------------------------------------------------------------------
+# Host-side (data-dependent shapes — must stay out of jit)
+# ---------------------------------------------------------------------------
+
+
+def dynamic_sampling(
+    data: dict[str, Any], group_size: int
+) -> tuple[dict[str, Any], dict[str, int]]:
+    """Drop GRPO groups whose rewards are all equal — they carry zero
+    advantage signal (functional.py:314; DAPO). Host-side: changes the batch
+    size, so it must run before device dispatch."""
+    rewards = np.asarray(data["rewards"])
+    batch_size = rewards.shape[0]
+    if group_size <= 0:
+        return data, dict(n_group_kept=0, n_group_filtered=0)
+    if batch_size % group_size != 0:
+        return data, dict(n_group_kept=batch_size // group_size, n_group_filtered=0)
+    num_groups = batch_size // group_size
+    grouped = rewards.reshape(num_groups, group_size)
+    all_equal = (grouped == grouped[:, :1]).all(axis=1)
+    valid = ~all_equal
+    mask = np.repeat(valid, group_size)
+    if not mask.any():
+        return data, dict(n_group_kept=0, n_group_filtered=num_groups)
+    n_kept = int(valid.sum())
+    filtered = {}
+    for k, v in data.items():
+        arr = np.asarray(v) if not isinstance(v, np.ndarray) else v
+        if isinstance(v, (np.ndarray, list)) and getattr(arr, "shape", ())[:1] == (batch_size,):
+            filtered[k] = arr[mask]
+        else:
+            filtered[k] = v
+    return filtered, dict(n_group_kept=n_kept, n_group_filtered=num_groups - n_kept)
+
+
+def reward_overlong_penalty(
+    data: dict[str, Any],
+    overlong_tokens: int,
+    overlong_penalty_factor: float,
+    max_response_length: int,
+) -> dict[str, Any]:
+    """DAPO soft overlong penalty: linearly penalise responses that enter the
+    last `overlong_tokens` of the budget (functional.py:376). Vectorised."""
+    rewards = np.asarray(data["rewards"], dtype=np.float32).copy()
+    response_lengths = np.asarray(data["loss_mask"]).sum(axis=-1).astype(np.int64)
+    expected_len = max_response_length - overlong_tokens
+    exceed = response_lengths - expected_len
+    penalty = np.minimum(-exceed / overlong_tokens * overlong_penalty_factor, 0.0)
+    data = dict(data)
+    data["rewards"] = rewards + penalty.astype(np.float32)
+    return data

@@ -5,25 +5,37 @@ All tests run on CPU with 8 virtual XLA devices so multi-chip shardings
 of the reference's StandaloneTestingProcess multi-rank-on-one-GPU pattern
 (realhf/base/testing.py:37-120).
 
-Must set env vars BEFORE jax is imported anywhere.
+Gotcha: the ambient environment runs an `axon` sitecustomize that calls
+`jax.config.update("jax_platforms", "axon,cpu")` at interpreter start,
+pointing jax at the real-TPU relay. Merely setting JAX_PLATFORMS=cpu is NOT
+enough — we must update the jax config back before any backend initialises,
+or tests hang dialing the tunnel.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Keep subprocesses (launcher tests) clean too.
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":") if ".axon_site" not in p
+)
+sys.path = [p for p in sys.path if ".axon_site" not in p]
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def cpu_devices():
-    import jax
-
     devices = jax.devices()
     assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
     return devices

@@ -1,0 +1,135 @@
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import MicroBatchSpec, NormConfig
+from areal_tpu.utils.data import (
+    KLEstimator,
+    Normalization,
+    amend_position_ids,
+    concat_padded_tensors,
+    pack_tensor_dict,
+    pad_packed_tensor_dict,
+    pad_sequences_to_tensors,
+    split_padded_tensor_dict_into_mb_list,
+    unpack_sequence,
+)
+
+
+def _mk_batch(lens, max_len=None):
+    seqs = [dict(input_ids=np.arange(n) + 1, rewards=np.float32(n)) for n in lens]
+    return pad_sequences_to_tensors(seqs)
+
+
+def test_pad_sequences():
+    b = _mk_batch([3, 5])
+    assert b["input_ids"].shape == (2, 5)
+    assert b["attention_mask"].sum() == 8
+    assert b["rewards"].shape == (2,)
+
+
+def test_concat_padded_repads():
+    b1 = _mk_batch([3])
+    b2 = _mk_batch([6])
+    out = concat_padded_tensors([b1, b2])
+    assert out["input_ids"].shape == (2, 6)
+    assert out["attention_mask"][0].sum() == 3
+    assert out["attention_mask"][1].sum() == 6
+
+
+def test_pack_unpack_roundtrip():
+    b = _mk_batch([3, 5, 2])
+    packed = pack_tensor_dict(b)
+    assert packed["input_ids"].shape == (10,)
+    assert list(packed["cu_seqlens"]) == [0, 3, 8, 10]
+    assert packed["max_seqlen"] == 5
+    seqs = unpack_sequence(packed["input_ids"], packed["cu_seqlens"])
+    assert [len(s) for s in seqs] == [3, 5, 2]
+    np.testing.assert_array_equal(seqs[0], [1, 2, 3])
+
+
+def test_pad_packed_bucketing():
+    b = _mk_batch([3, 5])
+    packed = pack_tensor_dict(b)
+    padded, pad_len = pad_packed_tensor_dict(packed, pad_to_multiple=16)
+    assert padded["input_ids"].shape == (16,)
+    assert pad_len == 8
+    # fake tail sequence appended
+    assert list(padded["cu_seqlens"]) == [0, 3, 8, 16]
+
+
+def test_amend_position_ids():
+    b = _mk_batch([3, 2])
+    packed = pack_tensor_dict(b)
+    packed = amend_position_ids(packed)
+    np.testing.assert_array_equal(packed["position_ids"], [0, 1, 2, 0, 1])
+
+
+def test_split_into_mbs_covers_batch():
+    b = _mk_batch([3, 5, 2, 7, 1, 4])
+    mbl = split_padded_tensor_dict_into_mb_list(
+        b, MicroBatchSpec(max_tokens_per_mb=10, n_mbs=None), pad_to_multiple=8
+    )
+    all_idx = sorted(i for idx in mbl.forward_indices for i in idx)
+    assert all_idx == list(range(6))
+    for mb in mbl.mbs:
+        # each mb padded to multiple of 8 and within budget before padding
+        assert mb["input_ids"].shape[0] % 8 == 0
+
+
+def test_split_respects_granularity():
+    b = _mk_batch([3, 5, 2, 7])
+    mbl = split_padded_tensor_dict_into_mb_list(
+        b, MicroBatchSpec(max_tokens_per_mb=9, granularity=2), pad_to_multiple=8
+    )
+    for idx in mbl.forward_indices:
+        # groups of 2 adjacent samples stay together
+        assert all(idx[i + 1] == idx[i] + 1 for i in range(0, len(idx) - 1, 2))
+
+
+def test_normalization_batch():
+    norm = Normalization(NormConfig(mean_level="batch", std_level="batch"))
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    out = norm(x)
+    assert abs(out.mean()) < 1e-5
+    assert abs(out.std() - 1.0) < 0.01
+
+
+def test_normalization_group():
+    norm = Normalization(
+        NormConfig(mean_level="group", std_level="group", group_size=2)
+    )
+    x = np.array([[1.0], [3.0], [10.0], [20.0]])
+    out = norm(x)
+    # each group centered independently
+    assert abs(out[:2].mean()) < 1e-6
+    assert abs(out[2:].mean()) < 1e-6
+
+
+def test_normalization_leave1out():
+    norm = Normalization(
+        NormConfig(mean_level="group", mean_leave1out=True, std_level=None, group_size=2)
+    )
+    x = np.array([[1.0], [3.0]])
+    out = norm(x)
+    # leave-one-out mean of sample0 is 3 -> 1-3 = -2; sample1: 3-1 = 2
+    np.testing.assert_allclose(out.flatten(), [-2.0, 2.0])
+
+
+def test_normalization_masked_all_zero():
+    norm = Normalization(NormConfig())
+    x = np.array([[5.0, 5.0]])
+    out = norm(x, loss_mask=np.zeros_like(x))
+    np.testing.assert_array_equal(out, x)
+
+
+def test_kl_estimators():
+    lp = np.array([0.0, -1.0])
+    lp_base = np.array([-1.0, -1.0])
+    k1 = KLEstimator("k1")(lp, lp_base)
+    np.testing.assert_allclose(k1, [1.0, 0.0])
+    k2 = KLEstimator("k2")(lp, lp_base)
+    np.testing.assert_allclose(k2, [0.5, 0.0])
+    k3 = KLEstimator("k3")(lp, lp_base)
+    np.testing.assert_allclose(k3, [np.exp(-1) - 1 + 1, 0.0])
+    with pytest.raises(ValueError):
+        KLEstimator("k9")

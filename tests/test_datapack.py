@@ -1,0 +1,60 @@
+import numpy as np
+import pytest
+
+from areal_tpu.utils.datapack import (
+    ffd_allocate,
+    flat2d,
+    min_abs_diff_partition,
+    partition_balanced,
+    reorder_to_balanced_batches,
+)
+
+
+def test_flat2d():
+    assert flat2d([[1, 2], [3], []]) == [1, 2, 3]
+
+
+def test_partition_balanced_covers_all():
+    nums = np.array([5, 1, 1, 1, 5, 1, 1, 1])
+    parts = partition_balanced(nums, 4)
+    assert sorted(flat2d(parts)) == list(range(8))
+    sums = [sum(nums[i] for i in p) for p in parts]
+    assert max(sums) <= 6  # optimal max-sum
+
+
+def test_partition_balanced_min_size():
+    with pytest.raises(ValueError):
+        partition_balanced(np.array([1, 2]), 3)
+
+
+def test_min_abs_diff_partition_bounds():
+    bounds = min_abs_diff_partition(np.array([1, 1, 1, 1]), 2)
+    assert bounds == [(0, 2), (2, 4)]
+
+
+def test_ffd_respects_capacity():
+    values = [30, 20, 20, 10, 10, 10]
+    bins = ffd_allocate(values, capacity=40)
+    assert sorted(flat2d(bins)) == list(range(6))
+    for b in bins:
+        assert sum(values[i] for i in b) <= 40
+
+
+def test_ffd_min_groups():
+    bins = ffd_allocate([1, 1, 1, 1], capacity=100, min_groups=2)
+    assert len(bins) >= 2
+    assert sorted(flat2d(bins)) == [0, 1, 2, 3]
+
+
+def test_ffd_oversized_item_gets_own_bin():
+    bins = ffd_allocate([100, 1], capacity=50)
+    big_bin = [b for b in bins if 0 in b][0]
+    assert big_bin == [0]
+
+
+def test_reorder_to_balanced_batches():
+    seqlens = np.array([100, 1, 1, 100, 50, 50])
+    chunks = reorder_to_balanced_batches(seqlens, batch_size_per_chunk=2)
+    assert sorted(flat2d(chunks)) == list(range(6))
+    sums = [sum(int(seqlens[i]) for i in c) for c in chunks]
+    assert max(sums) - min(sums) <= 100

@@ -1,0 +1,147 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.utils.functional import (
+    dynamic_sampling,
+    gather_logprobs,
+    gather_logprobs_entropy,
+    masked_normalization,
+    ppo_actor_loss_fn,
+    ppo_critic_loss_fn,
+    reward_overlong_penalty,
+)
+
+
+def test_gather_logprobs_matches_manual():
+    key = jax.random.PRNGKey(0)
+    logits = jax.random.normal(key, (5, 11))
+    labels = jnp.array([0, 3, 5, 10, 1])
+    lp = gather_logprobs(logits, labels)
+    ref = jax.nn.log_softmax(logits, axis=-1)[jnp.arange(5), labels]
+    np.testing.assert_allclose(np.asarray(lp), np.asarray(ref), rtol=1e-5)
+
+
+def test_gather_logprobs_entropy():
+    logits = jnp.zeros((3, 4))  # uniform
+    labels = jnp.array([0, 1, 2])
+    lp, ent = gather_logprobs_entropy(logits, labels)
+    np.testing.assert_allclose(np.asarray(lp), np.log(1 / 4) * np.ones(3), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ent), np.log(4) * np.ones(3), rtol=1e-6)
+
+
+def test_masked_normalization():
+    x = jnp.array([1.0, 2.0, 3.0, 100.0])
+    mask = jnp.array([1.0, 1.0, 1.0, 0.0])
+    out = masked_normalization(x, mask)
+    masked_vals = np.asarray(out)[:3]
+    assert abs(masked_vals.mean()) < 1e-4
+
+
+def test_ppo_loss_onpolicy_equals_pg():
+    # on-policy: logprobs == proximal == old -> ratio 1, loss = -mean(adv)
+    lp = jnp.array([-1.0, -2.0, -3.0])
+    adv = jnp.array([1.0, -1.0, 0.5])
+    mask = jnp.ones(3)
+    loss, stat = ppo_actor_loss_fn(lp, lp, lp, adv, 0.2, mask)
+    np.testing.assert_allclose(float(loss), -float(adv.mean()), rtol=1e-6)
+    assert not bool(stat["clip_mask"].any())
+
+
+def test_ppo_loss_clipping_engages():
+    old = jnp.array([-1.0])
+    new = old + 1.0  # ratio e > 1.2
+    adv = jnp.array([1.0])
+    mask = jnp.ones(1)
+    loss, stat = ppo_actor_loss_fn(new, old, old, adv, 0.2, mask)
+    # clipped at 1.2: loss = -1.2 * adv
+    np.testing.assert_allclose(float(loss), -1.2, rtol=1e-6)
+
+
+def test_ppo_loss_decoupled_behav_weight():
+    # proximal != old: behav importance weight multiplies the loss
+    prox = jnp.array([-1.0])
+    old = jnp.array([-1.5])
+    new = prox  # ratio vs prox = 1
+    adv = jnp.array([1.0])
+    mask = jnp.ones(1)
+    loss, stat = ppo_actor_loss_fn(new, prox, old, adv, 0.2, mask)
+    w = float(jnp.exp(prox - old)[0])
+    np.testing.assert_allclose(float(loss), -w, rtol=1e-6)
+    # cap below w -> token masked out of behav weighting
+    loss_capped, stat2 = ppo_actor_loss_fn(
+        new, prox, old, adv, 0.2, mask, behav_imp_weight_cap=1.1
+    )
+    np.testing.assert_allclose(float(loss_capped), 0.0, atol=1e-7)
+
+
+def test_ppo_loss_dual_clip():
+    old = jnp.array([-1.0])
+    new = old + 2.0  # ratio e^2 ≈ 7.4 > c_clip
+    adv = jnp.array([-2.0])  # negative advantage
+    mask = jnp.ones(1)
+    loss_noclip, _ = ppo_actor_loss_fn(new, old, old, adv, 0.2, mask)
+    loss_cclip, stat = ppo_actor_loss_fn(new, old, old, adv, 0.2, mask, c_clip=3.0)
+    # dual clip bounds the loss magnitude for negative advantages
+    assert float(loss_cclip) <= float(loss_noclip)
+    assert bool(stat["dual_clip_mask"].any())
+
+
+def test_ppo_loss_gradient_flows():
+    def f(lp):
+        loss, _ = ppo_actor_loss_fn(
+            lp, jnp.zeros(2), jnp.zeros(2), jnp.ones(2), 0.2, jnp.ones(2)
+        )
+        return loss
+
+    g = jax.grad(f)(jnp.zeros(2))
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert np.any(np.asarray(g) != 0)
+
+
+def test_critic_loss_clip():
+    v = jnp.array([2.0])
+    old_v = jnp.array([0.0])
+    target = jnp.array([0.0])
+    loss, stat = ppo_critic_loss_fn(v, old_v, target, value_eps_clip=0.5)
+    # clipped value = 0.5 -> clipped loss = 0.125; orig = 2.0 -> max = 2.0
+    np.testing.assert_allclose(float(loss), 2.0, rtol=1e-6)
+
+
+def test_dynamic_sampling_filters_uniform_groups():
+    data = dict(
+        rewards=np.array([1.0, 1.0, 0.0, 1.0]),
+        input_ids=np.arange(4 * 3).reshape(4, 3),
+        meta="keep",
+    )
+    out, stats = dynamic_sampling(data, group_size=2)
+    assert stats == dict(n_group_kept=1, n_group_filtered=1)
+    assert out["rewards"].shape == (2,)
+    np.testing.assert_array_equal(out["rewards"], [0.0, 1.0])
+    assert out["input_ids"].shape == (2, 3)
+    assert out["meta"] == "keep"
+
+
+def test_dynamic_sampling_all_filtered_returns_original():
+    data = dict(rewards=np.array([1.0, 1.0]))
+    out, stats = dynamic_sampling(data, group_size=2)
+    assert out["rewards"].shape == (2,)
+    assert stats["n_group_filtered"] == 1
+
+
+def test_reward_overlong_penalty():
+    loss_mask = np.zeros((2, 98), dtype=np.int32)
+    loss_mask[0, :10] = 1
+    loss_mask[1, :] = 1
+    data = dict(
+        rewards=np.array([1.0, 1.0], dtype=np.float32),
+        loss_mask=loss_mask,
+    )
+    out = reward_overlong_penalty(
+        data, overlong_tokens=20, overlong_penalty_factor=1.0, max_response_length=100
+    )
+    assert out["rewards"][0] == pytest.approx(1.0)  # within budget
+    # second: exceeds (100-20)=80 by 18 -> penalty -18/20
+    assert out["rewards"][1] == pytest.approx(1.0 - 18 / 20)
