@@ -1,0 +1,85 @@
+"""Supervised fine-tuning engine (parity: areal/engine/sft/lm_engine.py:13).
+
+`compute_packed_sft_loss` is the packed-causal-LM objective: token t predicts
+token t+1 within the same segment; `loss_mask` selects answer tokens. The
+loss is per-micro-batch normalised; `train_lm` feeds `train_batch` with the
+token count as loss weight so normalisation is global across micro-batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from areal_tpu.engine.jax_engine import JaxTrainEngine
+from areal_tpu.utils import stats_tracker
+from areal_tpu.utils.functional import gather_logprobs
+
+
+def compute_packed_sft_loss(logits: jax.Array, mb: dict[str, Any]) -> jax.Array:
+    """Next-token NLL over packed segments.
+
+    Valid positions t: same segment as t+1 AND loss_mask[t+1] == 1 (the
+    label token is a trainable answer token). The final position of each
+    segment has no next token and is masked out.
+    """
+    input_ids = mb["input_ids"]
+    seg = mb["segment_ids"]
+    loss_mask = mb["loss_mask"].astype(bool)
+    labels = jnp.roll(input_ids, -1)
+    same_seg = jnp.roll(seg, -1) == seg
+    # position t is trained iff its LABEL (t+1) is a loss token
+    valid = same_seg & jnp.roll(loss_mask, -1)
+    logprobs = gather_logprobs(logits, labels)
+    n = jnp.maximum(valid.sum(), 1)
+    return -jnp.where(valid, logprobs, 0.0).sum() / n
+
+
+def sft_loss_weight(mb: dict[str, Any]) -> float:
+    """Number of trained tokens in the micro-batch (for global norm).
+
+    Called on the host-side packed dict (before the engine adds
+    segment_ids), so segments are derived from cu_seqlens.
+    """
+    if "segment_ids" in mb:
+        seg = np.asarray(mb["segment_ids"])
+    else:
+        from areal_tpu.models.qwen2 import segment_ids_from_cu_seqlens
+
+        cu = np.asarray(mb["cu_seqlens"])
+        seg = segment_ids_from_cu_seqlens(cu, int(cu[-1]))
+    mask = np.asarray(mb["loss_mask"]).astype(bool)
+    same_seg = np.roll(seg, -1) == seg
+    return float((same_seg & np.roll(mask, -1)).sum())
+
+
+class LMEngine:
+    """Thin SFT wrapper over a TrainEngine (parity: lm_engine.py:13)."""
+
+    def __init__(self, engine: JaxTrainEngine):
+        self.engine = engine
+
+    def train_lm(self, data: dict[str, Any]) -> dict[str, float]:
+        stats = self.engine.train_batch(
+            data, compute_packed_sft_loss, sft_loss_weight
+        )
+        stats_tracker.scalar(**{f"sft/{k}": v for k, v in stats.items()})
+        return stats
+
+    def evaluate_lm(self, data: dict[str, Any]) -> float:
+        return self.engine.eval_batch(
+            data, compute_packed_sft_loss, sft_loss_weight
+        )
+
+
+class JaxLMEngine(JaxTrainEngine):
+    """TrainEngine with SFT convenience methods (parity: FSDPLMEngine)."""
+
+    def train_lm(self, data: dict[str, Any]) -> dict[str, float]:
+        return LMEngine(self).train_lm(data)
+
+    def evaluate_lm(self, data: dict[str, Any]) -> float:
+        return LMEngine(self).evaluate_lm(data)
