@@ -1,0 +1,27 @@
+"""Rollout workflow contract (parity: areal/api/workflow_api.py:11).
+
+A workflow is one agentic episode: given an inference engine and one dataset
+item, produce a training trajectory (padded dict-of-arrays with batch dim =
+number of samples, e.g. a GRPO group) or None to reject the episode.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:
+    from areal_tpu.api.engine_api import InferenceEngine
+
+
+class RolloutWorkflow(abc.ABC):
+    @abc.abstractmethod
+    async def arun_episode(
+        self, engine: "InferenceEngine", data: dict[str, Any]
+    ) -> dict[str, Any] | None:
+        """Run one episode; return a padded trajectory batch or None.
+
+        Returning None marks the episode rejected (filtered out); the
+        executor decrements running without incrementing accepted.
+        """
+        raise NotImplementedError()

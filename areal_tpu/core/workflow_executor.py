@@ -1,0 +1,261 @@
+"""WorkflowExecutor: the asynchronous rollout pipeline driver.
+
+Parity target: areal/core/workflow_executor.py:218 — submits workflow
+episodes to the AsyncTaskRunner under StalenessManager capacity control,
+validates trajectory format, applies `should_accept` filtering, and
+assembles accepted trajectories into padded training batches.
+`prepare_batch` keeps ≥ 2 training batches in flight (workflow_executor.py:
+561-598) so the trainer never starves while staleness permits.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import time
+from typing import TYPE_CHECKING, Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.cli_args import InferenceEngineConfig
+from areal_tpu.core.async_task_runner import AsyncTaskRunner, TaskResult
+from areal_tpu.core.staleness_manager import StalenessManager
+from areal_tpu.utils import logging, stats_tracker
+from areal_tpu.utils.data import concat_padded_tensors, cycle_dataloader
+
+if TYPE_CHECKING:
+    from areal_tpu.api.engine_api import InferenceEngine
+    from areal_tpu.api.workflow_api import RolloutWorkflow
+
+logger = logging.getLogger("workflow_executor")
+
+
+ROLLOUT_POLL_WAIT_TIME = 0.4
+
+
+def check_trajectory_format(traj: dict[str, Any]) -> None:
+    """Validate a workflow result batch (parity: workflow_executor.py:27).
+
+    Requirements: dict of numpy arrays with a leading batch dim shared by
+    all array values; must contain `attention_mask` and `input_ids` with
+    matching [B, T] shapes.
+    """
+    if not isinstance(traj, dict) or not traj:
+        raise ValueError(f"trajectory must be a non-empty dict, got {type(traj)}")
+    if "input_ids" not in traj or "attention_mask" not in traj:
+        raise ValueError(
+            f"trajectory must contain input_ids and attention_mask, got "
+            f"{sorted(traj.keys())}"
+        )
+    ii, am = np.asarray(traj["input_ids"]), np.asarray(traj["attention_mask"])
+    if ii.ndim != 2 or am.shape != ii.shape:
+        raise ValueError(
+            f"input_ids/attention_mask must be matching [B, T], got "
+            f"{ii.shape} vs {am.shape}"
+        )
+    bs = ii.shape[0]
+    for k, v in traj.items():
+        arr = np.asarray(v)
+        if arr.ndim >= 1 and arr.shape[0] != bs:
+            raise ValueError(
+                f"trajectory key {k!r} batch dim {arr.shape[0]} != {bs}"
+            )
+
+
+class WorkflowExecutor:
+    def __init__(
+        self,
+        config: InferenceEngineConfig,
+        inference_engine: "InferenceEngine",
+    ):
+        self.config = config
+        self.engine = inference_engine
+        qsize = config.queue_size or 4096
+        self.runner = AsyncTaskRunner(queue_size=qsize, name="rollout")
+        max_concurrent = config.max_concurrent_rollouts or 64
+        self.staleness_manager = StalenessManager(
+            max_concurrent_rollouts=max_concurrent,
+            consumer_batch_size=config.consumer_batch_size,
+            max_staleness=config.max_head_offpolicyness,
+        )
+        # submissions deferred until staleness capacity admits them
+        self._pending_inputs: queue.Queue = queue.Queue(maxsize=qsize)
+        self._result_cache: list[dict[str, Any]] = []
+        self._data_generator = None
+        self._version = 0
+        self._paused = False
+
+    # -- lifecycle ------------------------------------------------------
+    def initialize(self, train_data_parallel_size: int | None = None) -> None:
+        self.runner.start()
+
+    def destroy(self) -> None:
+        self.runner.destroy()
+
+    # -- versioning -----------------------------------------------------
+    def set_version(self, version: int) -> None:
+        self._version = version
+
+    def get_version(self) -> int:
+        return self._version
+
+    # -- flow control ---------------------------------------------------
+    def pause(self) -> None:
+        """Stop admitting new rollouts (weight-update window)."""
+        self._paused = True
+        self.runner.pause()
+
+    def resume(self) -> None:
+        self._paused = False
+        self.runner.resume()
+
+    @property
+    def paused(self) -> bool:
+        return self._paused
+
+    # -- submission -----------------------------------------------------
+    def submit(
+        self,
+        data: dict[str, Any],
+        workflow: "RolloutWorkflow | None" = None,
+        workflow_builder: Callable | None = None,
+        should_accept: Callable | None = None,
+    ) -> None:
+        """Queue one episode; actual launch happens when capacity allows."""
+        assert workflow is not None or workflow_builder is not None
+        try:
+            self._pending_inputs.put_nowait(
+                (data, workflow, workflow_builder, should_accept)
+            )
+        except queue.Full:
+            raise RuntimeError("workflow executor input queue full") from None
+
+    def _launch_one(self, item) -> None:
+        data, workflow, workflow_builder, should_accept = item
+        if workflow is None:
+            workflow = workflow_builder()
+        sm = self.staleness_manager
+        engine = self.engine
+        tracing = self.config.enable_rollout_tracing
+        check_format = self.config.check_trajectory_format
+
+        async def episode():
+            traj = await workflow.arun_episode(engine, data)
+            if traj is not None and check_format:
+                check_trajectory_format(traj)
+            if traj is not None and should_accept is not None and not should_accept(traj):
+                traj = None
+            return traj
+
+        task_id = self.runner.submit(episode)
+        sm.on_rollout_submitted()
+        if tracing:
+            logger.info(f"submitted rollout task {task_id}")
+
+    def _admit_pending(self) -> None:
+        """Move pending submissions into the runner within capacity."""
+        if self._paused:
+            return
+        capacity = self.staleness_manager.get_capacity(self._version)
+        while capacity > 0:
+            try:
+                item = self._pending_inputs.get_nowait()
+            except queue.Empty:
+                return
+            self._launch_one(item)
+            capacity -= 1
+
+    def _collect(self) -> None:
+        for tr in self.runner.poll_results():
+            self._on_result(tr)
+
+    def _on_result(self, tr: TaskResult) -> None:
+        sm = self.staleness_manager
+        if tr.exception is not None:
+            sm.on_rollout_rejected()
+            return
+        traj = tr.result
+        if traj is None:
+            sm.on_rollout_rejected()
+            if self.config.enable_rollout_tracing:
+                logger.info(f"rollout {tr.task_id} rejected")
+            return
+        sm.on_rollout_accepted()
+        self._result_cache.append(traj)
+
+    # -- collection -----------------------------------------------------
+    def wait(self, count: int, timeout: float | None = None) -> dict[str, Any]:
+        """Block until `count` accepted trajectories exist; returns their
+        concatenation as one padded batch."""
+        deadline = (
+            time.monotonic() + (timeout if timeout is not None else 3600.0)
+        )
+        while len(self._result_cache) < count:
+            self.runner.health_check()
+            self._admit_pending()
+            self._collect()
+            if len(self._result_cache) >= count:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"wait({count}): only {len(self._result_cache)} accepted"
+                )
+            time.sleep(ROLLOUT_POLL_WAIT_TIME / 100)
+        results, self._result_cache = (
+            self._result_cache[:count],
+            self._result_cache[count:],
+        )
+        # Shuffle so GRPO groups from the same prompt don't correlate with
+        # batch position (parity: workflow_executor wait shuffles).
+        random.shuffle(results)
+        return concat_padded_tensors(results)
+
+    def rollout_batch(
+        self,
+        data: list[dict[str, Any]],
+        workflow: "RolloutWorkflow | None" = None,
+        workflow_builder: Callable | None = None,
+        should_accept: Callable | None = None,
+    ) -> dict[str, Any]:
+        """Synchronous batch rollout: submit all, wait for all."""
+        for item in data:
+            self.submit(item, workflow, workflow_builder, should_accept)
+        return self.wait(count=len(data))
+
+    def prepare_batch(
+        self,
+        dataloader,
+        workflow: "RolloutWorkflow | None" = None,
+        workflow_builder: Callable | None = None,
+        should_accept: Callable | None = None,
+    ) -> dict[str, Any]:
+        """Async pipeline heart: keep ≥2 batches of episodes in flight and
+        return one training batch when ready (workflow_executor.py:561-598)."""
+        if self._data_generator is None:
+            self._data_generator = cycle_dataloader(dataloader)
+        batch_size = dataloader.batch_size
+        assert batch_size is not None
+        while True:
+            self.runner.health_check()
+            capacity = self.staleness_manager.get_capacity(self._version)
+            pending_total = (
+                self._pending_inputs.qsize()
+                + self.runner.inflight
+                + len(self._result_cache)
+            )
+            # keep two batches in the pipeline
+            if capacity + batch_size > 0 and pending_total < 2 * batch_size:
+                items = next(self._data_generator)
+                if isinstance(items, dict):
+                    items = [items]
+                for item in items:
+                    self.submit(item, workflow, workflow_builder, should_accept)
+            self._admit_pending()
+            self._collect()
+            if len(self._result_cache) >= batch_size:
+                with stats_tracker.record_timing("prepare_batch/concat"):
+                    return self.wait(batch_size, timeout=1)
+            time.sleep(ROLLOUT_POLL_WAIT_TIME / 10)
+
+    def get_stats(self):
+        return self.staleness_manager.get_stats()

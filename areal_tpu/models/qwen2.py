@@ -393,3 +393,163 @@ def segment_ids_from_cu_seqlens(cu_seqlens: np.ndarray, total: int) -> np.ndarra
     for i in range(n):
         seg[cu_seqlens[i] : cu_seqlens[i + 1]] = i
     return seg
+
+
+# ---------------------------------------------------------------------------
+# Decode path: prefill + batched single-token decode with a slot KV cache.
+# The TPU-native replacement for the reference's generation engines (SGLang
+# server / realhf real_llm_generate.py): static-shape continuous batching —
+# cache arrays are [L, R, S, nKV, hd] with R fixed decode slots, so XLA
+# compiles the decode step once and reuses it for the whole run.
+# ---------------------------------------------------------------------------
+
+
+def _project_qkv(layer_p: dict, x: jax.Array, cos, sin, cfg: ModelConfig):
+    """Shared QKV projection + norm + rope. x: [..., H] with leading dims
+    matching cos/sin's leading dims."""
+    q = jnp.einsum("...h,hnd->...nd", x, layer_p["q_kernel"])
+    k = jnp.einsum("...h,hnd->...nd", x, layer_p["k_kernel"])
+    v = jnp.einsum("...h,hnd->...nd", x, layer_p["v_kernel"])
+    if cfg.qkv_bias:
+        q = q + layer_p["q_bias"]
+        k = k + layer_p["k_bias"]
+        v = v + layer_p["v_bias"]
+    if cfg.qk_norm:
+        q = rms_norm(q, layer_p["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, layer_p["k_norm"], cfg.rms_norm_eps)
+    cos_b = cos[..., None, :].astype(q.dtype)
+    sin_b = sin[..., None, :].astype(q.dtype)
+
+    def rot(t):
+        d2 = t.shape[-1] // 2
+        t1, t2 = t[..., :d2], t[..., d2:]
+        return jnp.concatenate(
+            [t1 * cos_b - t2 * sin_b, t2 * cos_b + t1 * sin_b], axis=-1
+        )
+
+    return rot(q), rot(k), v
+
+
+def prefill(
+    params: dict,
+    input_ids: jax.Array,
+    position_ids: jax.Array,
+    cfg: ModelConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Causal forward over ONE sequence [T], returning (logits [T, V],
+    k_cache [L, T, nKV, hd], v_cache [L, T, nKV, hd])."""
+    compute_dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
+    cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta)
+    T = input_ids.shape[0]
+    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+    nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    group = nH // nKV
+
+    def layer(x, layer_p):
+        h = rms_norm(x, layer_p["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
+        qg = q.reshape(T, nKV, group, hd)
+        scores = jnp.einsum("tkgd,skd->kgts", qg, k).astype(jnp.float32)
+        scores = scores / np.sqrt(hd)
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn_out = jnp.einsum("kgts,skd->tkgd", probs, v).reshape(T, nH, hd)
+        x = x + jnp.einsum(
+            "tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"]
+        )
+        h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + mlp(layer_p["mlp"], h)
+        return x, (k, v)
+
+    if cfg.scan_layers:
+        x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
+    else:
+        ks_list, vs_list = [], []
+        for i in range(cfg.num_hidden_layers):
+            x, (k, v) = layer(x, params[f"layers_{i}"])
+            ks_list.append(k)
+            vs_list.append(v)
+        ks, vs = jnp.stack(ks_list), jnp.stack(vs_list)
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum(
+            "th,vh->tv", x, params["embed"]["embedding"].astype(compute_dtype)
+        )
+    else:
+        logits = jnp.einsum("th,hv->tv", x, params["lm_head"]["kernel"])
+    return logits.astype(jnp.float32), ks, vs
+
+
+def decode_step(
+    params: dict,
+    tokens: jax.Array,  # [R] current input token per slot
+    positions: jax.Array,  # [R] index the new token occupies
+    k_cache: jax.Array,  # [L, R, S, nKV, hd]
+    v_cache: jax.Array,  # [L, R, S, nKV, hd]
+    cfg: ModelConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One batched decode step over R slots.
+
+    Writes this step's K/V at `positions` and attends over s <= position
+    per slot. Returns (logits [R, V], k_cache, v_cache).
+    """
+    compute_dtype = jnp.dtype(cfg.dtype)
+    R = tokens.shape[0]
+    S = k_cache.shape[2]
+    nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    group = nH // nKV
+    x = params["embed"]["embedding"][tokens].astype(compute_dtype)  # [R, H]
+    cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)  # [R, hd/2]
+    valid = jnp.arange(S)[None, :] <= positions[:, None]  # [R, S]
+
+    def write(cache_l, new):  # [R, S, nKV, hd] <- [R, nKV, hd]
+        onehot = (jnp.arange(S)[None, :] == positions[:, None]).astype(
+            cache_l.dtype
+        )
+        return cache_l * (1 - onehot[..., None, None]) + (
+            new[:, None] * onehot[..., None, None]
+        )
+
+    def layer(x, inputs):
+        layer_p, kc, vc = inputs
+        h = rms_norm(x, layer_p["input_norm"], cfg.rms_norm_eps)
+        q, k_new, v_new = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
+        kc = write(kc, k_new.astype(kc.dtype))
+        vc = write(vc, v_new.astype(vc.dtype))
+        qg = q.reshape(R, nKV, group, hd)
+        scores = jnp.einsum("rkgd,rskd->rkgs", qg, kc.astype(q.dtype))
+        scores = (scores / np.sqrt(hd)).astype(jnp.float32)
+        scores = jnp.where(valid[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn_out = jnp.einsum(
+            "rkgs,rskd->rkgd", probs, vc.astype(x.dtype)
+        ).reshape(R, nH, hd)
+        x = x + jnp.einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"])
+        h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + mlp(layer_p["mlp"], h)
+        return x, (kc, vc)
+
+    if cfg.scan_layers:
+        x, (k_cache, v_cache) = jax.lax.scan(
+            layer, x, (params["layers"], k_cache, v_cache)
+        )
+    else:
+        kcs, vcs = [], []
+        for i in range(cfg.num_hidden_layers):
+            x, (kc, vc) = layer(
+                x, (params[f"layers_{i}"], k_cache[i], v_cache[i])
+            )
+            kcs.append(kc)
+            vcs.append(vc)
+        k_cache, v_cache = jnp.stack(kcs), jnp.stack(vcs)
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum(
+            "rh,vh->rv", x, params["embed"]["embedding"].astype(compute_dtype)
+        )
+    else:
+        logits = jnp.einsum("rh,hv->rv", x, params["lm_head"]["kernel"])
+    return logits.astype(jnp.float32), k_cache, v_cache

@@ -1,0 +1,86 @@
+import asyncio
+import time
+
+import pytest
+
+from areal_tpu.core.async_task_runner import AsyncTaskRunner, TaskRunnerError
+
+
+@pytest.fixture()
+def runner():
+    r = AsyncTaskRunner(queue_size=64, name="test")
+    r.start()
+    yield r
+    r.destroy()
+
+
+def test_submit_and_wait(runner):
+    async def work(x):
+        await asyncio.sleep(0.01)
+        return x * 2
+
+    for i in range(5):
+        runner.submit(lambda i=i: work(i))
+    results = runner.wait(5, timeout=5)
+    assert sorted(r.result for r in results) == [0, 2, 4, 6, 8]
+
+
+def test_wait_timeout_preserves_results(runner):
+    async def slow():
+        await asyncio.sleep(10)
+
+    async def fast():
+        return 1
+
+    runner.submit(fast)
+    runner.submit(slow)
+    with pytest.raises(TimeoutError):
+        runner.wait(2, timeout=0.3)
+    # the fast result must not be lost
+    results = runner.wait(1, timeout=1)
+    assert results[0].result == 1
+
+
+def test_exceptions_captured(runner):
+    async def boom():
+        raise ValueError("boom")
+
+    runner.submit(boom)
+    [res] = runner.wait(1, timeout=5)
+    assert isinstance(res.exception, ValueError)
+
+
+def test_exceptions_raised_when_requested(runner):
+    async def boom():
+        raise ValueError("boom")
+
+    runner.submit(boom)
+    with pytest.raises(TaskRunnerError):
+        runner.wait(1, timeout=5, raise_errors=True)
+
+
+def test_pause_resume(runner):
+    done = []
+
+    async def work():
+        done.append(1)
+        return 1
+
+    runner.pause()
+    runner.submit(work)
+    time.sleep(0.2)
+    assert not done  # paused: not launched
+    runner.resume()
+    runner.wait(1, timeout=5)
+    assert done
+
+
+def test_inflight_tracking(runner):
+    async def slow():
+        await asyncio.sleep(0.2)
+
+    for _ in range(3):
+        runner.submit(slow)
+    assert runner.inflight == 3
+    runner.wait(3, timeout=5)
+    assert runner.inflight == 0

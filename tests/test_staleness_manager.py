@@ -1,0 +1,55 @@
+"""Capacity math parity with areal/tests/test_staleness_manager.py."""
+
+from areal_tpu.core.staleness_manager import StalenessManager
+
+
+def test_concurrency_cap():
+    m = StalenessManager(max_concurrent_rollouts=4, consumer_batch_size=100,
+                         max_staleness=100)
+    assert m.get_capacity(0) == 4
+    for _ in range(4):
+        m.on_rollout_submitted()
+    assert m.get_capacity(0) == 0
+
+
+def test_staleness_cap_version0():
+    # on-policy: (0 + 0 + 1) * bs samples admissible at version 0
+    m = StalenessManager(max_concurrent_rollouts=1000, consumer_batch_size=8,
+                         max_staleness=0)
+    assert m.get_capacity(0) == 8
+    for _ in range(8):
+        m.on_rollout_submitted()
+    assert m.get_capacity(0) == 0
+    # version bump releases another batch
+    assert m.get_capacity(1) == 8
+
+
+def test_accepted_counts_against_staleness():
+    m = StalenessManager(max_concurrent_rollouts=1000, consumer_batch_size=4,
+                         max_staleness=1)
+    for _ in range(8):
+        m.on_rollout_submitted()
+    assert m.get_capacity(0) == 0
+    for _ in range(4):
+        m.on_rollout_accepted()
+    # accepted + running unchanged in total
+    assert m.get_capacity(0) == 0
+
+
+def test_rejected_frees_capacity():
+    m = StalenessManager(max_concurrent_rollouts=1000, consumer_batch_size=4,
+                         max_staleness=0)
+    for _ in range(4):
+        m.on_rollout_submitted()
+    assert m.get_capacity(0) == 0
+    m.on_rollout_rejected()
+    assert m.get_capacity(0) == 1
+
+
+def test_stats_snapshot():
+    m = StalenessManager(4, 4, 0)
+    m.on_rollout_submitted()
+    m.on_rollout_submitted()
+    m.on_rollout_accepted()
+    st = m.get_stats()
+    assert (st.submitted, st.accepted, st.running) == (2, 1, 1)
