@@ -84,6 +84,7 @@ class WorkflowExecutor:
         self._data_generator = None
         self._version = 0
         self._paused = False
+        self._consecutive_failures = 0
 
     # -- lifecycle ------------------------------------------------------
     def initialize(self, train_data_parallel_size: int | None = None) -> None:
@@ -173,7 +174,15 @@ class WorkflowExecutor:
         sm = self.staleness_manager
         if tr.exception is not None:
             sm.on_rollout_rejected()
+            # A systematic failure (e.g. crashed decode engine) must surface
+            # instead of spinning forever resubmitting doomed episodes.
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= 16:
+                raise RuntimeError(
+                    "16 consecutive rollout episodes failed; last error"
+                ) from tr.exception
             return
+        self._consecutive_failures = 0
         traj = tr.result
         if traj is None:
             sm.on_rollout_rejected()

@@ -1,0 +1,131 @@
+"""Dataset registry with per-host sharding.
+
+Parity target: areal/dataset/__init__.py:18 (`get_custom_dataset` with
+split_dataset_by_node). Datasets are HF `datasets` objects mapped to the
+framework's item schema: {"messages" | "prompt" | "input_ids", "answer"}.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("dataset")
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register_dataset(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_custom_dataset(
+    path: str,
+    split: str = "train",
+    type: str = "rl",
+    tokenizer: Any = None,
+    max_length: int | None = None,
+    rank: int = 0,
+    world_size: int = 1,
+    **kwargs,
+):
+    """Load a dataset by registry name or HF path, sharded per host."""
+    name = path.split("/")[-1].lower()
+    if name in _REGISTRY:
+        ds = _REGISTRY[name](
+            path=path, split=split, type=type, tokenizer=tokenizer,
+            max_length=max_length, **kwargs
+        )
+    else:
+        import datasets as hf_datasets
+
+        ds = hf_datasets.load_dataset(path, split=split)
+    if world_size > 1:
+        from datasets.distributed import split_dataset_by_node
+
+        ds = split_dataset_by_node(ds, rank=rank, world_size=world_size)
+    return ds
+
+
+@register_dataset("gsm8k")
+def _gsm8k(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
+    """GSM8K mapped to the RLVR schema (question -> messages, '#### x' ->
+    answer)."""
+    import datasets as hf_datasets
+
+    ds = hf_datasets.load_dataset("openai/gsm8k", "main", split=split)
+
+    def to_item(x):
+        answer = x["answer"].split("####")[-1].strip()
+        return dict(
+            messages=[{"role": "user", "content": x["question"]}],
+            prompt=x["question"],
+            answer=answer,
+        )
+
+    ds = ds.map(to_item, remove_columns=ds.column_names)
+    if type == "sft" and tokenizer is not None:
+        def tokenize(x):
+            ids = tokenizer.encode(x["prompt"] + "\n" + x["answer"])
+            return dict(input_ids=ids[:max_length] if max_length else ids)
+
+        ds = ds.map(tokenize)
+    return ds
+
+
+class SimpleDataLoader:
+    """Minimal stateful dataloader over a dataset (list-like), yielding
+    lists of items; replaces torchdata StatefulDataLoader for the TPU build.
+
+    state_dict/load_state_dict make the position recoverable (parity:
+    the reference's dataloader state in RecoverInfo).
+    """
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = True,
+                 seed: int = 0, drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._pos = 0
+
+    def __len__(self):
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def _order(self):
+        import numpy as np
+
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.RandomState(self.seed + self._epoch).shuffle(order)
+        return order
+
+    def __iter__(self):
+        order = self._order()
+        n = len(self.dataset)
+        while self._pos + self.batch_size <= n or (
+            not self.drop_last and self._pos < n
+        ):
+            idx = order[self._pos : self._pos + self.batch_size]
+            self._pos += len(idx)
+            yield [self.dataset[int(i)] for i in idx]
+        self._epoch += 1
+        self._pos = 0
+
+    def state_dict(self) -> dict:
+        return dict(epoch=self._epoch, pos=self._pos, seed=self.seed)
+
+    def load_state_dict(self, state: dict) -> None:
+        self._epoch = state["epoch"]
+        self._pos = state["pos"]
+        self.seed = state["seed"]

@@ -119,9 +119,15 @@ class JaxDecodeEngine(InferenceEngine):
 
     # -- lifecycle ------------------------------------------------------
     def set_model(self, params, model_config: ModelConfig) -> None:
-        """Install model weights directly (colocated mode)."""
+        """Install model weights directly (colocated mode).
+
+        Always copies: the trainer donates its param buffers to XLA on every
+        optimizer step, so sharing them would leave this engine holding
+        deleted arrays. The copy is the in-device analogue of the reference
+        NCCL broadcast.
+        """
         self.model_config = model_config
-        self.params = params
+        self.params = jax.tree.map(lambda x: jnp.copy(jnp.asarray(x)), params)
 
     def initialize(
         self,
@@ -542,7 +548,10 @@ class JaxDecodeEngine(InferenceEngine):
         self.pause_generation()
         try:
             with self._weight_lock:
-                self.params = params
+                # copy — the trainer will donate these buffers next step
+                self.params = jax.tree.map(
+                    lambda x: jnp.copy(jnp.asarray(x)), params
+                )
                 if model_config is not None:
                     decode_cfg = dataclasses.replace(
                         model_config,

@@ -1,0 +1,158 @@
+"""Generalized Advantage Estimation as an associative scan.
+
+TPU-native replacement for the reference's CUDA GAE kernels
+(csrc/cugae/gae.cu:10-216, wrapped by realhf/impl/model/utils/
+ppo_functional.py:326-383) and the Python recursion in the lite actor
+(areal/engine/ppo/actor.py:131-152).
+
+GAE is a linear (affine) recurrence run backwards in time:
+
+    A_t = m_t * (delta_t + gamma*lam * A_{t+1}) + (1 - m_t) * A_{t+1}
+
+which composes associatively as affine maps (a, b): x -> a*x + b. We
+evaluate it with `jax.lax.associative_scan` — O(log T) depth, fully
+parallel over batch and time on the VPU, no sequential loop — instead of a
+per-sequence sequential CUDA kernel. Masked (non-contributing) positions
+pass both the advantage and the bootstrap value through unchanged, matching
+the reference's masked recursion exactly (actor.py:140-151).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _affine_compose(later, earlier):
+    """Compose affine maps along the scan: earlier ∘ later (reverse scan
+    feeds `later` as the already-accumulated suffix)."""
+    a1, b1 = earlier
+    a2, b2 = later
+    return a1 * a2, b1 + a1 * b2
+
+
+def _suffix_affine(a: jax.Array, b: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Inclusive suffix composition S_t = f_t ∘ f_{t+1} ∘ ... ∘ f_{T-1}
+    along axis 1 of [B, T] coefficient arrays."""
+    return jax.lax.associative_scan(_affine_compose, (a, b), reverse=True, axis=1)
+
+
+def gae_padded(
+    rewards: jax.Array,  # [B, T] token-level rewards (already KL-regularised)
+    values: jax.Array,  # [B, T] value estimates (zeros for GRPO)
+    loss_mask: jax.Array,  # [B, T] 1 where the token contributes (rolled mask)
+    seq_no_eos_mask: jax.Array,  # [B] 1 if the sequence hit the length limit
+    discount: float,
+    gae_lambda: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Masked GAE over padded batches. Returns (advantages, returns), both
+    [B, T] float32, with advantages[:, T-1] == 0 (no next token).
+
+    Semantics match areal/engine/ppo/actor.py:131-152: the bootstrap value
+    at the sequence end is values[:, T-1] when the sequence has no EOS
+    (truncated — bootstrap from the value head) and 0 otherwise.
+    """
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    m = loss_mask.astype(jnp.float32)
+    B, T = rewards.shape
+
+    v_init = values[:, T - 1] * seq_no_eos_mask.astype(jnp.float32)  # [B]
+
+    # ---- pass 1: NV_t = bootstrap value seen when processing position t.
+    # Carry update after processing s: c <- (1-m_s)*c + m_s*v_s, i.e. affine
+    # (a, b) = (1-m_s, m_s*v_s); position T-1 is the loop's seed (identity).
+    a_nv = jnp.concatenate([1.0 - m[:, : T - 1], jnp.ones((B, 1))], axis=1)
+    b_nv = jnp.concatenate(
+        [m[:, : T - 1] * values[:, : T - 1], jnp.zeros((B, 1))], axis=1
+    )
+    A_nv, B_nv = _suffix_affine(a_nv, b_nv)
+    # NV_t = S_{t+1}(v_init); S_T = identity.
+    A_shift = jnp.concatenate([A_nv[:, 1:], jnp.ones((B, 1))], axis=1)
+    B_shift = jnp.concatenate([B_nv[:, 1:], jnp.zeros((B, 1))], axis=1)
+    next_values = A_shift * v_init[:, None] + B_shift  # [B, T]
+
+    # ---- pass 2: advantages.
+    delta = rewards + discount * next_values - values
+    a_adv = 1.0 - m + m * (discount * gae_lambda)
+    b_adv = m * delta
+    # position T-1 contributes nothing (identity, evaluated at 0)
+    a_adv = jnp.concatenate([a_adv[:, : T - 1], jnp.ones((B, 1))], axis=1)
+    b_adv = jnp.concatenate([b_adv[:, : T - 1], jnp.zeros((B, 1))], axis=1)
+    _, advantages = _suffix_affine(a_adv, b_adv)
+    returns = advantages + values
+    return advantages, returns
+
+
+gae_padded_jit = jax.jit(gae_padded, static_argnums=(4, 5))
+
+
+def gae_packed(
+    rewards: jax.Array,  # [total]
+    values: jax.Array,  # [total]
+    loss_mask: jax.Array,  # [total]
+    segment_ids: jax.Array,  # [total] (monotone; padding segment allowed)
+    seq_no_eos_mask: jax.Array,  # [total] per-token copy of the seq flag
+    discount: float,
+    gae_lambda: float,
+) -> tuple[jax.Array, jax.Array]:
+    """Segment-aware GAE over a packed 1-D stream (parity with cugae's
+    gae_1d_nolp_misalign, csrc/cugae/gae.cu:10). Segment boundaries reset
+    the recurrence: the affine coefficient is zeroed at each segment's last
+    token so no information crosses sequences."""
+    rewards = rewards.astype(jnp.float32)[None]
+    values = values.astype(jnp.float32)[None]
+    m = loss_mask.astype(jnp.float32)[None]
+    seg = segment_ids
+    T = seg.shape[0]
+    last_of_seg = jnp.concatenate(
+        [seg[:-1] != seg[1:], jnp.array([True])]
+    )[None]
+    no_eos = seq_no_eos_mask.astype(jnp.float32)[None]
+
+    # bootstrap value per segment end (value at the last token if no EOS)
+    v_boot = jnp.where(last_of_seg.astype(bool), values * no_eos, 0.0)
+
+    # NV pass with per-segment reset: at segment-last tokens the carry is
+    # re-seeded with v_boot (a=0 cuts the suffix).
+    a_nv = jnp.where(last_of_seg.astype(bool), 0.0, 1.0 - m)
+    b_nv = jnp.where(last_of_seg.astype(bool), v_boot, m * values)
+    A_nv, B_nv = _suffix_affine(a_nv, b_nv)
+    A_shift = jnp.concatenate([A_nv[:, 1:], jnp.ones((1, 1))], axis=1)
+    B_shift = jnp.concatenate([B_nv[:, 1:], jnp.zeros((1, 1))], axis=1)
+    next_values = B_shift + A_shift * 0.0  # reset at boundaries: no v_init term
+
+    delta = rewards + discount * next_values - values
+    a_adv = jnp.where(
+        last_of_seg.astype(bool), 0.0, 1.0 - m + m * discount * gae_lambda
+    )
+    b_adv = jnp.where(last_of_seg.astype(bool), 0.0, m * delta)
+    _, advantages = _suffix_affine(a_adv, b_adv)
+    returns = advantages + values
+    return advantages[0], returns[0]
+
+
+def gae_padded_reference(
+    rewards: np.ndarray,
+    values: np.ndarray,
+    loss_mask: np.ndarray,
+    seq_no_eos_mask: np.ndarray,
+    discount: float,
+    gae_lambda: float,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Sequential numpy oracle (direct transcription of the recurrence) used
+    to validate the scan formulation in tests."""
+    B, T = rewards.shape
+    adv = np.zeros((B, T), dtype=np.float64)
+    lastgaelam = np.zeros(B, dtype=np.float64)
+    nextvalues = values[:, T - 1] * seq_no_eos_mask
+    for t in reversed(range(T - 1)):
+        delta = rewards[:, t] + discount * nextvalues - values[:, t]
+        newgaelam = delta + discount * gae_lambda * lastgaelam
+        mask = loss_mask[:, t]
+        nextvalues = nextvalues * (1 - mask) + values[:, t] * mask
+        lastgaelam = lastgaelam * (1 - mask) + newgaelam * mask
+        adv[:, t] = lastgaelam
+    returns = adv + values
+    return adv.astype(np.float32), returns.astype(np.float32)

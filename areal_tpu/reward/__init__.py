@@ -1,0 +1,1 @@
+from areal_tpu.reward.math_parser import math_verify_reward  # noqa: F401

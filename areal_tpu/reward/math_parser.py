@@ -1,0 +1,134 @@
+"""Rule-based math answer verification.
+
+Parity target: areal/reward/math_parser.py — extract the final answer from a
+model completion (\\boxed{...}, "the answer is ...", last number) and test
+mathematical equivalence against the ground truth via sympy when available,
+falling back to string/numeric comparison.
+"""
+
+from __future__ import annotations
+
+import re
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("math_parser")
+
+
+_BOXED_RE = re.compile(r"\\boxed\s*\{")
+_ANSWER_PATTERNS = [
+    re.compile(r"(?:final answer|answer)\s*(?:is|:)\s*(.+)", re.IGNORECASE),
+]
+_NUMBER_RE = re.compile(r"-?\d+(?:[.,]\d+)*(?:/\d+)?")
+
+
+def extract_boxed(text: str) -> str | None:
+    """Extract the LAST \\boxed{...} with balanced braces."""
+    last = None
+    for m in _BOXED_RE.finditer(text):
+        start = m.end()
+        depth = 1
+        i = start
+        while i < len(text) and depth > 0:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        if depth == 0:
+            last = text[start : i - 1]
+    return last
+
+
+def extract_answer(text: str) -> str | None:
+    """Best-effort final-answer extraction from a completion."""
+    boxed = extract_boxed(text)
+    if boxed is not None:
+        return boxed.strip()
+    for pat in _ANSWER_PATTERNS:
+        matches = pat.findall(text)
+        if matches:
+            ans = matches[-1].strip().rstrip(".")
+            inner = extract_boxed(ans)
+            return (inner or ans).strip()
+    numbers = _NUMBER_RE.findall(text)
+    if numbers:
+        return numbers[-1]
+    return None
+
+
+def _normalize(ans: str) -> str:
+    ans = ans.strip().strip("$").strip()
+    ans = ans.replace("\\!", "").replace("\\,", "").replace("\\ ", " ")
+    ans = ans.replace("dfrac", "frac").replace("tfrac", "frac")
+    ans = ans.replace("\\left", "").replace("\\right", "")
+    ans = ans.replace("^{\\circ}", "").replace("^\\circ", "")
+    ans = ans.replace("\\%", "").rstrip("%")
+    ans = re.sub(r"\\text\{[^}]*\}", "", ans)
+    ans = re.sub(r"\s+", " ", ans).strip()
+    # strip thousands separators in plain numbers like 1,234,567
+    if re.fullmatch(r"-?\d{1,3}(,\d{3})+(\.\d+)?", ans):
+        ans = ans.replace(",", "")
+    return ans
+
+
+def _to_number(ans: str) -> float | None:
+    ans = ans.strip()
+    m = re.fullmatch(r"(-?\d+)\s*/\s*(\d+)", ans)
+    if m:
+        denom = float(m.group(2))
+        return float(m.group(1)) / denom if denom else None
+    frac = re.fullmatch(r"-?\\frac\{(-?\d+)\}\{(-?\d+)\}", ans)
+    if frac:
+        denom = float(frac.group(2))
+        val = float(frac.group(1)) / denom if denom else None
+        if val is not None and ans.startswith("-"):
+            val = -val
+        return val
+    try:
+        return float(ans)
+    except ValueError:
+        return None
+
+
+def math_equal(pred: str, target: str) -> bool:
+    """Mathematical equivalence: numeric, then sympy-symbolic, then string."""
+    pred, target = _normalize(pred), _normalize(target)
+    if pred == target:
+        return True
+    pn, tn = _to_number(pred), _to_number(target)
+    if pn is not None and tn is not None:
+        return abs(pn - tn) < 1e-6 * max(1.0, abs(tn))
+    try:
+        import sympy
+        from sympy.parsing.latex import parse_latex
+
+        def parse(s):
+            try:
+                return parse_latex(s)
+            except Exception:
+                return sympy.sympify(s)
+
+        diff = sympy.simplify(parse(pred) - parse(target))
+        return diff == 0
+    except Exception:
+        return False
+
+
+def math_verify_reward(
+    prompt: str | None,
+    completion: str | None,
+    prompt_ids=None,
+    completion_ids=None,
+    **data,
+) -> float:
+    """Binary verifiable reward for math answers (the RLVR reward_fn
+    signature). Ground truth comes from data['answer'] (or 'solution')."""
+    target = data.get("answer", data.get("solution"))
+    if completion is None or target is None:
+        return 0.0
+    target_ans = extract_answer(str(target)) or str(target).strip()
+    pred = extract_answer(completion)
+    if pred is None:
+        return 0.0
+    return 1.0 if math_equal(pred, target_ans) else 0.0

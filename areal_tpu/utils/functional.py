@@ -38,23 +38,27 @@ __all__ = [
 ]
 
 
-def gather_logprobs(logits: jax.Array, labels: jax.Array) -> jax.Array:
+def gather_logprobs(
+    logits: jax.Array, labels: jax.Array, temperature: float = 1.0
+) -> jax.Array:
     """log p(labels) from raw logits; [T, V] + [T] → [T] (float32).
 
-    Computed in float32 regardless of logits dtype — bf16 log-softmax loses
-    ~2 decimal digits which is fatal for importance ratios.
+    `temperature` matches the sampling temperature so recomputed logprobs
+    align with inference-engine logprobs. Computed in float32 regardless of
+    logits dtype — bf16 log-softmax loses ~2 decimal digits which is fatal
+    for importance ratios.
     """
-    logits = logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gathered = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     return gathered - logz
 
 
 def gather_logprobs_entropy(
-    logits: jax.Array, labels: jax.Array
+    logits: jax.Array, labels: jax.Array, temperature: float = 1.0
 ) -> tuple[jax.Array, jax.Array]:
     """(log p(labels), entropy) in one pass; shares the logsumexp."""
-    logits = logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32) / max(temperature, 1e-6)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     logprobs_all = logits - logz[..., None]
     probs = jnp.exp(logprobs_all)

@@ -1,0 +1,90 @@
+"""GAE associative-scan vs sequential oracle (parity with the reference's
+cugae kernel tests, realhf/tests/cpp_extensions/test_cugae.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from areal_tpu.ops.gae import gae_packed, gae_padded, gae_padded_reference
+
+
+def _random_case(B=4, T=24, seed=0, mask_prob=0.7):
+    rng = np.random.RandomState(seed)
+    rewards = rng.randn(B, T).astype(np.float32)
+    values = rng.randn(B, T).astype(np.float32)
+    loss_mask = (rng.rand(B, T) < mask_prob).astype(np.float32)
+    no_eos = (rng.rand(B) < 0.5).astype(np.float32)
+    return rewards, values, loss_mask, no_eos
+
+
+@pytest.mark.parametrize("discount,lam", [(1.0, 1.0), (0.99, 0.95), (0.9, 0.5)])
+def test_gae_padded_matches_oracle(discount, lam):
+    rewards, values, loss_mask, no_eos = _random_case(seed=int(lam * 100))
+    adv, ret = gae_padded(rewards, values, loss_mask, no_eos, discount, lam)
+    adv_ref, ret_ref = gae_padded_reference(
+        rewards, values, loss_mask, no_eos, discount, lam
+    )
+    np.testing.assert_allclose(np.asarray(adv), adv_ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ret), ret_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_gae_last_position_zero():
+    rewards, values, loss_mask, no_eos = _random_case(seed=7)
+    adv, _ = gae_padded(rewards, values, loss_mask, no_eos, 0.99, 0.95)
+    np.testing.assert_allclose(np.asarray(adv)[:, -1], 0.0)
+
+
+def test_gae_grpo_mode_reward_to_go():
+    # values == 0, discount = lam = 1: advantage is the masked reward-to-go
+    B, T = 2, 8
+    rewards = np.zeros((B, T), dtype=np.float32)
+    rewards[:, 5] = 1.0  # terminal-ish reward
+    values = np.zeros((B, T), dtype=np.float32)
+    loss_mask = np.ones((B, T), dtype=np.float32)
+    no_eos = np.zeros(B, dtype=np.float32)
+    adv, _ = gae_padded(rewards, values, loss_mask, no_eos, 1.0, 1.0)
+    adv = np.asarray(adv)
+    np.testing.assert_allclose(adv[:, :6], 1.0, atol=1e-6)
+    np.testing.assert_allclose(adv[:, 6:], 0.0, atol=1e-6)
+
+
+def test_gae_packed_matches_padded():
+    lens = [6, 9, 4]
+    rng = np.random.RandomState(3)
+    B, T = len(lens), max(lens)
+    rewards = np.zeros((B, T), dtype=np.float32)
+    values = np.zeros((B, T), dtype=np.float32)
+    loss_mask = np.zeros((B, T), dtype=np.float32)
+    for i, L in enumerate(lens):
+        rewards[i, :L] = rng.randn(L)
+        values[i, :L] = rng.randn(L)
+        loss_mask[i, :L] = (rng.rand(L) < 0.8).astype(np.float32)
+        # invariant from the rolled loss mask: a sequence's final position is
+        # never trained (its label falls outside the sequence)
+        loss_mask[i, L - 1] = 0.0
+    no_eos = np.zeros(B, dtype=np.float32)
+
+    adv_pad, _ = gae_padded(rewards, values, loss_mask, no_eos, 0.97, 0.9)
+    adv_pad = np.asarray(adv_pad)
+
+    # packed layout
+    seg, r1, v1, m1, ne1 = [], [], [], [], []
+    for i, L in enumerate(lens):
+        seg += [i] * L
+        r1 += list(rewards[i, :L])
+        v1 += list(values[i, :L])
+        m1 += list(loss_mask[i, :L])
+        ne1 += [0.0] * L
+    adv_packed, _ = gae_packed(
+        jnp.asarray(r1), jnp.asarray(v1), jnp.asarray(m1),
+        jnp.asarray(np.array(seg)), jnp.asarray(ne1), 0.97, 0.9
+    )
+    adv_packed = np.asarray(adv_packed)
+    ofs = 0
+    for i, L in enumerate(lens):
+        np.testing.assert_allclose(
+            adv_packed[ofs : ofs + L], adv_pad[i, :L], rtol=1e-4,
+            atol=1e-4, err_msg=f"seq {i}"
+        )
+        ofs += L

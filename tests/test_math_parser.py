@@ -1,0 +1,45 @@
+import pytest
+
+from areal_tpu.reward.math_parser import (
+    extract_answer,
+    extract_boxed,
+    math_equal,
+    math_verify_reward,
+)
+
+
+def test_extract_boxed_balanced():
+    assert extract_boxed(r"so \boxed{42}") == "42"
+    assert extract_boxed(r"\boxed{\frac{1}{2}}") == r"\frac{1}{2}"
+    assert extract_boxed(r"\boxed{a} then \boxed{b}") == "b"
+    assert extract_boxed("no box") is None
+
+
+def test_extract_answer_fallbacks():
+    assert extract_answer("The answer is 17.") == "17"
+    assert extract_answer("blah 3 then 42") == "42"
+    assert extract_answer("nothing here") is None
+
+
+@pytest.mark.parametrize(
+    "a,b,eq",
+    [
+        ("42", "42", True),
+        ("42.0", "42", True),
+        ("1/2", "0.5", True),
+        (r"\frac{1}{2}", "0.5", True),
+        ("1,234", "1234", True),
+        ("41", "42", False),
+        ("x+1", "1+x", True),  # sympy path
+    ],
+)
+def test_math_equal(a, b, eq):
+    assert math_equal(a, b) == eq
+
+
+def test_reward_fn():
+    assert math_verify_reward(None, r"... \boxed{10}", answer="10") == 1.0
+    assert math_verify_reward(None, r"... \boxed{11}", answer="10") == 0.0
+    assert math_verify_reward(None, "The answer is 7", answer="#### 7".split("####")[-1].strip()) == 1.0
+    assert math_verify_reward(None, None, answer="1") == 0.0
+    assert math_verify_reward(None, "junk", answer=None) == 0.0
