@@ -182,6 +182,7 @@ class WorkflowExecutor:
                     "16 consecutive rollout episodes failed; last error"
                 ) from tr.exception
             return
+        # any completed episode (accepted or rejected) breaks the streak
         self._consecutive_failures = 0
         traj = tr.result
         if traj is None:

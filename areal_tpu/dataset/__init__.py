@@ -58,7 +58,12 @@ def _gsm8k(path: str, split: str, type: str, tokenizer=None, max_length=None, **
     answer)."""
     import datasets as hf_datasets
 
-    ds = hf_datasets.load_dataset("openai/gsm8k", "main", split=split)
+    # honour an explicit local path / mirror; bare "gsm8k" means the hub set
+    # (only the hub dataset has the "main" builder config)
+    if path and path not in ("gsm8k",):
+        ds = hf_datasets.load_dataset(path, split=split)
+    else:
+        ds = hf_datasets.load_dataset("openai/gsm8k", "main", split=split)
 
     def to_item(x):
         answer = x["answer"].split("####")[-1].strip()

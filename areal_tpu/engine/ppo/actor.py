@@ -61,22 +61,38 @@ class PPOActor:
         self.mask_no_eos_with_zero = config.mask_no_eos_with_zero
         self.temperature = config.temperature
         self.dynamic_sampling = config.dynamic_sampling
+        # Stable callables: the engine's jit caches are keyed by callable
+        # identity, so per-call closures would recompile every step.
+        self._logp_fns: dict[float, Any] = {}
+        self._loss_fn = functools.partial(
+            grpo_loss_fn,
+            temperature=config.temperature,
+            eps_clip=config.eps_clip,
+            eps_clip_higher=config.eps_clip_higher,
+            c_clip=config.c_clip,
+            behav_imp_weight_cap=config.behav_imp_weight_cap,
+        )
+
+    def _calc_logprobs_fn(self, temp: float):
+        if temp not in self._logp_fns:
+            def calc_logprobs(logits, mb):
+                labels = jnp.roll(mb["input_ids"], shift=-1)
+                return gather_logprobs(logits, labels, temp)
+
+            self._logp_fns[temp] = calc_logprobs
+        return self._logp_fns[temp]
 
     # ------------------------------------------------------------------
     def compute_logp(self, data: dict[str, Any], temperature: float | None = None):
         """Token logprobs of the batch under current weights ([B, T] padded,
         aligned so logp[t] scores token t+1 — then rolled to label-align in
         compute_advantages, mirroring the reference layout)."""
-        temp = temperature or self.temperature
-
-        def calc_logprobs(logits, mb):
-            labels = jnp.roll(mb["input_ids"], shift=-1)
-            return gather_logprobs(logits, labels, temp)
+        temp = self.temperature if temperature is None else temperature
 
         self.engine.eval()
         flat = self.engine.forward(
             input_=data,
-            post_hook=calc_logprobs,
+            post_hook=self._calc_logprobs_fn(temp),
             aggregate_fn=list,
         )
         # re-pad to [B, T]
@@ -172,6 +188,7 @@ class PPOActor:
         cfg = self.config
         if self.dynamic_sampling and len(data["rewards"]) % self.group_size == 0:
             data, sampling_stat = dynamic_sampling(data, self.group_size)
+            stats_tracker.scalar(**sampling_stat)
 
         attn_mask = np.asarray(data["attention_mask"])
         loss_mask = np.asarray(data["loss_mask"])
@@ -216,17 +233,6 @@ class PPOActor:
         }
 
         self.engine.train()
-        loss_fn = functools.partial(
-            grpo_loss_fn,
-            temperature=self.temperature,
-            eps_clip=cfg.eps_clip,
-            eps_clip_higher=cfg.eps_clip_higher,
-            c_clip=cfg.c_clip,
-            behav_imp_weight_cap=cfg.behav_imp_weight_cap,
-        )
-        # cache the partial so the engine's jit cache hits across steps
-        if not hasattr(self, "_loss_fn"):
-            self._loss_fn = loss_fn
         loss_fn = self._loss_fn
 
         all_stats = []

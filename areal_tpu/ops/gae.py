@@ -118,10 +118,10 @@ def gae_packed(
     # re-seeded with v_boot (a=0 cuts the suffix).
     a_nv = jnp.where(last_of_seg.astype(bool), 0.0, 1.0 - m)
     b_nv = jnp.where(last_of_seg.astype(bool), v_boot, m * values)
-    A_nv, B_nv = _suffix_affine(a_nv, b_nv)
-    A_shift = jnp.concatenate([A_nv[:, 1:], jnp.ones((1, 1))], axis=1)
-    B_shift = jnp.concatenate([B_nv[:, 1:], jnp.zeros((1, 1))], axis=1)
-    next_values = B_shift + A_shift * 0.0  # reset at boundaries: no v_init term
+    _, B_nv = _suffix_affine(a_nv, b_nv)
+    # a=0 at every segment boundary, so the multiplicative (v_init) term of
+    # the shifted carry is identically zero — only the additive part remains.
+    next_values = jnp.concatenate([B_nv[:, 1:], jnp.zeros((1, 1))], axis=1)
 
     delta = rewards + discount * next_values - values
     a_adv = jnp.where(

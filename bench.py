@@ -1,0 +1,164 @@
+"""Benchmark harness: one JSON line for the driver.
+
+Measures the GSPMD trainer's packed-SFT step throughput on the flagship
+Qwen2.5-0.5B-geometry decoder (bf16, remat, scan-over-layers) on whatever
+accelerator is attached, and reports MFU against the chip's bf16 peak.
+
+`vs_baseline` compares our trainer MFU to 0.20 — the ballpark dense-7B
+train-step MFU of the reference's Megatron/FSDP GPU trainer in the published
+boba² runs (BASELINE.md; AReaL does not publish MFU directly, 0.20 is the
+standard H800 Megatron figure for this class of run).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+BASELINE_TRAINER_MFU = 0.20
+
+# bf16 peak FLOP/s per chip by device kind substring.
+PEAK_FLOPS = [
+    ("v6", 918e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),  # v5p
+    ("v4", 275e12),
+]
+
+
+def peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for sub, f in PEAK_FLOPS:
+        if sub in kind:
+            return f
+    return 100e12  # unknown accelerator / CPU: nominal figure
+
+
+def count_params(params) -> int:
+    import jax
+
+    return int(sum(np.prod(x.shape) for x in jax.tree.leaves(params)))
+
+
+def main() -> None:
+    import jax
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.sft.lm_engine import JaxLMEngine
+    from areal_tpu.models.qwen2 import ModelConfig
+    from areal_tpu.utils.data import pad_sequences_to_tensors
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+
+    if on_accel:
+        model = ModelConfig(
+            vocab_size=151936,
+            hidden_size=896,
+            intermediate_size=4864,
+            num_hidden_layers=24,
+            num_attention_heads=14,
+            num_key_value_heads=2,
+            tie_word_embeddings=True,
+            dtype="bfloat16",
+            param_dtype="bfloat16",
+            remat=True,
+            scan_layers=True,
+        )
+        tokens_per_step = 4096
+        seq_len = 512
+        warmup, iters = 2, 8
+    else:  # CPU smoke fallback so the harness always emits a line
+        model = ModelConfig(
+            vocab_size=1024,
+            hidden_size=128,
+            intermediate_size=256,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            dtype="float32",
+            param_dtype="float32",
+        )
+        tokens_per_step = 512
+        seq_len = 128
+        warmup, iters = 1, 3
+
+    cfg = TrainEngineConfig(
+        experiment_name="bench",
+        trial_name="b",
+        path="",
+        init_from_scratch=True,
+        dtype=model.dtype,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=tokens_per_step + seq_len),
+        optimizer=OptimizerConfig(
+            lr=1e-4,
+            warmup_steps_proportion=0.0,
+            lr_scheduler_type="constant",
+            gradient_clipping=1.0,
+        ),
+        gradient_checkpointing=model.remat,
+    )
+    eng = JaxLMEngine(cfg)
+    eng.model_config = model
+    eng.create_process_group(ParallelStrategy())
+    eng.initialize(None, FinetuneSpec(1, 1000, 1))
+
+    rng = np.random.RandomState(0)
+    n_seqs = tokens_per_step // seq_len
+    seqs = []
+    for _ in range(n_seqs):
+        ids = rng.randint(1, model.vocab_size, (seq_len,))
+        mask = np.ones(seq_len, dtype=np.int32)
+        seqs.append(dict(input_ids=ids, loss_mask=mask))
+    batch = pad_sequences_to_tensors(seqs)
+
+    for _ in range(warmup):
+        eng.train_lm(batch)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        eng.train_lm(batch)
+    dt = (time.perf_counter() - t0) / iters
+
+    n_params = count_params(eng.params)
+    # 6ND dense matmul FLOPs + causal attention term 6·L·T·ctx·H (fwd+bwd).
+    attn_flops = (
+        6 * model.num_hidden_layers * tokens_per_step * seq_len
+        * model.num_attention_heads * (model.hidden_size // model.num_attention_heads)
+    )
+    flops = 6 * n_params * tokens_per_step + attn_flops
+    mfu = flops / dt / peak_flops(dev.device_kind)
+    tokens_per_sec = tokens_per_step / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "trainer_mfu_qwen2.5-0.5b_bf16_packed_sft"
+                if on_accel
+                else "trainer_mfu_cpu_smoke",
+                "value": round(mfu, 4),
+                "unit": "fraction_of_peak",
+                "vs_baseline": round(mfu / BASELINE_TRAINER_MFU, 3),
+                "detail": {
+                    "device": dev.device_kind,
+                    "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+                    "step_time_s": round(dt, 4),
+                    "n_params": n_params,
+                    "tokens_per_step": tokens_per_step,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
