@@ -102,7 +102,12 @@ class JaxDecodeEngine(InferenceEngine):
         self._request_q: queue.Queue = queue.Queue()
         self._shutdown = threading.Event()
         self._gen_paused = threading.Event()
-        self._idle = threading.Event()
+        # Serialises scheduler work (admit + chunk) against pause/abort.
+        # pause_generation sets the flag then acquires this lock once: any
+        # in-flight chunk has finished, and the flag is re-checked under the
+        # lock so no new chunk can start — a race-free handshake regardless
+        # of how long the first XLA compile takes.
+        self._sched_lock = threading.Lock()
         self._weight_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._thread_exc: BaseException | None = None
@@ -383,19 +388,21 @@ class JaxDecodeEngine(InferenceEngine):
         R = self.config.max_running_requests
         try:
             while not self._shutdown.is_set():
-                if self._gen_paused.is_set():
-                    self._idle.set()
+                with self._sched_lock:
+                    if self._gen_paused.is_set():
+                        paused, worked = True, False
+                    else:
+                        paused = False
+                        admitted = self._admit()
+                        active = self._active_mask()
+                        worked = bool(active.any())
+                        if worked:
+                            self._run_chunk(active)
+                        worked = worked or admitted
+                if paused:
                     time.sleep(0.005)
-                    continue
-                admitted = self._admit()
-                active = self._active_mask()
-                if not active.any():
-                    self._idle.set()
-                    if not admitted:
-                        time.sleep(0.002)
-                    continue
-                self._idle.clear()
-                self._run_chunk(active)
+                elif not worked:
+                    time.sleep(0.002)
         except BaseException as e:  # noqa: BLE001
             self._thread_exc = e
             logger.error(
@@ -529,12 +536,43 @@ class JaxDecodeEngine(InferenceEngine):
         self._executor.resume()
 
     def pause_generation(self):
-        """Pause on the next chunk boundary and wait until idle."""
+        """Pause on the next chunk boundary; returns once the scheduler has
+        quiesced (blocks through an in-flight chunk, however long its first
+        compile takes)."""
         self._gen_paused.set()
-        self._idle.wait(timeout=30)
+        with self._sched_lock:
+            pass
 
     def continue_generation(self):
         self._gen_paused.clear()
+
+    def abort_all(self) -> int:
+        """Retire every in-flight and queued request with stop_reason
+        "interrupt", returning partial outputs to their callers.
+
+        This is the server-side half of the reference's interruptible
+        generation (remote_inf_engine.py:428-478): on a weight update the
+        servers flush in-flight requests; clients accumulate the partial
+        tokens and re-submit. Call only while paused (scheduler idle).
+        """
+        assert self._gen_paused.is_set(), "abort_all requires pause_generation"
+        n = 0
+        with self._sched_lock:
+            for i, s in enumerate(self._slots):
+                if s is None:
+                    continue
+                s.stop_reason = "interrupt"
+                self._retire(i)
+                n += 1
+            while True:
+                try:
+                    item = self._request_q.get_nowait()
+                except queue.Empty:
+                    break
+                item.stop_reason = "interrupt"
+                self._complete(item, stop_reason="interrupt")
+                n += 1
+        return n
 
     # -- weight updates -------------------------------------------------
     def init_weights_update_group(self, meta: WeightUpdateMeta):

@@ -1,0 +1,252 @@
+"""HTTP decode server: the TPU-native analogue of an SGLang/vLLM server.
+
+Wraps a `JaxDecodeEngine` behind the JSON-over-HTTP control plane that
+`RemoteInfEngine` speaks. Parity targets: the server side of
+areal/engine/sglang_remote.py (endpoint set) and
+areal/launcher/sglang_server.py (subprocess wrapper: health wait +
+name_resolve registration).
+
+Endpoints:
+  GET  /health                  -> {"status": "ok", "version": N}
+  GET  /info                    -> model/config metadata
+  POST /generate                -> one completion w/ token logprobs+versions
+  POST /pause_generation        -> pause on chunk boundary; {"abort": true}
+                                   flushes in-flight requests, which return
+                                   stop_reason="interrupt" (partial rollout)
+  POST /continue_generation
+  POST /update_weights_from_disk  {"path": ..., "version": optional}
+  POST /set_version               {"version": N}
+
+Generation runs on the engine's background scheduler thread; the aiohttp
+loop only brokers futures, so thousands of streams multiplex over one
+static-shape decode program.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import os
+import socket
+from typing import Any
+
+from aiohttp import web
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxDecodeConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest, WeightUpdateMeta
+from areal_tpu.utils import logging, names
+from areal_tpu.utils import name_resolve
+
+logger = logging.getLogger("decode_server")
+
+_GCONFIG_FIELDS = {f.name for f in dataclasses.fields(GenerationHyperparameters)}
+
+
+def _parse_gconfig(d: dict[str, Any]) -> GenerationHyperparameters:
+    return GenerationHyperparameters(
+        **{k: v for k, v in d.items() if k in _GCONFIG_FIELDS}
+    )
+
+
+class DecodeServer:
+    def __init__(
+        self,
+        config: JaxDecodeConfig,
+        inference_config: InferenceEngineConfig | None = None,
+        tokenizer: Any = None,
+        engine: Any = None,
+    ):
+        from areal_tpu.engine.jax_decode import JaxDecodeEngine
+
+        self.config = config
+        self.engine = engine or JaxDecodeEngine(
+            config, inference_config or InferenceEngineConfig(), tokenizer
+        )
+        self._owns_engine = engine is None
+        self._runner: web.AppRunner | None = None
+        self.addr: str | None = None
+
+    # -- handlers -------------------------------------------------------
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "version": self.engine.get_version()}
+        )
+
+    async def _info(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "model_path": self.config.model_path,
+                "context_length": self.config.context_length,
+                "max_running_requests": self.config.max_running_requests,
+                "version": self.engine.get_version(),
+            }
+        )
+
+    async def _generate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        req = ModelRequest(
+            rid=body.get("rid") or ModelRequest().rid,
+            input_ids=[int(t) for t in body["input_ids"]],
+            gconfig=_parse_gconfig(body.get("gconfig", {})),
+        )
+        resp = await self.engine.agenerate(req)
+        return web.json_response(
+            {
+                "output_tokens": resp.output_tokens,
+                "output_logprobs": resp.output_logprobs,
+                "output_versions": resp.output_versions,
+                "stop_reason": resp.stop_reason,
+                "latency": resp.latency,
+                "ttft": resp.ttft,
+            }
+        )
+
+    async def _pause(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        # pause_generation blocks until the scheduler is idle — run it off
+        # the event loop so in-flight /generate futures can resolve.
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.pause_generation
+        )
+        aborted = 0
+        if body.get("abort"):
+            aborted = self.engine.abort_all()
+        return web.json_response({"status": "ok", "aborted": aborted})
+
+    async def _continue(self, request: web.Request) -> web.Response:
+        self.engine.continue_generation()
+        return web.json_response({"status": "ok"})
+
+    async def _update_weights_from_disk(
+        self, request: web.Request
+    ) -> web.Response:
+        body = await request.json()
+        meta = WeightUpdateMeta(type="disk", path=body["path"])
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.engine.update_weights_from_disk, meta
+        )
+        if "version" in body and body["version"] is not None:
+            self.engine.set_version(int(body["version"]))
+        return web.json_response(
+            {"status": "ok", "version": self.engine.get_version()}
+        )
+
+    async def _set_version(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        self.engine.set_version(int(body["version"]))
+        return web.json_response({"status": "ok"})
+
+    # -- lifecycle ------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1024**3)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/info", self._info)
+        app.router.add_post("/generate", self._generate)
+        app.router.add_post("/pause_generation", self._pause)
+        app.router.add_post("/continue_generation", self._continue)
+        app.router.add_post(
+            "/update_weights_from_disk", self._update_weights_from_disk
+        )
+        app.router.add_post("/set_version", self._set_version)
+        return app
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> str:
+        if self._owns_engine:
+            self.engine.initialize()
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual_port = self._runner.addresses[0][1]
+        ip = _local_ip() if host in ("0.0.0.0", "::") else host
+        self.addr = f"{ip}:{actual_port}"
+        logger.info(f"decode server listening on {self.addr}")
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        if self._owns_engine:
+            self.engine.destroy()
+
+    def register(self, experiment_name: str, trial_name: str, server_id: str):
+        assert self.addr is not None
+        name_resolve.add(
+            names.gen_server(experiment_name, trial_name, server_id),
+            self.addr,
+            keepalive_ttl=None,
+            replace=True,
+        )
+
+
+def _local_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
+async def _serve(args: argparse.Namespace) -> None:
+    config = JaxDecodeConfig(
+        model_path=args.model_path,
+        dtype=args.dtype,
+        context_length=args.context_length,
+        max_running_requests=args.max_running_requests,
+        new_tokens_per_chunk=args.new_tokens_per_chunk,
+        random_seed=args.seed,
+    )
+    tokenizer = None
+    if args.model_path and not args.skip_tokenizer_init:
+        try:
+            from transformers import AutoTokenizer
+
+            tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"tokenizer load failed ({e}); stop-on-eos disabled")
+    server = DecodeServer(config, tokenizer=tokenizer)
+    await server.start(args.host, args.port)
+    if args.experiment_name and args.trial_name:
+        server.register(
+            args.experiment_name, args.trial_name, args.server_id or server.addr
+        )
+    stop = asyncio.Event()
+    try:
+        await stop.wait()
+    finally:
+        await server.stop()
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="areal_tpu decode server")
+    p.add_argument("--model-path", default="")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--context-length", type=int, default=32768)
+    p.add_argument("--max-running-requests", type=int, default=64)
+    p.add_argument("--new-tokens-per-chunk", type=int, default=128)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 0)))
+    p.add_argument("--experiment-name", default=os.environ.get("AREAL_EXPERIMENT_NAME", ""))
+    p.add_argument("--trial-name", default=os.environ.get("AREAL_TRIAL_NAME", ""))
+    p.add_argument("--server-id", default="")
+    p.add_argument("--skip-tokenizer-init", action="store_true")
+    args = p.parse_args(argv)
+    asyncio.run(_serve(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Async HTTP helpers for the rollout control plane.
+
+Parity target: areal/utils/http.py (arequest_with_retry over aiohttp with
+per-endpoint retries and pooled connectors). The decode-server protocol is
+JSON-over-HTTP exactly like the reference's SGLang/vLLM control plane; only
+the payload schema differs (see areal_tpu/launcher/decode_server.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import weakref
+from typing import Any
+
+import aiohttp
+
+DEFAULT_RETRIES = 3
+DEFAULT_REQUEST_TIMEOUT = 3600.0
+
+
+class HttpRequestError(Exception):
+    def __init__(self, message: str, status: int | None = None):
+        super().__init__(message)
+        self.status = status
+
+
+# One pooled ClientSession per event loop. aiohttp sessions are bound to the
+# loop that created them; the rollout executor runs its own background loop
+# and short-lived `asyncio.run` loops appear for fanout RPCs, so key weakly
+# by the loop object (id()-keying would alias dead loops on address reuse).
+_sessions: "weakref.WeakKeyDictionary[asyncio.AbstractEventLoop, aiohttp.ClientSession]" = (
+    weakref.WeakKeyDictionary()
+)
+
+
+def _get_session(timeout: float) -> aiohttp.ClientSession:
+    loop = asyncio.get_running_loop()
+    sess = _sessions.get(loop)
+    if sess is None or sess.closed:
+        sess = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout, sock_connect=30),
+            connector=aiohttp.TCPConnector(limit=0, ttl_dns_cache=300),
+        )
+        _sessions[loop] = sess
+    return sess
+
+
+async def close_current_session() -> None:
+    """Close the pooled session of the running loop (call before the loop
+    exits in short-lived `asyncio.run` scopes to avoid leaking sockets)."""
+    loop = asyncio.get_running_loop()
+    sess = _sessions.pop(loop, None)
+    if sess is not None and not sess.closed:
+        await sess.close()
+
+
+async def arequest_with_retry(
+    addr: str,
+    endpoint: str,
+    payload: dict[str, Any] | None = None,
+    method: str = "POST",
+    max_retries: int = DEFAULT_RETRIES,
+    timeout: float = DEFAULT_REQUEST_TIMEOUT,
+    retry_delay: float = 1.0,
+) -> dict[str, Any]:
+    """POST/GET `http://{addr}{endpoint}`, return parsed JSON; retry on
+    connection errors and 5xx. 4xx raise immediately."""
+    last_exc: Exception | None = None
+    url = f"http://{addr}{endpoint}"
+    for attempt in range(max_retries):
+        try:
+            session = _get_session(timeout)
+            async with session.request(
+                method, url, json=payload if method != "GET" else None
+            ) as resp:
+                if resp.status >= 400:
+                    raise HttpRequestError(
+                        f"{url} -> {resp.status}: {await resp.text()}",
+                        status=resp.status,
+                    )
+                return await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, HttpRequestError) as e:
+            if (
+                isinstance(e, HttpRequestError)
+                and e.status is not None
+                and e.status < 500
+            ):
+                raise
+            last_exc = e
+            if attempt + 1 < max_retries:
+                await asyncio.sleep(retry_delay * (2**attempt))
+    raise HttpRequestError(
+        f"request to {url} failed after {max_retries} retries"
+    ) from last_exc
+
+
+async def aget_with_retry(
+    addr: str, endpoint: str, **kw: Any
+) -> dict[str, Any]:
+    return await arequest_with_retry(addr, endpoint, method="GET", **kw)
+
+
+async def wait_server_healthy(
+    addr: str, timeout: float = 120.0, interval: float = 1.0
+) -> None:
+    """Poll GET /health until it returns 200 or `timeout` elapses."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        try:
+            await arequest_with_retry(
+                addr, "/health", method="GET", max_retries=1, timeout=10
+            )
+            return
+        except Exception:
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(f"server {addr} not healthy after {timeout}s")
+            await asyncio.sleep(interval)

@@ -1,0 +1,189 @@
+"""Remote inference stack: DecodeServer (HTTP) + RemoteInfEngine client.
+
+Covers the control-plane parity surface of areal/core/remote_inf_engine.py +
+areal/engine/sglang_remote.py: /generate round-trips with logprobs+versions,
+greedy parity with the in-process engine, pause-with-abort producing
+"interrupt" partials that the client resumes, version fanout, and rid→server
+affinity.
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxDecodeConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.engine.jax_decode import JaxDecodeEngine
+from areal_tpu.launcher.decode_server import DecodeServer
+from areal_tpu.models.qwen2 import ModelConfig, init_params
+
+TINY = ModelConfig(
+    vocab_size=64,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    dtype="float32",
+    param_dtype="float32",
+)
+
+
+class _ServerThread:
+    """Run a DecodeServer on a private event loop in a daemon thread."""
+
+    def __init__(self, engine: JaxDecodeEngine):
+        self.server = DecodeServer(
+            JaxDecodeConfig(), engine=engine
+        )
+        self.addr = None
+        self._loop = None
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(30), "server failed to start"
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def _start():
+            self.addr = await self.server.start(host="127.0.0.1", port=0)
+            self._ready.set()
+
+        self._loop.run_until_complete(_start())
+        self._loop.run_forever()
+
+    def stop(self):
+        async def _stop():
+            await self.server.stop()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_stop(), self._loop)
+        self._thread.join(timeout=10)
+
+
+@pytest.fixture(scope="module")
+def served_engine(cpu_devices):
+    cfg = JaxDecodeConfig(
+        context_length=96,
+        max_running_requests=4,
+        new_tokens_per_chunk=4,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    st = _ServerThread(eng)
+    yield eng, st.addr
+    st.stop()
+    eng.destroy()
+
+
+@pytest.fixture(scope="module")
+def client(served_engine):
+    _, addr = served_engine
+    c = RemoteInfEngine(
+        InferenceEngineConfig(setup_timeout=30, request_timeout=60)
+    )
+    c.initialize(addr=addr)
+    yield c
+    c.destroy()
+
+
+def _greedy_req(prompt, n_new, rid=None):
+    g = GenerationHyperparameters(greedy=True, max_new_tokens=n_new)
+    kw = {"input_ids": prompt, "gconfig": g}
+    if rid:
+        kw["rid"] = rid
+    return ModelRequest(**kw)
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_generate_roundtrip_matches_local(served_engine, client):
+    eng, _ = served_engine
+    prompt = [3, 14, 15, 9, 2]
+    local = eng.generate(_greedy_req(prompt, 12))
+    remote = _run(client.agenerate(_greedy_req(prompt, 12)))
+    assert remote.output_tokens == local.output_tokens
+    np.testing.assert_allclose(
+        remote.output_logprobs, local.output_logprobs, rtol=1e-5, atol=1e-6
+    )
+    assert remote.output_versions == local.output_versions
+    assert remote.stop_reason in ("stop", "length")
+    assert len(remote.output_tokens) == 12
+
+
+def test_concurrent_remote_generations(client):
+    async def _many():
+        reqs = [_greedy_req([i + 1, i + 2, i + 3], 8) for i in range(8)]
+        return await asyncio.gather(*[client.agenerate(r) for r in reqs])
+
+    resps = _run(_many())
+    assert all(len(r.output_tokens) == 8 for r in resps)
+
+
+def test_interrupt_resume_loop(served_engine, client):
+    """Pause+abort mid-generation; the client must resume transparently and
+    the final sequence must equal an uninterrupted greedy decode."""
+    eng, _ = served_engine
+    prompt = [5, 11, 7]
+    uninterrupted = eng.generate(_greedy_req(prompt, 24)).output_tokens
+
+    result = {}
+
+    def _bg():
+        result["resp"] = _run(client.agenerate(_greedy_req(prompt, 24)))
+
+    t = threading.Thread(target=_bg)
+    t.start()
+    # let some chunks land, then flush in-flight requests like a weight
+    # update would
+    import time
+
+    interrupted = False
+    for _ in range(50):
+        time.sleep(0.05)
+        if result.get("resp"):
+            break
+        eng.pause_generation()
+        if any(s is not None for s in eng._slots):
+            eng.abort_all()
+            interrupted = True
+        eng.continue_generation()
+        if interrupted:
+            break
+    t.join(timeout=60)
+    assert not t.is_alive()
+    resp = result["resp"]
+    assert resp.output_tokens == uninterrupted
+    assert len(resp.output_logprobs) == 24
+    assert len(resp.output_versions) == 24
+
+
+def test_set_version_fans_out(served_engine, client):
+    eng, _ = served_engine
+    client.set_version(7)
+    assert eng.get_version() == 7
+    resp = _run(client.agenerate(_greedy_req([1, 2, 3], 4)))
+    assert all(v == 7 for v in resp.output_versions)
+    client.set_version(0)
+
+
+def test_rid_affinity_and_round_robin(client):
+    a1 = client.choose_server("rid-x")
+    a2 = client.choose_server("rid-x")
+    assert a1 == a2  # affinity caches the first assignment
