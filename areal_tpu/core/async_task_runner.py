@@ -97,6 +97,14 @@ class AsyncTaskRunner:
             for t in pending:
                 t.cancel()
             await asyncio.gather(*pending, return_exceptions=True)
+        # Close this loop's pooled HTTP session (workflows issue generation
+        # requests from this loop) before the loop itself is torn down.
+        try:
+            from areal_tpu.utils.http import close_current_session
+
+            await close_current_session()
+        except Exception:  # pragma: no cover - best-effort cleanup
+            pass
 
     async def _execute(self, task_id: int, factory, meta: dict):
         start = time.monotonic()

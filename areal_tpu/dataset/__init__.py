@@ -7,7 +7,6 @@ framework's item schema: {"messages" | "prompt" | "input_ids", "answer"}.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Callable
 
 from areal_tpu.utils import logging
@@ -59,13 +58,13 @@ def _gsm8k(path: str, split: str, type: str, tokenizer=None, max_length=None, **
     answer)."""
     import datasets as hf_datasets
 
-    # honour an explicit local path / mirror; the hub ids need the "main"
-    # builder config (openai/gsm8k has no default config)
-    if path and path not in ("gsm8k", "openai/gsm8k") and os.path.exists(path):
-        ds = hf_datasets.load_dataset(path, split=split)
+    # The canonical hub ids need the "main" builder config (openai/gsm8k has
+    # no default); local mirrors load as-is; anything else passes through to
+    # load_dataset so typos fail loudly.
+    if path in ("", "gsm8k", "openai/gsm8k", None):
+        ds = hf_datasets.load_dataset("openai/gsm8k", "main", split=split)
     else:
-        hub = path if path and "/" in path and not os.path.exists(path) else "openai/gsm8k"
-        ds = hf_datasets.load_dataset(hub, "main", split=split)
+        ds = hf_datasets.load_dataset(path, split=split)
 
     def to_item(x):
         answer = x["answer"].split("####")[-1].strip()

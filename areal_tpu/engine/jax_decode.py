@@ -581,8 +581,13 @@ class JaxDecodeEngine(InferenceEngine):
     def update_weights_from_distributed(
         self, meta: WeightUpdateMeta, params=None, model_config=None
     ):
-        """Colocated fast path: install trainer-provided sharded arrays."""
+        """Colocated fast path: install trainer-provided sharded arrays.
+
+        If the caller already paused generation explicitly, it stays paused
+        afterwards (an external /pause_generation is not cancelled by the
+        weight swap's internal pause)."""
         assert params is not None
+        was_paused = self._gen_paused.is_set()
         self.pause_generation()
         try:
             with self._weight_lock:
@@ -601,17 +606,22 @@ class JaxDecodeEngine(InferenceEngine):
                         # change for the same run
                         self.model_config = decode_cfg
         finally:
-            self.continue_generation()
+            if not was_paused:
+                self.continue_generation()
 
     def update_weights_from_disk(self, meta: WeightUpdateMeta):
+        """Reload weights from an HF checkpoint dir. Preserves an external
+        pause (see update_weights_from_distributed)."""
         assert meta.path is not None
+        was_paused = self._gen_paused.is_set()
         self.pause_generation()
         try:
             with self._weight_lock:
                 host = hf_io.load_hf_params(meta.path, self.model_config)
                 self.params = jax.tree.map(jnp.asarray, host)
         finally:
-            self.continue_generation()
+            if not was_paused:
+                self.continue_generation()
 
     def set_version(self, version: int) -> None:
         self._version = version

@@ -182,6 +182,7 @@ class JaxTrainEngine(TrainEngine):
                 param_dtype=cfg.dtype,
                 remat=cfg.gradient_checkpointing,
                 scan_layers=cfg.jax.scan_layers,
+                is_critic=cfg.is_critic,
             )
             self.model_config = ModelConfig.from_hf_config(cfg.path, **overrides)
 

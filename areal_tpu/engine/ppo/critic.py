@@ -1,0 +1,104 @@
+"""PPO critic: value prediction + value-clipped regression updates.
+
+Parity target: areal/engine/ppo/critic.py (PPOCritic / FSDPPPOCritic) and
+areal/utils/functional.py ppo_critic_loss_fn. The critic shares the decoder
+trunk with the actor but ends in a scalar value head
+(ModelConfig.is_critic); `compute_values` fills data["values"] which the
+actor's GAE consumes, and `ppo_update` regresses onto data["returns"].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.api.cli_args import PPOCriticConfig
+from areal_tpu.api.engine_api import TrainEngine
+from areal_tpu.engine.jax_engine import JaxTrainEngine
+from areal_tpu.engine.ppo.actor import _split_minibatches
+from areal_tpu.utils import stats_tracker
+from areal_tpu.utils.functional import ppo_critic_loss_fn
+
+
+class PPOCritic:
+    def __init__(self, config: PPOCriticConfig, engine: TrainEngine):
+        self.config = config
+        self.engine = engine
+        self._loss_fn = functools.partial(
+            critic_loss_fn, value_eps_clip=config.eps_clip
+        )
+        self._value_hook = lambda values, mb: values
+
+    # ------------------------------------------------------------------
+    def compute_values(self, data: dict[str, Any]) -> np.ndarray:
+        """Token values under current weights, re-padded to [B, T]."""
+        self.engine.eval()
+        flat = self.engine.forward(
+            input_=data,
+            post_hook=self._value_hook,
+            aggregate_fn=list,
+        )
+        B, T = data["input_ids"].shape
+        out = np.zeros((B, T), dtype=np.float32)
+        for i, seq in enumerate(flat):
+            out[i, : len(seq)] = np.asarray(seq)
+        return out
+
+    # ------------------------------------------------------------------
+    def ppo_update(self, data: dict[str, Any]) -> list[dict[str, float]]:
+        """Value regression over ppo_n_minibatches (expects the batch dict
+        AFTER PPOActor.compute_advantages: values/returns/loss_mask set)."""
+        cfg = self.config
+        data = {
+            k: v
+            for k, v in data.items()
+            if k
+            not in ("rewards", "tot_rewards", "kl_rewards", "versions",
+                    "advantages", "prox_logp", "logprobs", "ref_logp")
+        }
+        self.engine.train()
+        all_stats = []
+        for mb in _split_minibatches(data, cfg.ppo_n_minibatches):
+            train_stat = self.engine.train_batch(
+                mb,
+                loss_fn=self._loss_fn,
+                loss_weight_fn=lambda x: float(
+                    np.asarray(x["loss_mask"]).sum()
+                ),
+            )
+            stats_tracker.scalar(**{f"critic_{k}": v for k, v in train_stat.items()})
+            all_stats.append(stats_tracker.export_all())
+        return all_stats
+
+
+def critic_loss_fn(values, mb: dict[str, Any], value_eps_clip: float):
+    """Packed critic loss: clip the value update around the old values
+    (parity: critic.py loss fn)."""
+    loss, _stat = ppo_critic_loss_fn(
+        value=values,
+        old_value=mb["values"],
+        target_value=mb["returns"],
+        value_eps_clip=value_eps_clip,
+        loss_mask=mb["loss_mask"],
+    )
+    return loss
+
+
+class JaxPPOCritic(JaxTrainEngine):
+    """TrainEngine + critic algorithms in one object (parity: FSDPPPOCritic)."""
+
+    def __init__(self, config: PPOCriticConfig):
+        import dataclasses
+
+        if not config.is_critic:
+            config = dataclasses.replace(config, is_critic=True)
+        super().__init__(config)
+        self.critic = PPOCritic(config, self)
+
+    def compute_values(self, *args, **kwargs) -> np.ndarray:
+        return self.critic.compute_values(*args, **kwargs)
+
+    def ppo_update(self, *args, **kwargs) -> list[dict[str, float]]:
+        return self.critic.ppo_update(*args, **kwargs)

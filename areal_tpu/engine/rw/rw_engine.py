@@ -1,0 +1,116 @@
+"""Pairwise reward-model training (Bradley–Terry).
+
+Parity target: areal/engine/rw/rw_engine.py:15 — each training sample is a
+(chosen, rejected) pair; the model is the scalar-value-head critic and the
+loss is -log sigmoid(score_chosen − score_rejected) with scores read at each
+sequence's final token.
+
+TPU mapping: pairs are kept intact through micro-batching via
+MicroBatchSpec.granularity=2 (the same mechanism that keeps GRPO groups
+together), so inside the jit the k-th pair is segments (2k, 2k+1) of the
+packed stream and the pairwise loss is two segment_sums — no dynamic
+shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.cli_args import TrainEngineConfig
+from areal_tpu.engine.jax_engine import JaxTrainEngine
+from areal_tpu.utils import stats_tracker
+
+
+def rw_pairwise_loss(values: jax.Array, mb: dict[str, Any]) -> jax.Array:
+    """Packed Bradley–Terry loss.
+
+    `rw_seq_end` marks each sequence's final real token (host-built, so the
+    pad tail is all zeros). Segment k belongs to pair k//2 with sign + for
+    chosen (even) / − for rejected (odd); a valid pair has exactly two end
+    markers, which excludes the fake pad segment automatically.
+    """
+    seg = mb["segment_ids"]
+    is_end = mb["rw_seq_end"].astype(values.dtype)
+    pair = seg // 2
+    sign = 1.0 - 2.0 * (seg % 2).astype(values.dtype)
+    K = seg.shape[0] // 2 + 1  # static cap on pair count
+    diff = jax.ops.segment_sum(values * is_end * sign, pair, num_segments=K)
+    cnt = jax.ops.segment_sum(
+        mb["rw_seq_end"].astype(jnp.int32), pair, num_segments=K
+    )
+    valid = (cnt == 2).astype(values.dtype)
+    loss = -(jax.nn.log_sigmoid(diff) * valid).sum() / jnp.maximum(
+        valid.sum(), 1.0
+    )
+    return loss
+
+
+def _attach_seq_end(data: dict[str, Any]) -> dict[str, Any]:
+    """Add the [B, T] end-of-sequence marker derived from attention_mask."""
+    am = np.asarray(data["attention_mask"])
+    B = am.shape[0]
+    lens = am.sum(-1).astype(np.int64)
+    end = np.zeros_like(am)
+    end[np.arange(B), np.clip(lens - 1, 0, None)] = 1
+    out = dict(data)
+    out["rw_seq_end"] = end
+    return out
+
+
+class JaxRWEngine(JaxTrainEngine):
+    """Reward-model engine (parity: FSDPRWEngine)."""
+
+    def __init__(self, config: TrainEngineConfig):
+        if not config.is_critic:
+            config = dataclasses.replace(config, is_critic=True)
+        if config.mb_spec.granularity % 2 != 0:
+            config = dataclasses.replace(
+                config,
+                mb_spec=dataclasses.replace(config.mb_spec, granularity=2),
+            )
+        super().__init__(config)
+
+    def train_rw(self, data: dict[str, Any]) -> dict[str, float]:
+        """One optimizer step on a padded pair batch: rows (2i, 2i+1) are
+        the (chosen, rejected) halves of pair i."""
+        assert data["input_ids"].shape[0] % 2 == 0, "RW batch must be pairs"
+        data = _attach_seq_end(data)
+        self.train()
+        stat = self.train_batch(
+            data,
+            loss_fn=rw_pairwise_loss,
+            loss_weight_fn=lambda mb: float(
+                np.asarray(mb["rw_seq_end"]).sum() / 2
+            ),
+        )
+        stats_tracker.scalar(**{f"rw_{k}": v for k, v in stat.items()})
+        return stat
+
+    def eval_rw(self, data: dict[str, Any]) -> float:
+        data = _attach_seq_end(data)
+        self.eval()
+        return self.eval_batch(
+            data,
+            loss_fn=rw_pairwise_loss,
+            loss_weight_fn=lambda mb: float(
+                np.asarray(mb["rw_seq_end"]).sum() / 2
+            ),
+        )
+
+    def compute_scores(self, data: dict[str, Any]) -> np.ndarray:
+        """Per-sequence reward scores (value at the final real token)."""
+        self.eval()
+        flat = self.forward(
+            input_=data, post_hook=lambda v, mb: v, aggregate_fn=list
+        )
+        lens = np.asarray(data["attention_mask"]).sum(-1).astype(np.int64)
+        return np.array(
+            [float(np.asarray(seq)[l - 1]) for seq, l in zip(flat, lens)],
+            dtype=np.float32,
+        )

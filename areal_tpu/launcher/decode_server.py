@@ -70,6 +70,9 @@ class DecodeServer:
         self._owns_engine = engine is None
         self._runner: web.AppRunner | None = None
         self.addr: str | None = None
+        # Set by /pause_generation, cleared by /continue_generation: a weight
+        # update must not cancel a pause the client asked for explicitly.
+        self._client_paused = False
 
     # -- handlers -------------------------------------------------------
     async def _health(self, request: web.Request) -> web.Response:
@@ -113,6 +116,7 @@ class DecodeServer:
             body = {}
         # pause_generation blocks until the scheduler is idle — run it off
         # the event loop so in-flight /generate futures can resolve.
+        self._client_paused = True
         await asyncio.get_running_loop().run_in_executor(
             None, self.engine.pause_generation
         )
@@ -122,6 +126,7 @@ class DecodeServer:
         return web.json_response({"status": "ok", "aborted": aborted})
 
     async def _continue(self, request: web.Request) -> web.Response:
+        self._client_paused = False
         self.engine.continue_generation()
         return web.json_response({"status": "ok"})
 
@@ -130,12 +135,21 @@ class DecodeServer:
     ) -> web.Response:
         body = await request.json()
         meta = WeightUpdateMeta(type="disk", path=body["path"])
-        loop = asyncio.get_running_loop()
-        await loop.run_in_executor(
-            None, self.engine.update_weights_from_disk, meta
-        )
-        if "version" in body and body["version"] is not None:
-            self.engine.set_version(int(body["version"]))
+        version = body.get("version")
+
+        def _swap():
+            # Hold the pause across swap + version bump so no token is ever
+            # produced by the new weights under the old version stamp.
+            self.engine.pause_generation()
+            try:
+                self.engine.update_weights_from_disk(meta)
+                if version is not None:
+                    self.engine.set_version(int(version))
+            finally:
+                if not self._client_paused:
+                    self.engine.continue_generation()
+
+        await asyncio.get_running_loop().run_in_executor(None, _swap)
         return web.json_response(
             {"status": "ok", "version": self.engine.get_version()}
         )

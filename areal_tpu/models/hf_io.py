@@ -57,6 +57,10 @@ def hf_name_to_ours(name: str) -> tuple[str, ...] | None:
         return ("final_norm",)
     if name == "lm_head.weight":
         return ("lm_head", "kernel")
+    if name == "score.weight":  # HF TokenClassification value head
+        return ("value_head", "kernel")
+    if name == "score.bias":
+        return ("value_head", "bias")
     if name.startswith("layers."):
         parts = name.split(".")
         i = int(parts[1])
@@ -146,8 +150,17 @@ def assemble_params(
         tree[path[-1]] = value
 
     cast = lambda x: jnp.asarray(x, dtype=jnp.dtype(dtype))  # noqa: E731
-    if cfg.tie_word_embeddings:
+    if cfg.tie_word_embeddings or cfg.is_critic:
         flat = {p: w for p, w in flat.items() if p[0] != "lm_head"}
+    if cfg.is_critic and ("value_head", "kernel") not in flat:
+        # initializing a critic from a causal-LM checkpoint: fresh value head
+        flat[("value_head", "kernel")] = np.zeros(
+            (cfg.hidden_size, 1), dtype=np.float32
+        )
+    if cfg.is_critic and ("value_head", "bias") not in flat:
+        flat[("value_head", "bias")] = np.zeros((1,), dtype=np.float32)
+    if not cfg.is_critic:
+        flat = {p: w for p, w in flat.items() if p[0] != "value_head"}
     if cfg.scan_layers:
         L = cfg.num_hidden_layers
         layer_paths = sorted(
@@ -240,6 +253,10 @@ def ours_name_to_hf(path: tuple[str, ...]) -> str:
         return "model.norm.weight"
     if path == ("lm_head", "kernel"):
         return "lm_head.weight"
+    if path == ("value_head", "kernel"):
+        return "score.weight"
+    if path == ("value_head", "bias"):
+        return "score.bias"
     if path[0].startswith("layers_"):
         i = int(path[0].split("_")[1])
         return f"model.layers.{i}." + leaf_table[path[1:]]

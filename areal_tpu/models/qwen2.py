@@ -62,6 +62,10 @@ class ModelConfig:
     # compile-time toggles
     scan_layers: bool = True
     remat: bool = False
+    # critic/reward mode: scalar value head instead of the LM head
+    # (parity: the reference's AutoModelForTokenClassification path,
+    # areal/engine/base_hf_engine.py:180-187)
+    is_critic: bool = False
 
     @property
     def head_dim_(self) -> int:
@@ -167,7 +171,9 @@ def param_shapes(cfg: ModelConfig) -> dict:
         **layers_tree,
         "final_norm": (cfg.hidden_size,),
     }
-    if not cfg.tie_word_embeddings:
+    if cfg.is_critic:
+        out["value_head"] = {"kernel": (cfg.hidden_size, 1), "bias": (1,)}
+    elif not cfg.tie_word_embeddings:
         out["lm_head"] = {"kernel": (cfg.hidden_size, cfg.vocab_size)}
     return out
 
@@ -204,7 +210,9 @@ def param_logical_axes(cfg: ModelConfig) -> dict:
         **layers_tree,
         "final_norm": ("norm",),
     }
-    if not cfg.tie_word_embeddings:
+    if cfg.is_critic:
+        out["value_head"] = {"kernel": ("embed", "norm"), "bias": ("norm",)}
+    elif not cfg.tie_word_embeddings:
         out["lm_head"] = {"kernel": ("embed", "vocab")}
     return out
 
@@ -233,7 +241,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     # biases start at zero
     def zero_biases(path, x):
         name = path[-1].key if hasattr(path[-1], "key") else ""
-        if name.endswith("_bias"):
+        if name.endswith("_bias") or name == "bias":
             return jnp.zeros_like(x)
         return x
 
@@ -375,6 +383,12 @@ def forward(
             x = layer_fn(params[f"layers_{i}"], x, cos, sin, mask, cfg)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.is_critic:
+        values = (
+            jnp.einsum("th,hk->tk", x, params["value_head"]["kernel"])
+            + params["value_head"]["bias"]
+        )
+        return values[:, 0].astype(jnp.float32)
     if cfg.tie_word_embeddings:
         logits = jnp.einsum(
             "th,vh->tv", x, params["embed"]["embedding"].astype(compute_dtype)

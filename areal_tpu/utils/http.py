@@ -33,12 +33,15 @@ _sessions: "weakref.WeakKeyDictionary[asyncio.AbstractEventLoop, aiohttp.ClientS
 )
 
 
-def _get_session(timeout: float) -> aiohttp.ClientSession:
+def _get_session() -> aiohttp.ClientSession:
     loop = asyncio.get_running_loop()
     sess = _sessions.get(loop)
     if sess is None or sess.closed:
+        # No session-level total timeout: callers pass per-request timeouts
+        # (the session is shared by short health probes and hour-long
+        # generations on the same loop).
         sess = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=timeout, sock_connect=30),
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=30),
             connector=aiohttp.TCPConnector(limit=0, ttl_dns_cache=300),
         )
         _sessions[loop] = sess
@@ -69,9 +72,12 @@ async def arequest_with_retry(
     url = f"http://{addr}{endpoint}"
     for attempt in range(max_retries):
         try:
-            session = _get_session(timeout)
+            session = _get_session()
             async with session.request(
-                method, url, json=payload if method != "GET" else None
+                method,
+                url,
+                json=payload if method != "GET" else None,
+                timeout=aiohttp.ClientTimeout(total=timeout, sock_connect=30),
             ) as resp:
                 if resp.status >= 400:
                     raise HttpRequestError(

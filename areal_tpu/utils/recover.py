@@ -1,0 +1,173 @@
+"""Step-level fault recovery: checkpoint + restore of the full training state.
+
+Parity target: areal/utils/recover.py:29 (RecoverInfo) and :139
+(RecoverHandler). Each dump writes, atomically under a marker file:
+
+  {fileroot}/recover/{experiment}/{trial}/
+      recover_info.pkl   — StepInfo + saver/evaluator freq-gate state +
+                           dataloader position + engine version
+      checkpoint/        — HF-format weights + optimizer state (optim/)
+
+`load` restores engine weights+optimizer, dataloader position, and the
+freq-gate states, then the caller re-pushes weights into the inference
+servers and resumes from `recover_info.last_step_info.next()` — identical
+semantics to the reference's RecoverHandler.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+from dataclasses import dataclass, field
+from typing import Any
+
+from areal_tpu.api.cli_args import RecoverConfig
+from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, StepInfo
+from areal_tpu.utils import logging
+from areal_tpu.utils.timeutil import FrequencyControl
+
+logger = logging.getLogger("recover")
+
+_DONE_MARKER = "DONE"
+
+
+@dataclass
+class RecoverInfo:
+    last_step_info: StepInfo
+    saver_info: dict = field(default_factory=dict)
+    evaluator_info: dict = field(default_factory=dict)
+    dataloader_info: dict = field(default_factory=dict)
+    version: int = 0
+
+
+def recover_root(config: RecoverConfig) -> str:
+    return os.path.join(
+        config.fileroot, "recover", config.experiment_name, config.trial_name
+    )
+
+
+def check_if_auto_recover(config: RecoverConfig) -> bool:
+    """True when mode permits resuming AND a complete recover checkpoint
+    exists (reference `check_if_auto_recover`)."""
+    if config.mode not in ("auto", "resume", "fault"):
+        return False
+    root = recover_root(config)
+    return os.path.exists(os.path.join(root, _DONE_MARKER)) and os.path.exists(
+        os.path.join(root, "recover_info.pkl")
+    )
+
+
+class RecoverHandler:
+    def __init__(self, config: RecoverConfig, ft_spec: FinetuneSpec):
+        self.config = config
+        self.ft_spec = ft_spec
+        self.freq_ctl = FrequencyControl(
+            freq_epoch=config.freq_epochs,
+            freq_step=config.freq_steps,
+            freq_sec=config.freq_secs,
+        )
+
+    # -- dump -----------------------------------------------------------
+    def dump(
+        self,
+        engine,
+        step_info: StepInfo,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        tokenizer=None,
+        force: bool = False,
+    ) -> str | None:
+        if self.config.mode == "disabled":
+            return None
+        if not force and not self.freq_ctl.check(
+            epochs=int(step_info.epoch_step == step_info.steps_per_epoch - 1),
+            steps=1,
+        ):
+            return None
+        root = recover_root(self.config)
+        marker = os.path.join(root, _DONE_MARKER)
+        if os.path.exists(marker):
+            os.remove(marker)
+        ckpt = os.path.join(root, "checkpoint")
+        os.makedirs(ckpt, exist_ok=True)
+        engine.save(
+            SaveLoadMeta(
+                path=ckpt, weight_format="hf", with_optim=True, tokenizer=tokenizer
+            )
+        )
+        info = RecoverInfo(
+            last_step_info=step_info,
+            saver_info=saver.state_dict() if saver is not None else {},
+            evaluator_info=evaluator.state_dict() if evaluator is not None else {},
+            dataloader_info=(
+                dataloader.state_dict()
+                if dataloader is not None and hasattr(dataloader, "state_dict")
+                else {}
+            ),
+            version=engine.get_version(),
+        )
+        with open(os.path.join(root, "recover_info.pkl"), "wb") as f:
+            pickle.dump(info, f)
+        with open(marker, "w") as f:
+            f.write("ok")
+        logger.info(
+            f"dumped recover checkpoint at global_step "
+            f"{step_info.global_step} -> {root}"
+        )
+        return root
+
+    # -- load -----------------------------------------------------------
+    def load(
+        self,
+        engine,
+        saver=None,
+        evaluator=None,
+        dataloader=None,
+        inference_engine=None,
+        weight_update_meta=None,
+    ) -> RecoverInfo | None:
+        """Restore everything; returns the RecoverInfo (resume from
+        `.last_step_info.next()`) or None when no checkpoint exists."""
+        if not check_if_auto_recover(self.config):
+            return None
+        root = recover_root(self.config)
+        with open(os.path.join(root, "recover_info.pkl"), "rb") as f:
+            info: RecoverInfo = pickle.load(f)
+        engine.load(
+            SaveLoadMeta(
+                path=os.path.join(root, "checkpoint"),
+                weight_format="hf",
+                with_optim=True,
+            )
+        )
+        engine.set_version(info.version)
+        if saver is not None and info.saver_info:
+            saver.load_state_dict(info.saver_info)
+        if evaluator is not None and info.evaluator_info:
+            evaluator.load_state_dict(info.evaluator_info)
+        if dataloader is not None and info.dataloader_info:
+            dataloader.load_state_dict(info.dataloader_info)
+        if inference_engine is not None:
+            inference_engine.set_version(info.version)
+            if weight_update_meta is not None:
+                # re-push restored weights so decode servers match
+                engine.update_weights(weight_update_meta)
+        logger.info(
+            f"recovered from global_step {info.last_step_info.global_step} "
+            f"(version {info.version})"
+        )
+        return info
+
+    def state_dict(self) -> dict:
+        return self.freq_ctl.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.freq_ctl.load_state_dict(state)
+
+
+def discard_recover_state(config: RecoverConfig) -> None:
+    root = recover_root(config)
+    if os.path.exists(root):
+        shutil.rmtree(root)
