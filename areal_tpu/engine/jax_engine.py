@@ -166,6 +166,7 @@ class JaxTrainEngine(TrainEngine):
             jax.distributed.initialize()
         self.parallel_strategy = parallel_strategy
         self.mesh = mesh_lib.build_mesh(parallel_strategy)
+        mesh_lib.set_current_mesh(self.mesh)
         logger.info(
             f"mesh built: {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}"
         )
@@ -177,12 +178,18 @@ class JaxTrainEngine(TrainEngine):
         cfg = self.config
         self.ft_spec = ft_spec
         if self.model_config is None:
+            # config speaks "pallas"/"xla" (kernel choice); the model speaks
+            # "flash"/"dense" (algorithm). Same axis, different vocabulary.
+            attn_impl = {"pallas": "flash", "xla": "dense"}.get(
+                cfg.attn_impl, cfg.attn_impl
+            )
             overrides: dict[str, Any] = dict(
                 dtype=cfg.dtype,
                 param_dtype=cfg.dtype,
                 remat=cfg.gradient_checkpointing,
                 scan_layers=cfg.jax.scan_layers,
                 is_critic=cfg.is_critic,
+                attn_impl=attn_impl,
             )
             self.model_config = ModelConfig.from_hf_config(cfg.path, **overrides)
 
@@ -471,6 +478,10 @@ class JaxTrainEngine(TrainEngine):
         loss_fn: Callable,
         loss_weight_fn: Callable,
     ) -> dict[str, float]:
+        # Rebind the ambient mesh so ops that trace lazily (ring attention's
+        # shard_map) capture THIS engine's mesh even when several engines
+        # with different strategies coexist in one process (actor + critic).
+        mesh_lib.set_current_mesh(self.mesh)
         assert self.optimizer is not None, "engine has no optimizer"
         mb_list = split_padded_tensor_dict_into_mb_list(
             input_, self.config.mb_spec
@@ -508,6 +519,7 @@ class JaxTrainEngine(TrainEngine):
         loss_fn: Callable,
         loss_weight_fn: Callable,
     ):
+        mesh_lib.set_current_mesh(self.mesh)
         mb_list = split_padded_tensor_dict_into_mb_list(
             input_, self.config.mb_spec
         )
@@ -544,6 +556,7 @@ class JaxTrainEngine(TrainEngine):
     ):
         """No-grad forward with unpack → reorder → aggregate
         (parity: fsdp_engine.py:695-794)."""
+        mesh_lib.set_current_mesh(self.mesh)
         mb_list = split_padded_tensor_dict_into_mb_list(
             input_, self.config.mb_spec
         )

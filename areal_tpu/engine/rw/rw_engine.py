@@ -51,6 +51,12 @@ def rw_pairwise_loss(values: jax.Array, mb: dict[str, Any]) -> jax.Array:
     return loss
 
 
+def _identity_hook(v, mb):
+    # Module-level so the engine's jit cache (keyed on the hook's identity)
+    # hits across compute_scores calls.
+    return v
+
+
 def _attach_seq_end(data: dict[str, Any]) -> dict[str, Any]:
     """Add the [B, T] end-of-sequence marker derived from attention_mask."""
     am = np.asarray(data["attention_mask"])
@@ -107,7 +113,7 @@ class JaxRWEngine(JaxTrainEngine):
         """Per-sequence reward scores (value at the final real token)."""
         self.eval()
         flat = self.forward(
-            input_=data, post_hook=lambda v, mb: v, aggregate_fn=list
+            input_=data, post_hook=_identity_hook, aggregate_fn=list
         )
         lens = np.asarray(data["attention_mask"]).sum(-1).astype(np.int64)
         return np.array(

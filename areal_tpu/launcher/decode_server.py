@@ -73,6 +73,10 @@ class DecodeServer:
         # Set by /pause_generation, cleared by /continue_generation: a weight
         # update must not cancel a pause the client asked for explicitly.
         self._client_paused = False
+        # Serialises pause/continue/weight-swap: a /continue_generation must
+        # not resume decoding in the middle of an in-flight swap, or tokens
+        # from the new weights would carry the old version stamp.
+        self._ctl_lock = asyncio.Lock()
 
     # -- handlers -------------------------------------------------------
     async def _health(self, request: web.Request) -> web.Response:
@@ -116,18 +120,20 @@ class DecodeServer:
             body = {}
         # pause_generation blocks until the scheduler is idle — run it off
         # the event loop so in-flight /generate futures can resolve.
-        self._client_paused = True
-        await asyncio.get_running_loop().run_in_executor(
-            None, self.engine.pause_generation
-        )
-        aborted = 0
-        if body.get("abort"):
-            aborted = self.engine.abort_all()
+        async with self._ctl_lock:
+            self._client_paused = True
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.pause_generation
+            )
+            aborted = 0
+            if body.get("abort"):
+                aborted = self.engine.abort_all()
         return web.json_response({"status": "ok", "aborted": aborted})
 
     async def _continue(self, request: web.Request) -> web.Response:
-        self._client_paused = False
-        self.engine.continue_generation()
+        async with self._ctl_lock:
+            self._client_paused = False
+            self.engine.continue_generation()
         return web.json_response({"status": "ok"})
 
     async def _update_weights_from_disk(
@@ -149,7 +155,8 @@ class DecodeServer:
                 if not self._client_paused:
                     self.engine.continue_generation()
 
-        await asyncio.get_running_loop().run_in_executor(None, _swap)
+        async with self._ctl_lock:
+            await asyncio.get_running_loop().run_in_executor(None, _swap)
         return web.json_response(
             {"status": "ok", "version": self.engine.get_version()}
         )

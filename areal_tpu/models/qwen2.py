@@ -62,6 +62,11 @@ class ModelConfig:
     # compile-time toggles
     scan_layers: bool = True
     remat: bool = False
+    # attention implementation: "dense" materialises the [T,T] score matrix
+    # (fine for short packs / CPU tests); "flash" uses the Pallas
+    # online-softmax kernel (areal_tpu/ops/flash_attention.py) — O(T) memory,
+    # required for long-context packs; "auto" picks flash on TPU.
+    attn_impl: str = "auto"
     # critic/reward mode: scalar value head instead of the LM head
     # (parity: the reference's AutoModelForTokenClassification path,
     # areal/engine/base_hf_engine.py:180-187)
@@ -289,12 +294,42 @@ def segment_causal_mask(segment_ids: jax.Array) -> jax.Array:
     return (seg_q == seg_k) & causal & (seg_q != PADDING_SEGMENT)
 
 
+_ATTN_IMPLS = ("auto", "flash", "dense", "ring")
+
+
+def resolve_attn_impl(cfg: ModelConfig) -> str:
+    if cfg.attn_impl not in _ATTN_IMPLS:
+        raise ValueError(
+            f"attn_impl={cfg.attn_impl!r} not in {_ATTN_IMPLS} "
+            "(engine configs may also say 'pallas'/'xla' for flash/dense)"
+        )
+    if cfg.attn_impl != "auto":
+        return cfg.attn_impl
+    if jax.default_backend() != "tpu":
+        return "dense"
+    # Flash when tokens live on one shard; ring when the packed token axis is
+    # sharded over (dp, sp) — a bare pallas_call cannot be SPMD-partitioned
+    # along an axis the kernel reduces over.
+    from areal_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.current_mesh()
+    if mesh is not None:
+        n = 1
+        for a in (mesh_lib.AXIS_DP, mesh_lib.AXIS_SP):
+            if a in mesh.axis_names:
+                n *= mesh.shape[a]
+        if n > 1:
+            return "ring"
+    return "flash"
+
+
 def attention(
     layer_p: dict,
     x: jax.Array,
     cos: jax.Array,
     sin: jax.Array,
-    mask: jax.Array,
+    segment_ids: jax.Array,
+    mask: jax.Array | None,
     cfg: ModelConfig,
 ) -> jax.Array:
     """Packed multi-head GQA attention over one 1-D token stream [T, H]."""
@@ -312,16 +347,28 @@ def attention(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    # GQA: broadcast kv heads to query heads via grouped einsum.
-    group = nH // nKV
     T = x.shape[0]
-    q = q.reshape(T, nKV, group, hd)
-    scores = jnp.einsum("tkgd,skd->kgts", q, k).astype(jnp.float32)
-    scores = scores / np.sqrt(hd)
-    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    out = jnp.einsum("kgts,skd->tkgd", probs, v)
-    out = out.reshape(T, nH, hd)
+    impl = resolve_attn_impl(cfg)
+    if impl == "flash":
+        from areal_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, segment_ids)
+    elif impl == "ring":
+        from areal_tpu.ops.ring_attention import ring_flash_attention
+
+        out = ring_flash_attention(q, k, v, segment_ids)
+    else:
+        # GQA: broadcast kv heads to query heads via grouped einsum.
+        group = nH // nKV
+        if mask is None:
+            mask = segment_causal_mask(segment_ids)
+        qg = q.reshape(T, nKV, group, hd)
+        scores = jnp.einsum("tkgd,skd->kgts", qg, k).astype(jnp.float32)
+        scores = scores / np.sqrt(hd)
+        scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        out = jnp.einsum("kgts,skd->tkgd", probs, v)
+        out = out.reshape(T, nH, hd)
     return jnp.einsum("tnd,ndh->th", out, layer_p["o_kernel"])
 
 
@@ -336,11 +383,12 @@ def decoder_layer(
     x: jax.Array,
     cos: jax.Array,
     sin: jax.Array,
-    mask: jax.Array,
+    segment_ids: jax.Array,
+    mask: jax.Array | None,
     cfg: ModelConfig,
 ) -> jax.Array:
     h = rms_norm(x, layer_p["input_norm"], cfg.rms_norm_eps)
-    x = x + attention(layer_p["attn"], h, cos, sin, mask, cfg)
+    x = x + attention(layer_p["attn"], h, cos, sin, segment_ids, mask, cfg)
     h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
     return x + mlp(layer_p["mlp"], h)
 
@@ -360,15 +408,21 @@ def forward(
     compute_dtype = jnp.dtype(cfg.dtype)
     x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
     cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta)
-    mask = segment_causal_mask(segment_ids)
+    # Dense path: build the [T,T] mask ONCE here (outside the per-layer remat
+    # region); flash/ring never materialise it.
+    mask = (
+        segment_causal_mask(segment_ids)
+        if resolve_attn_impl(cfg) == "dense"
+        else None
+    )
 
     layer_fn = decoder_layer
     if cfg.remat:
-        layer_fn = jax.checkpoint(decoder_layer, static_argnums=(5,))
+        layer_fn = jax.checkpoint(decoder_layer, static_argnums=(6,))
 
     if cfg.scan_layers:
         def body(carry, layer_p):
-            return layer_fn(layer_p, carry, cos, sin, mask, cfg), None
+            return layer_fn(layer_p, carry, cos, sin, segment_ids, mask, cfg), None
 
         # scan over the stacked [L, ...] layer params
         def scan_body(x0):
@@ -380,7 +434,9 @@ def forward(
         x = scan_body(x)
     else:
         for i in range(cfg.num_hidden_layers):
-            x = layer_fn(params[f"layers_{i}"], x, cos, sin, mask, cfg)
+            x = layer_fn(
+                params[f"layers_{i}"], x, cos, sin, segment_ids, mask, cfg
+            )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if cfg.is_critic:

@@ -1,0 +1,578 @@
+"""Segment-aware flash attention for packed sequences — Pallas TPU kernel.
+
+The trainer's hot op. The reference leans on flash-attn CUDA kernels through
+HF/Megatron (SURVEY §2.3 "megatron fused deps": flash-attn, and SGLang's
+kernels on the decode side); here the same role is played by a Pallas kernel
+designed for our packed layout:
+
+- inputs are a single packed 1-D token stream `[T, heads, head_dim]` with
+  `segment_ids[T]` marking sequence membership (PADDING_SEGMENT = -1 for the
+  pad tail) — the layout produced by pack_tensor_dict + FFD micro-batching.
+  Attention is causal-within-segment, so one kernel serves any mix of
+  sequence lengths with static shapes (no recompiles).
+- online-softmax tiling (flash attention): O(T) memory instead of the
+  O(T^2) score matrix, which is what makes 32k-token generations trainable.
+- GQA is expressed in the BlockSpec index maps: query head h reads KV head
+  h // (nH // nKV) — no KV replication in HBM.
+- fp32 accumulation for scores/softmax/output accumulation; bf16 matmul
+  inputs feed the MXU.
+- backward is two more Pallas kernels (dq; dk/dv per query head reduced over
+  the GQA group outside) wired through jax.custom_vjp, with the standard
+  delta = rowsum(dO * O) trick so the backward never materialises probs.
+
+Causality is decided by explicit global token-position arrays (qpos/kpos),
+not block indices — that is what lets the SAME kernel serve both the local
+case (positions = arange, with whole above-diagonal blocks skipped via
+pl.when) and the ring-attention case (areal_tpu/ops/ring_attention.py),
+where the kv chunk comes from another shard and carries an arbitrary
+position offset.
+
+The kernel also returns the per-row log-sum-exp and differentiates through
+it (ds = p * (dp - delta + dlse)) so sharded callers can merge partial
+results from multiple kv chunks and still take exact gradients.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PADDING_SEGMENT = -1
+_NEG_INF = -1e30
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _mask_for(seg_q, seg_k, qpos, kpos):
+    """[Bq, Bk] validity: same segment, causal by global position, not pad."""
+    return (
+        (seg_q[:, None] == seg_k[None, :])
+        & (qpos[:, None] >= kpos[None, :])
+        & (seg_q[:, None] != PADDING_SEGMENT)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    seg_q_ref,
+    seg_k_ref,
+    qpos_ref,
+    kpos_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    skip_blocks: bool,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [Bq, hd]
+        k = k_ref[0].astype(jnp.float32)  # [Bk, hd]
+        s = jax.lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        s = s * sm_scale
+        mask = _mask_for(seg_q_ref[0], seg_k_ref[0], qpos_ref[0], kpos_ref[0])
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:]  # [Bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        # Fully-masked rows: every entry of p is exp(_NEG_INF - _NEG_INF) = 1;
+        # zero them so l stays 0 for pad rows.
+        p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        v = v_ref[0].astype(jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p,
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if skip_blocks:
+        # Positions are plain arange: kv blocks strictly above the diagonal
+        # can be skipped wholesale (~2x fwd saving for causal).
+        pl.when(j * block_k <= i * block_q + (block_q - 1))(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = jnp.where(l > 0.0, m_ref[:] + jnp.log(safe_l), _NEG_INF)
+        lse_ref[0] = lse[:, 0]
+
+
+def _fwd_call(
+    q3, k3, v3, seg_q, seg_k, qpos, kpos, sm_scale, block_q, block_k,
+    skip_blocks, interpret,
+):
+    """q3: [nH, Tq, hd]; k3/v3: [nKV, Tk, hd]. Returns (o [nH,Tq,hd], lse [nH,Tq])."""
+    nH, Tq, hd = q3.shape
+    nKV, Tk, _ = k3.shape
+    group = nH // nKV
+    grid = (nH, Tq // block_q, Tk // block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        skip_blocks=skip_blocks,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q), lambda h, i, j: (0, i)),
+            pl.BlockSpec((1, block_k), lambda h, i, j: (0, j)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (0, i)),
+            pl.BlockSpec((1, block_k), lambda h, i, j: (0, j)),
+            pl.BlockSpec((1, block_q, hd), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec(
+                (1, block_k, hd), lambda h, i, j, g=group: (h // g, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, hd), lambda h, i, j, g=group: (h // g, j, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nH, Tq, hd), q3.dtype),
+            jax.ShapeDtypeStruct((nH, Tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        seg_q.reshape(1, Tq),
+        seg_k.reshape(1, Tk),
+        qpos.reshape(1, Tq),
+        kpos.reshape(1, Tk),
+        q3,
+        k3,
+        v3,
+    )
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _scores(q, k, seg_q, seg_k, qpos, kpos, sm_scale):
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * sm_scale
+    return jnp.where(_mask_for(seg_q, seg_k, qpos, kpos), s, _NEG_INF)
+
+
+def _bwd_dq_kernel(
+    seg_q_ref,
+    seg_k_ref,
+    qpos_ref,
+    kpos_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dlse_ref,
+    dq_ref,
+    dq_acc_ref,
+    *,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    skip_blocks: bool,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # [Bq]
+        delta = delta_ref[0]  # [Bq]
+        dlse = dlse_ref[0]  # [Bq]
+        s = _scores(
+            q, k, seg_q_ref[0], seg_k_ref[0], qpos_ref[0], kpos_ref[0], sm_scale
+        )
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(lse[:, None] > _NEG_INF / 2, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None] + dlse[:, None])
+        dq_acc_ref[:] += sm_scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if skip_blocks:
+        pl.when(j * block_k <= i * block_q + (block_q - 1))(_compute)
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    seg_q_ref,
+    seg_k_ref,
+    qpos_ref,
+    kpos_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dlse_ref,
+    dk_ref,
+    dv_ref,
+    dk_acc_ref,
+    dv_acc_ref,
+    *,
+    sm_scale: float,
+    block_q: int,
+    block_k: int,
+    skip_blocks: bool,
+):
+    jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        dlse = dlse_ref[0]
+        s = _scores(
+            q, k, seg_q_ref[0], seg_k_ref[0], qpos_ref[0], kpos_ref[0], sm_scale
+        )
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(lse[:, None] > _NEG_INF / 2, p, 0.0)
+        # dv += p^T @ do
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None] + dlse[:, None])
+        # dk += ds^T @ q
+        dk_acc_ref[:] += sm_scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if skip_blocks:
+        pl.when(iq * block_q + (block_q - 1) >= jk * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(
+    q3, k3, v3, seg_q, seg_k, qpos, kpos, o, lse, do, dlse,
+    sm_scale, block_q, block_k, skip_blocks, interpret,
+):
+    nH, Tq, hd = q3.shape
+    nKV, Tk, _ = k3.shape
+    group = nH // nKV
+    seg_q2 = seg_q.reshape(1, Tq)
+    seg_k2 = seg_k.reshape(1, Tk)
+    qpos2 = qpos.reshape(1, Tq)
+    kpos2 = kpos.reshape(1, Tk)
+    delta = jnp.sum(
+        o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
+    )  # [nH, Tq]
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        skip_blocks=skip_blocks,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(nH, Tq // block_q, Tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q), lambda h, i, j: (0, i)),
+            pl.BlockSpec((1, block_k), lambda h, i, j: (0, j)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (0, i)),
+            pl.BlockSpec((1, block_k), lambda h, i, j: (0, j)),
+            pl.BlockSpec((1, block_q, hd), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec(
+                (1, block_k, hd), lambda h, i, j, g=group: (h // g, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, hd), lambda h, i, j, g=group: (h // g, j, 0)
+            ),
+            pl.BlockSpec((1, block_q, hd), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nH, Tq, hd), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        interpret=interpret,
+    )(seg_q2, seg_k2, qpos2, kpos2, q3, k3, v3, do, lse, delta, dlse)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel,
+        sm_scale=sm_scale,
+        block_q=block_q,
+        block_k=block_k,
+        skip_blocks=skip_blocks,
+    )
+    # dk/dv computed per *query* head, then reduced over the GQA group.
+    dk_h, dv_h = pl.pallas_call(
+        dkv_kernel,
+        grid=(nH, Tk // block_k, Tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q), lambda h, jk, iq: (0, iq)),
+            pl.BlockSpec((1, block_k), lambda h, jk, iq: (0, jk)),
+            pl.BlockSpec((1, block_q), lambda h, jk, iq: (0, iq)),
+            pl.BlockSpec((1, block_k), lambda h, jk, iq: (0, jk)),
+            pl.BlockSpec((1, block_q, hd), lambda h, jk, iq: (h, iq, 0)),
+            pl.BlockSpec(
+                (1, block_k, hd), lambda h, jk, iq, g=group: (h // g, jk, 0)
+            ),
+            pl.BlockSpec(
+                (1, block_k, hd), lambda h, jk, iq, g=group: (h // g, jk, 0)
+            ),
+            pl.BlockSpec((1, block_q, hd), lambda h, jk, iq: (h, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda h, jk, iq: (h, iq)),
+            pl.BlockSpec((1, block_q), lambda h, jk, iq: (h, iq)),
+            pl.BlockSpec((1, block_q), lambda h, jk, iq: (h, iq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, hd), lambda h, jk, iq: (h, jk, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda h, jk, iq: (h, jk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nH, Tk, hd), jnp.float32),
+            jax.ShapeDtypeStruct((nH, Tk, hd), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, hd), jnp.float32),
+            pltpu.VMEM((block_k, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seg_q2, seg_k2, qpos2, kpos2, q3, k3, v3, do, lse, delta, dlse)
+
+    dk = dk_h.reshape(nKV, group, Tk, hd).sum(axis=1).astype(k3.dtype)
+    dv = dv_h.reshape(nKV, group, Tk, hd).sum(axis=1).astype(v3.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-VJP core (heads-major, block-aligned shapes)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash(
+    q3, k3, v3, seg_q, seg_k, qpos, kpos,
+    sm_scale, block_q, block_k, skip_blocks, interpret,
+):
+    return _fwd_call(
+        q3, k3, v3, seg_q, seg_k, qpos, kpos,
+        sm_scale, block_q, block_k, skip_blocks, interpret,
+    )
+
+
+def _flash_fwd(
+    q3, k3, v3, seg_q, seg_k, qpos, kpos,
+    sm_scale, block_q, block_k, skip_blocks, interpret,
+):
+    o, lse = _fwd_call(
+        q3, k3, v3, seg_q, seg_k, qpos, kpos,
+        sm_scale, block_q, block_k, skip_blocks, interpret,
+    )
+    return (o, lse), (q3, k3, v3, seg_q, seg_k, qpos, kpos, o, lse)
+
+
+def _flash_bwd(sm_scale, block_q, block_k, skip_blocks, interpret, res, cts):
+    q3, k3, v3, seg_q, seg_k, qpos, kpos, o, lse = res
+    do, dlse = cts
+    if dlse is None or isinstance(dlse, jax.custom_derivatives.SymbolicZero):
+        dlse = jnp.zeros_like(lse)
+    dq, dk, dv = _bwd_call(
+        q3, k3, v3, seg_q, seg_k, qpos, kpos, o, lse, do,
+        dlse.astype(jnp.float32),
+        sm_scale, block_q, block_k, skip_blocks, interpret,
+    )
+    return dq, dk, dv, None, None, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _pad_to(x, n, axis, value=0):
+    pad = n - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def flash_attention_chunk(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    seg_q: jax.Array,
+    seg_k: jax.Array,
+    q_positions: jax.Array,
+    kv_positions: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Attention of local queries against ONE kv chunk (ring building block).
+
+    q: [Tq, nH, hd]; k/v: [Tk, nKV, hd]; positions are *global* token indices
+    deciding causality. Returns (out [Tq, nH, hd], lse [Tq, nH]) where `out`
+    is normalised within this chunk and `lse` is the chunk's log-sum-exp —
+    merge across chunks with logsumexp weights (see ring_attention.merge).
+    """
+    Tq, nH, hd = q.shape
+    Tk = k.shape[0]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    if interpret is None:
+        interpret = _default_interpret()
+    block_q = min(block_q, max(128, Tq))
+    block_k = min(block_k, max(128, Tk))
+    Tqp = ((Tq + block_q - 1) // block_q) * block_q
+    Tkp = ((Tk + block_k - 1) // block_k) * block_k
+
+    q3 = jnp.swapaxes(_pad_to(q, Tqp, 0), 0, 1)
+    k3 = jnp.swapaxes(_pad_to(k, Tkp, 0), 0, 1)
+    v3 = jnp.swapaxes(_pad_to(v, Tkp, 0), 0, 1)
+    seg_q = _pad_to(seg_q.astype(jnp.int32), Tqp, 0, PADDING_SEGMENT)
+    seg_k = _pad_to(seg_k.astype(jnp.int32), Tkp, 0, PADDING_SEGMENT)
+    qpos = _pad_to(q_positions.astype(jnp.int32), Tqp, 0)
+    kpos = _pad_to(kv_positions.astype(jnp.int32), Tkp, 0)
+
+    o3, lse = _flash(
+        q3, k3, v3, seg_q, seg_k, qpos, kpos,
+        sm_scale, block_q, block_k, False, interpret,
+    )
+    return jnp.swapaxes(o3, 0, 1)[:Tq], jnp.swapaxes(lse, 0, 1)[:Tq]
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    segment_ids: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Packed-layout flash attention (single device / replicated tokens).
+
+    Args:
+      q: [T, nH, hd]; k, v: [T, nKV, hd] (GQA: nH % nKV == 0).
+      segment_ids: [T] int32; PADDING_SEGMENT (-1) marks pad tokens.
+    Returns: [T, nH, hd] in q.dtype. T is padded internally to the block size.
+    """
+    T, nH, hd = q.shape
+    nKV = k.shape[1]
+    assert nH % nKV == 0, (nH, nKV)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    if interpret is None:
+        interpret = _default_interpret()
+
+    block_q = min(block_q, max(128, T))
+    block_k = min(block_k, max(128, T))
+    blk = math.lcm(block_q, block_k)
+    Tp = ((T + blk - 1) // blk) * blk
+
+    q3 = jnp.swapaxes(_pad_to(q, Tp, 0), 0, 1)  # [nH, Tp, hd]
+    k3 = jnp.swapaxes(_pad_to(k, Tp, 0), 0, 1)
+    v3 = jnp.swapaxes(_pad_to(v, Tp, 0), 0, 1)
+    seg = _pad_to(segment_ids.astype(jnp.int32), Tp, 0, PADDING_SEGMENT)
+    pos = jnp.arange(Tp, dtype=jnp.int32)
+
+    o3, _ = _flash(
+        q3, k3, v3, seg, seg, pos, pos,
+        sm_scale, block_q, block_k, True, interpret,
+    )
+    return jnp.swapaxes(o3, 0, 1)[:T]

@@ -1,0 +1,151 @@
+"""Ring attention: context-parallel flash attention over the token axis.
+
+The reference's long-context story is Megatron context parallelism — 2·cp
+zig-zag chunk sharding delegated to TransformerEngine CUDA kernels
+(areal/utils/mcore/packed_context_parallel.py:9, megatron_engine.py:815-882)
+— plus Ulysses all-to-all SP on the FSDP path (areal/utils/ulysses.py). On
+TPU both collapse into ONE mechanism: the packed token stream is sharded
+over mesh axes ("dp","sp"), and attention runs as a shard_map ring —
+
+    each shard holds a [T/n] chunk of Q, K, V; K/V chunks rotate around the
+    ring via jax.lax.ppermute (XLA lowers to ICI neighbour exchange), each
+    step computing a partial flash-attention (areal_tpu/ops/flash_attention
+    .flash_attention_chunk) of local Q against the visiting K/V chunk;
+    partials merge exactly via log-sum-exp weights.
+
+Causality is decided by *global* token positions (shard_index · T/n +
+arange), so packing and segment isolation behave exactly as in the
+single-shard kernel. Gradients flow through ppermute and the kernel's
+custom VJP — no custom ring backward needed.
+
+Cost note: with plain block sharding, chunks wholly in a query's future are
+fully masked yet still computed (the classic causal CP imbalance the
+reference's zig-zag layout addresses). The compute is still O(T²/n) per
+shard and overlaps with the ring transfers; zig-zag layout is a later
+optimisation, correctness and memory scaling come first.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from areal_tpu.ops.flash_attention import (
+    _NEG_INF,
+    flash_attention,
+    flash_attention_chunk,
+)
+from areal_tpu.parallel import mesh as mesh_lib
+
+
+def _ring_body(
+    q_l: jax.Array,  # [Tl, nH(_l), hd]
+    k_l: jax.Array,
+    v_l: jax.Array,
+    seg_l: jax.Array,  # [Tl]
+    *,
+    axis_names: tuple[str, ...],
+    n: int,
+    sm_scale: float | None,
+    interpret: bool | None,
+) -> jax.Array:
+    Tl = q_l.shape[0]
+    idx = jax.lax.axis_index(axis_names)
+    local = jnp.arange(Tl, dtype=jnp.int32)
+    qpos = idx.astype(jnp.int32) * Tl + local
+
+    k_c, v_c, seg_c = k_l, v_l, seg_l
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # Online merge: keep ONE running (out, lse) pair — O(T/n) memory per
+    # shard — rescaled by log-sum-exp weights each ring step. Rows with no
+    # valid keys anywhere keep lse at _NEG_INF and out at 0.
+    o_run = None
+    lse_run = None
+    for s in range(n):
+        src = (idx - s) % n
+        kpos = src.astype(jnp.int32) * Tl + local
+        o_s, lse_s = flash_attention_chunk(
+            q_l, k_c, v_c, seg_l, seg_c, qpos, kpos,
+            sm_scale=sm_scale, interpret=interpret,
+        )
+        o_s = o_s.astype(jnp.float32)
+        if o_run is None:
+            o_run, lse_run = o_s, lse_s
+        else:
+            m = jnp.maximum(lse_run, lse_s)
+            m0 = jnp.where(m > _NEG_INF / 2, m, 0.0)
+            wa = jnp.exp(lse_run - m0)
+            wb = jnp.exp(lse_s - m0)
+            denom = wa + wb
+            safe = jnp.where(denom > 0.0, denom, 1.0)
+            o_run = (wa[..., None] * o_run + wb[..., None] * o_s) / safe[..., None]
+            lse_run = jnp.where(denom > 0.0, m0 + jnp.log(safe), _NEG_INF)
+        if s < n - 1:
+            k_c = jax.lax.ppermute(k_c, axis_names, perm)
+            v_c = jax.lax.ppermute(v_c, axis_names, perm)
+            seg_c = jax.lax.ppermute(seg_c, axis_names, perm)
+
+    return o_run.astype(q_l.dtype)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    segment_ids: jax.Array,
+    *,
+    mesh: Mesh | None = None,
+    axis_names: tuple[str, ...] | None = None,
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Sequence-sharded attention. Same contract as flash_attention, but the
+    [T] token axis may be sharded over mesh axes ("dp","sp"); falls back to
+    the single-shard kernel when there is nothing to ring over."""
+    if mesh is None:
+        mesh = mesh_lib.current_mesh()
+    if mesh is None:
+        return flash_attention(
+            q, k, v, segment_ids, sm_scale=sm_scale, interpret=interpret
+        )
+    if axis_names is None:
+        axis_names = tuple(
+            a
+            for a in (mesh_lib.AXIS_DP, mesh_lib.AXIS_SP)
+            if a in mesh.axis_names and mesh.shape[a] > 1
+        )
+    n = math.prod(mesh.shape[a] for a in axis_names) if axis_names else 1
+    T, nH, _ = q.shape
+    nKV = k.shape[1]
+    if n <= 1 or T % n != 0 or (T // n) < 128:
+        # Nothing to shard over / too small to tile: single-shard kernel
+        # (XLA will all-gather the token axis if it was sharded).
+        return flash_attention(
+            q, k, v, segment_ids, sm_scale=sm_scale, interpret=interpret
+        )
+
+    # Keep TP sharding of the head axis through the shard_map when it divides.
+    tp = mesh.shape.get(mesh_lib.AXIS_TP, 1)
+    head_axis = (
+        mesh_lib.AXIS_TP if tp > 1 and nH % tp == 0 and nKV % tp == 0 else None
+    )
+    body = functools.partial(
+        _ring_body,
+        axis_names=axis_names,
+        n=n,
+        sm_scale=sm_scale,
+        interpret=interpret,
+    )
+    tok = P(axis_names)
+    qkv_spec = P(axis_names, head_axis, None)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, tok),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )(q, k, v, segment_ids.astype(jnp.int32))

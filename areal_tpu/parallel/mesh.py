@@ -38,6 +38,20 @@ AXIS_SP = "sp"
 AXIS_TP = "tp"
 MESH_AXES = (AXIS_PP, AXIS_DP, AXIS_SP, AXIS_TP)
 
+# Ambient mesh: engines register their mesh here so ops deep inside the
+# jitted model (ring attention's shard_map) can reach it without threading a
+# Mesh through every pure function signature.
+_CURRENT_MESH: Mesh | None = None
+
+
+def set_current_mesh(mesh: Mesh | None) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def current_mesh() -> Mesh | None:
+    return _CURRENT_MESH
+
 
 def build_mesh(
     strategy: ParallelStrategy, devices: list | None = None
