@@ -28,6 +28,9 @@ class ModelRequest:
     )
     metadata: dict[str, Any] = field(default_factory=dict)
     tokenizer: Any = None
+    # VLM inputs: list of images (bytes/base64/PIL), passed through to the
+    # decode backend (parity: io_struct.py:21 ModelRequest.image_data).
+    image_data: list[Any] | None = None
 
     def copy(self) -> "ModelRequest":
         return ModelRequest(
@@ -36,6 +39,7 @@ class ModelRequest:
             gconfig=self.gconfig.new(),
             metadata=dict(self.metadata),
             tokenizer=self.tokenizer,
+            image_data=list(self.image_data) if self.image_data else None,
         )
 
 

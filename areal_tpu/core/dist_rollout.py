@@ -1,0 +1,200 @@
+"""Distributed rollout coordination (parity: areal/core/dist_rollout.py:43,93).
+
+The reference runs rollout only on DP-head GPU ranks, then `redistribute()`
+all-gathers trajectories across the DP group, slices them into GRPO groups,
+FFD-balances groups by sequence length, and NCCL-broadcasts each rank's
+slice to its CP/TP peers.
+
+On TPU under single-controller SPMD the shape is different and simpler:
+
+- rollout is a *host*-side activity (asyncio HTTP against decode servers) —
+  every **process** (host) rolls out its share of the global batch; there is
+  no per-device "DP head" because devices don't run Python.
+- the gather step is a host-level all-gather over processes
+  (jax.experimental.multihost_utils.process_allgather) instead of an NCCL
+  all-gather over DP ranks.
+- the "broadcast to CP/TP peers" step disappears entirely: handing the
+  balanced global batch to `jax.device_put` with the engine's batch sharding
+  places every row on exactly the devices that need it — XLA's runtime does
+  the scatter.
+
+What *survives* the translation is the balancing policy: GRPO groups stay
+intact, and groups are placed into equal-cardinality per-DP-shard chunks
+with near-equal token totals so no DP shard stalls on a long-tail batch
+(the reference's FFD `_redistribute_by_group`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.utils import logging, stats_tracker
+from areal_tpu.utils.data import concat_padded_tensors, get_batch_size
+from areal_tpu.utils.datapack import reorder_to_balanced_batches
+
+logger = logging.getLogger("dist_rollout")
+
+
+@dataclasses.dataclass
+class RedistributePlan:
+    """Row order + per-DP-shard slices after balancing."""
+
+    row_order: np.ndarray  # [B] original-row index for each new position
+    shard_groups: list[list[int]]  # group indices per DP shard
+    shard_tokens: list[int]  # token totals per DP shard (balance metric)
+
+
+def redistribute(
+    batch: dict[str, Any],
+    *,
+    group_size: int = 1,
+    dp_size: int = 1,
+) -> tuple[dict[str, Any], RedistributePlan]:
+    """Reorder a padded [B, T] batch so contiguous B/dp_size row-slices have
+    near-equal token totals, keeping each `group_size` block (one GRPO prompt
+    group) intact. Rows of one shard stay contiguous, so the engine's
+    dp-sharded `device_put` gives each DP shard its balanced slice.
+    """
+    B = get_batch_size(batch)
+    assert B % group_size == 0, (B, group_size)
+    n_groups = B // group_size
+    assert n_groups % dp_size == 0, (
+        f"groups ({n_groups}) must divide evenly over dp shards ({dp_size})"
+    )
+    am = np.asarray(batch["attention_mask"])
+    group_lens = am.reshape(n_groups, group_size, -1).sum(axis=(1, 2))
+
+    shard_groups = reorder_to_balanced_batches(group_lens, n_groups // dp_size)
+    assert len(shard_groups) == dp_size, (len(shard_groups), dp_size)
+    row_order = np.concatenate(
+        [
+            np.arange(g * group_size, (g + 1) * group_size)
+            for groups in shard_groups
+            for g in groups
+        ]
+    )
+    out = {}
+    for key, val in batch.items():
+        arr = np.asarray(val)
+        out[key] = arr[row_order] if arr.ndim >= 1 and arr.shape[0] == B else arr
+    plan = RedistributePlan(
+        row_order=row_order,
+        shard_groups=shard_groups,
+        shard_tokens=[int(group_lens[g].sum()) for g in shard_groups],
+    )
+    return out, plan
+
+
+def _host_allgather(batch: dict[str, Any]) -> dict[str, Any]:
+    """All-gather a padded batch across JAX processes (multi-host)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return batch
+    # Align pad lengths across hosts, then gather along the batch axis.
+    local_T = max(
+        (np.asarray(v).shape[1] for v in batch.values() if np.asarray(v).ndim == 2),
+        default=0,
+    )
+    max_T = int(
+        multihost_utils.process_allgather(np.asarray([local_T])).max()
+    )
+    padded = {}
+    for k, v in batch.items():
+        arr = np.asarray(v)
+        if arr.ndim == 2 and arr.shape[1] < max_T:
+            arr = np.pad(arr, ((0, 0), (0, max_T - arr.shape[1])))
+        padded[k] = arr
+    gathered = multihost_utils.process_allgather(padded)
+    # [P, B_local, ...] -> [P*B_local, ...]
+    return {
+        k: np.asarray(v).reshape((-1,) + np.asarray(v).shape[2:])
+        for k, v in gathered.items()
+    }
+
+
+class DistRolloutCoordinator:
+    """Couples a train engine with an inference engine's rollout queue and
+    produces balanced global batches (parity: DistRolloutCoordinator,
+    areal/core/dist_rollout.py:93 + FSDPEngine.prepare_batch fsdp_engine.py:482).
+    """
+
+    def __init__(
+        self,
+        train_engine,
+        rollout_engine,
+        *,
+        allgather_fn: Callable[[dict[str, Any]], dict[str, Any]] | None = None,
+    ):
+        self.train_engine = train_engine
+        self.rollout_engine = rollout_engine
+        self._allgather = allgather_fn or _host_allgather
+
+    def _dp_size(self) -> int:
+        try:
+            return int(self.train_engine.data_parallel_world_size())
+        except Exception:
+            return 1
+
+    def prepare_batch(
+        self,
+        dataloader,
+        *,
+        granularity: int = 1,
+        workflow=None,
+        workflow_builder=None,
+        should_accept=None,
+    ) -> tuple[dict[str, Any], RedistributePlan]:
+        """Pull one locally-rolled-out batch, gather across hosts, balance
+        across DP shards. `granularity` is the GRPO group size — rows of one
+        prompt group are kept on one shard."""
+        with stats_tracker.record_timing("dist_rollout/local_rollout"):
+            local = self.rollout_engine.prepare_batch(
+                dataloader,
+                workflow=workflow,
+                workflow_builder=workflow_builder,
+                should_accept=should_accept,
+            )
+        with stats_tracker.record_timing("dist_rollout/allgather"):
+            global_batch = self._allgather(local)
+        with stats_tracker.record_timing("dist_rollout/redistribute"):
+            balanced, plan = redistribute(
+                global_batch, group_size=granularity, dp_size=self._dp_size()
+            )
+        if len(plan.shard_tokens) > 1:
+            logger.debug(
+                f"redistributed: tokens/shard {plan.shard_tokens} "
+                f"(imbalance {max(plan.shard_tokens) - min(plan.shard_tokens)})"
+            )
+        return balanced, plan
+
+    def rollout_batch(
+        self,
+        data: list[dict[str, Any]],
+        *,
+        granularity: int = 1,
+        workflow=None,
+        workflow_builder=None,
+        should_accept=None,
+    ) -> tuple[dict[str, Any], RedistributePlan]:
+        """Synchronous variant over an explicit item list."""
+        local = self.rollout_engine.rollout_batch(
+            data,
+            workflow=workflow,
+            workflow_builder=workflow_builder,
+            should_accept=should_accept,
+        )
+        global_batch = self._allgather(local)
+        return redistribute(
+            global_batch, group_size=granularity, dp_size=self._dp_size()
+        )
+
+
+def merge_host_batches(batches: list[dict[str, Any]]) -> dict[str, Any]:
+    """Concatenate per-host padded batches (test helper mirroring what
+    process_allgather produces)."""
+    return concat_padded_tensors(batches)

@@ -58,11 +58,22 @@ class RemoteBackend:
     HEALTH_ENDPOINT = "/health"
 
     def build_generate_payload(self, req: ModelRequest) -> dict[str, Any]:
-        return {
+        payload = {
             "rid": req.rid,
             "input_ids": list(req.input_ids),
             "gconfig": dataclasses.asdict(req.gconfig),
         }
+        if req.image_data:
+            # VLM inputs ride as base64 strings (callers pass bytes or str).
+            import base64
+
+            payload["image_data"] = [
+                base64.b64encode(img).decode()
+                if isinstance(img, (bytes, bytearray))
+                else img
+                for img in req.image_data
+            ]
+        return payload
 
     def parse_generate_response(self, data: dict[str, Any]) -> dict[str, Any]:
         return {

@@ -478,6 +478,14 @@ class JaxDecodeEngine(InferenceEngine):
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         if self._thread_exc is not None:
             raise RuntimeError("decode engine crashed") from self._thread_exc
+        if req.image_data:
+            # Explicit failure beats silently generating image-blind text:
+            # this engine decodes the text families (qwen2/qwen3/llama); VLM
+            # decode needs a vision-tower model family.
+            raise NotImplementedError(
+                "JaxDecodeEngine does not decode image inputs yet; route "
+                "vision requests to a VLM-capable backend"
+            )
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
         item = _Slot(

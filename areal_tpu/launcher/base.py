@@ -1,0 +1,91 @@
+"""Launcher job bookkeeping: state FSM + exit classification.
+
+Parity: areal/utils/launcher.py JobState + areal/launcher/local.py:36-57
+(psutil status → JobState mapping, recoverable-exit classification).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import signal
+import subprocess
+import time
+
+
+class JobFailure(RuntimeError):
+    """A launcher job exited non-zero. `recoverable` marks preemption-style
+    exits (SIGKILL/SIGTERM) worth an automatic experiment restart, vs
+    deterministic failures that would just loop."""
+
+    def __init__(self, msg: str, *, recoverable: bool = False):
+        super().__init__(msg)
+        self.recoverable = recoverable
+
+
+class JobState(enum.Enum):
+    NOT_FOUND = 0
+    PENDING = 1
+    RUNNING = 2
+    COMPLETED = 3
+    FAILED = 4
+    CANCELLED = 5
+
+    def active(self) -> bool:
+        return self in (JobState.PENDING, JobState.RUNNING)
+
+
+# Exit codes that indicate an infrastructure hiccup worth auto-restarting
+# (the reference restarts on non-zero exits when recover_mode allows it;
+# SIGKILL'd (137) / SIGTERM'd (143) workers are treated as preemptions).
+RECOVERABLE_RETURNCODES = {-signal.SIGKILL, -signal.SIGTERM, 137, 143}
+
+
+@dataclasses.dataclass
+class JobInfo:
+    name: str
+    cmd: list[str]
+    proc: subprocess.Popen | None = None
+    log_path: str | None = None
+    start_time: float = dataclasses.field(default_factory=time.monotonic)
+
+    @property
+    def state(self) -> JobState:
+        if self.proc is None:
+            return JobState.PENDING
+        rc = self.proc.poll()
+        if rc is None:
+            return JobState.RUNNING
+        return JobState.COMPLETED if rc == 0 else JobState.FAILED
+
+    @property
+    def returncode(self) -> int | None:
+        return None if self.proc is None else self.proc.poll()
+
+    def recoverable(self) -> bool:
+        rc = self.returncode
+        return rc is not None and rc in RECOVERABLE_RETURNCODES
+
+
+def kill_process_tree(proc: subprocess.Popen, grace_seconds: float = 5.0) -> None:
+    """SIGTERM the whole process group, then SIGKILL stragglers."""
+    if proc.poll() is not None:
+        return
+    try:
+        import os
+
+        pgid = os.getpgid(proc.pid)
+        os.killpg(pgid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        proc.terminate()
+    deadline = time.monotonic() + grace_seconds
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return
+        time.sleep(0.1)
+    try:
+        import os
+
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        proc.kill()

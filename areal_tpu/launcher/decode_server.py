@@ -100,6 +100,7 @@ class DecodeServer:
             rid=body.get("rid") or ModelRequest().rid,
             input_ids=[int(t) for t in body["input_ids"]],
             gconfig=_parse_gconfig(body.get("gconfig", {})),
+            image_data=body.get("image_data"),
         )
         resp = await self.engine.agenerate(req)
         return web.json_response(

@@ -1,0 +1,254 @@
+"""Local launcher: decode servers + trainer processes on one host.
+
+Parity: areal/launcher/local.py:81 LocalLauncher — spawns LLM-server
+subprocesses and N trainer processes, allocates accelerators, tails logs,
+kills the whole tree on failure, and auto-restarts the experiment after
+RECOVER_TIME_INTERVAL up to `recover_retries` times.
+
+TPU translation: the "LLM server" is our decode server
+(areal_tpu.launcher.decode_server), accelerator allocation is by TPU chip
+visibility (TPU_VISIBLE_CHIPS / JAX_PLATFORMS) rather than
+CUDA_VISIBLE_DEVICES, and trainer ranks are JAX processes (AREAL_TPU
+process env + jax.distributed) rather than torchrun ranks. Discovery stays
+name_resolve: servers self-register under names.gen_servers.
+
+Usage (mirrors `python -m areal.launcher.local entry.py --config c.yaml`):
+
+    python -m areal_tpu.launcher.local entry.py --config cfg.yaml [k=v ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from areal_tpu.api.alloc_mode import AllocationMode, AllocationType
+from areal_tpu.launcher.base import (
+    JobFailure,
+    JobInfo,
+    JobState,
+    kill_process_tree,
+)
+from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils.network import find_free_ports, gethostip
+
+logger = logging.getLogger("local_launcher")
+
+RECOVER_TIME_INTERVAL = 10.0  # parity: local.py:58
+
+
+class LocalLauncher:
+    def __init__(self, experiment_name: str, trial_name: str, fileroot: str):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.fileroot = fileroot
+        self.jobs: list[JobInfo] = []
+
+    # -- paths ----------------------------------------------------------
+    def log_dir(self) -> str:
+        d = os.path.join(
+            self.fileroot, "logs", self.experiment_name, self.trial_name
+        )
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- submission -----------------------------------------------------
+    def submit(
+        self,
+        name: str,
+        cmd: list[str],
+        env: dict[str, str] | None = None,
+    ) -> JobInfo:
+        import subprocess
+
+        log_path = os.path.join(self.log_dir(), f"{name}.log")
+        logf = open(log_path, "ab")
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        proc = subprocess.Popen(
+            cmd,
+            stdout=logf,
+            stderr=subprocess.STDOUT,
+            env=full_env,
+            start_new_session=True,  # own process group → clean tree kill
+        )
+        job = JobInfo(name=name, cmd=cmd, proc=proc, log_path=log_path)
+        self.jobs.append(job)
+        logger.info(f"launched {name}: pid={proc.pid} log={log_path}")
+        return job
+
+    def submit_decode_server(
+        self,
+        server_idx: int,
+        model_path: str,
+        *,
+        port: int | None = None,
+        extra_args: list[str] | None = None,
+        env: dict[str, str] | None = None,
+    ) -> JobInfo:
+        port = port or find_free_ports(1)[0]
+        cmd = [
+            sys.executable,
+            "-m",
+            "areal_tpu.launcher.decode_server",
+            "--model-path",
+            model_path,
+            "--host",
+            "0.0.0.0",
+            "--port",
+            str(port),
+            "--experiment-name",
+            self.experiment_name,
+            "--trial-name",
+            self.trial_name,
+            "--server-id",
+            f"{gethostip()}:{port}",
+        ] + (extra_args or [])
+        return self.submit(f"decode_server_{server_idx}", cmd, env=env)
+
+    def wait_decode_servers(self, count: int, timeout: float = 300.0) -> list[str]:
+        """Block until `count` servers registered in name_resolve."""
+        key = names.gen_servers(self.experiment_name, self.trial_name)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self._raise_on_failure()
+            try:
+                addrs = name_resolve.get_subtree(key)
+            except Exception:
+                addrs = []
+            if len(addrs) >= count:
+                return list(addrs)
+            time.sleep(1.0)
+        raise TimeoutError(
+            f"{count} decode servers did not register within {timeout}s"
+        )
+
+    def submit_trainers(
+        self,
+        entrypoint: list[str],
+        n_procs: int,
+        env: dict[str, str] | None = None,
+    ) -> list[JobInfo]:
+        """Spawn trainer processes with jax.distributed-style env. On a
+        single TPU host n_procs is typically 1 (one process drives all local
+        chips under SPMD)."""
+        coord_port = find_free_ports(1)[0]
+        jobs = []
+        for rank in range(n_procs):
+            proc_env = {
+                "AREAL_EXPERIMENT_NAME": self.experiment_name,
+                "AREAL_TRIAL_NAME": self.trial_name,
+                "AREAL_TPU_NUM_PROCESSES": str(n_procs),
+                "AREAL_TPU_PROCESS_ID": str(rank),
+                "AREAL_TPU_COORDINATOR": f"{gethostip()}:{coord_port}",
+                **(env or {}),
+            }
+            jobs.append(
+                self.submit(f"trainer_{rank}", list(entrypoint), env=proc_env)
+            )
+        return jobs
+
+    # -- supervision ----------------------------------------------------
+    def _raise_on_failure(self) -> None:
+        for job in self.jobs:
+            if job.state is JobState.FAILED:
+                tail = ""
+                if job.log_path and os.path.exists(job.log_path):
+                    with open(job.log_path, "rb") as f:
+                        f.seek(max(0, os.path.getsize(job.log_path) - 4096))
+                        tail = f.read().decode(errors="replace")
+                raise JobFailure(
+                    f"job {job.name} failed rc={job.returncode}\n"
+                    f"--- last log lines ---\n{tail}",
+                    recoverable=job.recoverable(),
+                )
+
+    def poll(self) -> dict[str, JobState]:
+        return {j.name: j.state for j in self.jobs}
+
+    def wait(
+        self,
+        check_interval: float = 2.0,
+        until: str = "trainers",  # "trainers" | "all"
+    ) -> None:
+        """Block until trainer jobs finish (servers are then torn down) or
+        raise on the first failed job."""
+        while True:
+            self._raise_on_failure()
+            watched = [
+                j
+                for j in self.jobs
+                if until == "all" or j.name.startswith("trainer")
+            ]
+            if not watched:
+                return  # nothing to wait on — don't spin forever
+            if all(j.state is JobState.COMPLETED for j in watched):
+                return
+            time.sleep(check_interval)
+
+    def stop_all(self) -> None:
+        for job in reversed(self.jobs):
+            if job.proc is not None:
+                kill_process_tree(job.proc)
+        self.jobs.clear()
+
+
+def run_experiment(
+    config,
+    entrypoint: list[str],
+    *,
+    max_restarts: int = 0,
+) -> None:
+    """Launch servers+trainers per the allocation mode; auto-restart the
+    whole experiment on recoverable failure (parity: local.py recover loop)."""
+    alloc = AllocationMode.from_str(config.allocation_mode)
+    launcher = LocalLauncher(
+        config.experiment_name, config.trial_name, config.cluster.fileroot
+    )
+    model_path = getattr(config.decode, "model_path", "") or config.tokenizer_path
+    attempt = 0
+    while True:
+        try:
+            n_servers = (
+                alloc.gen.data_parallel_size
+                if alloc.type_ in (AllocationType.DECOUPLED_TRAIN,)
+                else 0
+            )
+            for i in range(n_servers):
+                launcher.submit_decode_server(i, model_path)
+            if n_servers:
+                launcher.wait_decode_servers(n_servers)
+            launcher.submit_trainers(entrypoint, n_procs=1)
+            launcher.wait()
+            return
+        except JobFailure as e:
+            launcher.stop_all()
+            attempt += 1
+            if attempt > max_restarts or not e.recoverable:
+                raise
+            logger.warning(
+                f"experiment failed ({e}); restart {attempt}/{max_restarts} "
+                f"in {RECOVER_TIME_INTERVAL}s"
+            )
+            time.sleep(RECOVER_TIME_INTERVAL)
+        except BaseException:
+            launcher.stop_all()
+            raise
+
+
+def main(argv: list[str] | None = None) -> None:
+    """CLI: python -m areal_tpu.launcher.local entry.py --config cfg.yaml [k=v]"""
+    from areal_tpu.api.cli_args import BaseExperimentConfig, load_expr_config
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    assert argv and argv[0].endswith(".py"), (
+        "usage: python -m areal_tpu.launcher.local entry.py --config cfg.yaml"
+    )
+    entry = argv[0]
+    config, _ = load_expr_config(argv[1:], BaseExperimentConfig)
+    run_experiment(config, [sys.executable, entry] + argv[1:])
+
+
+if __name__ == "__main__":
+    main()

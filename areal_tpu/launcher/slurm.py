@@ -1,0 +1,159 @@
+"""SLURM launcher: sbatch script generation + squeue polling.
+
+Parity: areal/launcher/slurm.py:46 SlurmLauncher — renders sbatch scripts
+(container image, nodelist, mem/accelerator gres), submits LLM-server and
+trainer job arrays, polls squeue states, cancels on failure.
+
+TPU notes: TPU-on-SLURM sites expose chips via `--gres=tpu:N` or dedicated
+partitions; trainer jobs get jax.distributed coordinator env rather than
+MASTER_ADDR/RANK. Script *generation* is pure and unit-tested; submission
+requires the sbatch/squeue binaries at runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import subprocess
+import time
+
+from areal_tpu.launcher.base import JobState
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("slurm_launcher")
+
+SQUEUE_STATE_MAP = {
+    "PENDING": JobState.PENDING,
+    "CONFIGURING": JobState.PENDING,
+    "RUNNING": JobState.RUNNING,
+    "COMPLETING": JobState.RUNNING,
+    "COMPLETED": JobState.COMPLETED,
+    "FAILED": JobState.FAILED,
+    "OUT_OF_MEMORY": JobState.FAILED,
+    "TIMEOUT": JobState.FAILED,
+    "NODE_FAIL": JobState.FAILED,
+    "PREEMPTED": JobState.FAILED,
+    "CANCELLED": JobState.CANCELLED,
+}
+
+
+@dataclasses.dataclass
+class SlurmJobSpec:
+    name: str
+    cmd: str
+    n_nodes: int = 1
+    cpus_per_task: int = 4
+    mem_mb: int = 32 * 1024
+    accelerators_per_node: int = 0  # rendered as --gres=tpu:N
+    partition: str | None = None
+    container_image: str | None = None
+    container_mounts: str | None = None
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    time_limit: str | None = None
+    nodelist: str | None = None
+
+
+def render_sbatch_script(spec: SlurmJobSpec, log_dir: str) -> str:
+    lines = [
+        "#!/bin/bash",
+        f"#SBATCH --job-name={spec.name}",
+        f"#SBATCH --nodes={spec.n_nodes}",
+        "#SBATCH --ntasks-per-node=1",
+        f"#SBATCH --cpus-per-task={spec.cpus_per_task}",
+        f"#SBATCH --mem={spec.mem_mb}M",
+        f"#SBATCH --output={os.path.join(log_dir, spec.name + '.%j.log')}",
+        "#SBATCH --open-mode=append",
+    ]
+    if spec.accelerators_per_node:
+        lines.append(f"#SBATCH --gres=tpu:{spec.accelerators_per_node}")
+    if spec.partition:
+        lines.append(f"#SBATCH --partition={spec.partition}")
+    if spec.time_limit:
+        lines.append(f"#SBATCH --time={spec.time_limit}")
+    if spec.nodelist:
+        lines.append(f"#SBATCH --nodelist={spec.nodelist}")
+    lines.append("")
+    for k, v in spec.env.items():
+        lines.append(f"export {k}={v}")
+    # jax.distributed rendezvous: first node in the allocation coordinates.
+    # NUM_PROCESSES/COORDINATOR are allocation-constant, so they may be
+    # exported in the batch script; PROCESS_ID must expand *inside* each srun
+    # task ($SLURM_PROCID is 0 in the batch shell itself).
+    lines += [
+        "export AREAL_TPU_NUM_PROCESSES=$SLURM_JOB_NUM_NODES",
+        'export AREAL_TPU_COORDINATOR="$(scontrol show hostnames '
+        '$SLURM_JOB_NODELIST | head -n1):8476"',
+        "",
+    ]
+    task_cmd = f"export AREAL_TPU_PROCESS_ID=$SLURM_PROCID; {spec.cmd}"
+    if spec.container_image:
+        mounts = f" --container-mounts={spec.container_mounts}" if spec.container_mounts else ""
+        run = (
+            f"srun --container-image={spec.container_image}{mounts} "
+            f"bash -c {task_cmd!r}"
+        )
+    else:
+        run = f"srun bash -c {task_cmd!r}"
+    lines.append(run)
+    return "\n".join(lines) + "\n"
+
+
+class SlurmLauncher:
+    def __init__(self, experiment_name: str, trial_name: str, fileroot: str):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.fileroot = fileroot
+        self.job_ids: dict[str, str] = {}
+        if shutil.which("sbatch") is None:
+            logger.warning("sbatch not found; submission will fail")
+
+    def log_dir(self) -> str:
+        d = os.path.join(
+            self.fileroot, "logs", self.experiment_name, self.trial_name
+        )
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def submit(self, spec: SlurmJobSpec) -> str:
+        script = render_sbatch_script(spec, self.log_dir())
+        path = os.path.join(self.log_dir(), f"{spec.name}.sbatch")
+        with open(path, "w") as f:
+            f.write(script)
+        out = subprocess.check_output(["sbatch", path], text=True)
+        # "Submitted batch job 12345"
+        job_id = out.strip().split()[-1]
+        self.job_ids[spec.name] = job_id
+        logger.info(f"sbatch {spec.name}: job {job_id}")
+        return job_id
+
+    def poll(self) -> dict[str, JobState]:
+        if not self.job_ids:
+            return {}
+        ids = ",".join(self.job_ids.values())
+        out = subprocess.check_output(
+            ["squeue", "-j", ids, "-h", "-o", "%i %T"], text=True
+        )
+        by_id = {}
+        for line in out.splitlines():
+            jid, state = line.split()
+            by_id[jid] = SQUEUE_STATE_MAP.get(state, JobState.NOT_FOUND)
+        return {
+            name: by_id.get(jid, JobState.COMPLETED)  # gone = finished
+            for name, jid in self.job_ids.items()
+        }
+
+    def wait(self, check_interval: float = 10.0) -> None:
+        while True:
+            states = self.poll()
+            if any(s is JobState.FAILED for s in states.values()):
+                self.stop_all()
+                raise RuntimeError(f"slurm job failed: {states}")
+            if all(not s.active() for s in states.values()):
+                return
+            time.sleep(check_interval)
+
+    def stop_all(self) -> None:
+        for jid in self.job_ids.values():
+            subprocess.run(["scancel", jid], check=False)
+        self.job_ids.clear()

@@ -1,0 +1,104 @@
+"""Multi-turn self-correction workflow (parity: areal/workflow/multi_turn.py).
+
+One episode = up to `max_turns` rounds of: generate an answer → score it →
+if wrong, append a feedback prompt and try again. The final reward is
+discounted by `turn_discount` per extra turn, and the loss mask covers only
+the model's own completions (feedback/prompt tokens are context, not
+targets). The whole conversation is emitted as ONE packed row so the
+trainer sees a single long sequence.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils.data import pad_sequences_to_tensors
+
+DEFAULT_FEEDBACK = (
+    "\nYour answer is either wrong or not parsable to the reward function. "
+    "You may misunderstand the original question. Please carefully read the "
+    "original question, check the preceding errors, and try to answer it again.\n"
+)
+
+
+class MultiTurnWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn: Callable[..., float],
+        gconfig: GenerationHyperparameters,
+        tokenizer: Any,
+        max_turns: int = 3,
+        turn_discount: float = 0.9,
+        feedback_text: str = DEFAULT_FEEDBACK,
+        reward_timeout_seconds: float = 15.0,
+    ):
+        self.reward_fn = AsyncRewardWrapper(
+            reward_fn, timeout_seconds=reward_timeout_seconds
+        )
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.max_turns = max_turns
+        self.turn_discount = turn_discount
+        self.feedback_text = feedback_text
+
+    def _encode_prompt(self, data: dict[str, Any]) -> list[int]:
+        if "input_ids" in data:
+            return list(np.asarray(data["input_ids"]).reshape(-1))
+        if "messages" in data:
+            return self.tokenizer.apply_chat_template(
+                data["messages"], add_generation_prompt=True, tokenize=True
+            )
+        return self.tokenizer.encode(data["prompt"])
+
+    async def arun_episode(self, engine, data: dict[str, Any]):
+        prompt_ids = self._encode_prompt(data)
+        seq = list(prompt_ids)
+        loss_mask = [0] * len(seq)
+        logprobs = [0.0] * len(seq)
+        versions = [-1] * len(seq)
+
+        discount = 1.0
+        reward = 0.0
+        feedback_ids = self.tokenizer.encode(self.feedback_text)
+        for turn in range(self.max_turns):
+            req = ModelRequest(
+                rid=str(uuid.uuid4()),
+                input_ids=list(seq),
+                gconfig=self.gconfig.new(n_samples=1),
+                tokenizer=self.tokenizer,
+            )
+            resp = await engine.agenerate(req)
+            seq += resp.output_tokens
+            loss_mask += [1] * resp.output_len
+            logprobs += resp.output_logprobs
+            versions += resp.output_versions
+
+            completion_str = self.tokenizer.decode(resp.output_tokens)
+            reward = await self.reward_fn(
+                None, completion_str, resp.input_tokens, resp.output_tokens, **data
+            )
+            if reward > 0 or turn == self.max_turns - 1:
+                break
+            # Wrong answer: append feedback (context only) and retry.
+            seq += feedback_ids
+            loss_mask += [0] * len(feedback_ids)
+            logprobs += [0.0] * len(feedback_ids)
+            versions += [-1] * len(feedback_ids)
+            discount *= self.turn_discount
+
+        row = dict(
+            input_ids=np.array(seq, dtype=np.int32),
+            loss_mask=np.array(loss_mask, dtype=np.int32),
+            logprobs=np.array(logprobs, dtype=np.float32),
+            versions=np.array(versions, dtype=np.int32),
+            rewards=np.float32(float(reward) * discount),
+            begin_of_answer=np.int32(len(prompt_ids)),
+        )
+        return pad_sequences_to_tensors([row])

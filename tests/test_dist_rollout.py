@@ -1,0 +1,93 @@
+"""DistRolloutCoordinator: group-preserving FFD balance + host gather."""
+
+import numpy as np
+import pytest
+
+from areal_tpu.core.dist_rollout import (
+    DistRolloutCoordinator,
+    merge_host_batches,
+    redistribute,
+)
+
+
+def make_batch(lens, T=None):
+    T = T or max(lens)
+    B = len(lens)
+    am = np.zeros((B, T), dtype=np.int32)
+    ids = np.zeros((B, T), dtype=np.int32)
+    for i, l in enumerate(lens):
+        am[i, :l] = 1
+        ids[i, :l] = np.arange(1, l + 1) + 100 * i
+    return {"input_ids": ids, "attention_mask": am}
+
+
+def test_redistribute_preserves_rows_and_groups():
+    lens = [30, 29, 5, 6, 20, 21, 4, 3]  # 4 groups of 2
+    batch = make_batch(lens)
+    out, plan = redistribute(batch, group_size=2, dp_size=2)
+    # Permutation: every original row appears exactly once.
+    assert sorted(plan.row_order.tolist()) == list(range(8))
+    # Groups stay adjacent: rows 2g, 2g+1 remain neighbours.
+    pos = {int(r): i for i, r in enumerate(plan.row_order)}
+    for g in range(4):
+        assert abs(pos[2 * g] - pos[2 * g + 1]) == 1
+    # Balance: the two shards' token totals are closer than the naive split.
+    naive = [sum(lens[:4]), sum(lens[4:])]
+    assert max(plan.shard_tokens) - min(plan.shard_tokens) <= max(naive) - min(naive)
+    # Rows carried their content.
+    for new_i, old_i in enumerate(plan.row_order):
+        np.testing.assert_array_equal(
+            out["input_ids"][new_i], batch["input_ids"][old_i]
+        )
+
+
+def test_redistribute_group_divisibility_error():
+    batch = make_batch([4, 5, 6])
+    with pytest.raises(AssertionError):
+        redistribute(batch, group_size=2, dp_size=1)
+
+
+def test_redistribute_scalar_and_1d_fields_pass_through():
+    batch = make_batch([4, 5, 6, 7])
+    batch["rewards"] = np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32)
+    out, plan = redistribute(batch, group_size=1, dp_size=2)
+    np.testing.assert_array_equal(out["rewards"], batch["rewards"][plan.row_order])
+
+
+class _FakeRollout:
+    def __init__(self, batch):
+        self.batch = batch
+
+    def prepare_batch(self, dataloader, **kw):
+        return self.batch
+
+    def rollout_batch(self, data, **kw):
+        return self.batch
+
+
+class _FakeTrain:
+    def __init__(self, dp):
+        self.dp = dp
+
+    def data_parallel_world_size(self):
+        return self.dp
+
+
+def test_coordinator_simulated_two_hosts():
+    # Two "hosts" each produce half the batch with different pad lengths;
+    # the injected allgather merges them like process_allgather would.
+    host0 = make_batch([10, 12, 3, 4], T=12)
+    host1 = make_batch([25, 24, 7, 8], T=25)
+
+    def fake_allgather(local):
+        return merge_host_batches([host0, host1])
+
+    coord = DistRolloutCoordinator(
+        _FakeTrain(dp=2), _FakeRollout(host0), allgather_fn=fake_allgather
+    )
+    out, plan = coord.prepare_batch(None, granularity=2)
+    assert out["input_ids"].shape[0] == 8
+    # The two long groups (25,24) and (10,12) should land on different shards.
+    assert max(plan.shard_tokens) - min(plan.shard_tokens) <= 22
+    # All 8 rows present.
+    assert sorted(plan.row_order.tolist()) == list(range(8))

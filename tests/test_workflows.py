@@ -1,0 +1,128 @@
+"""Multi-turn and vision RLVR workflows against a scripted mock engine."""
+
+import asyncio
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelResponse
+from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+from areal_tpu.workflow.vision_rlvr import VisionRLVRWorkflow
+
+
+class FakeTokenizer:
+    def encode(self, text):
+        return [10 + (ord(c) % 50) for c in text[:5]] or [7]
+
+    def decode(self, ids):
+        return " ".join(str(i) for i in ids)
+
+    def apply_chat_template(self, messages, **kw):
+        return [1, 2, 3]
+
+
+class ScriptedEngine:
+    """Returns scripted completions in order; stamps version 3."""
+
+    def __init__(self, completions):
+        self.completions = list(completions)
+        self.calls = 0
+
+    async def agenerate(self, req):
+        out = self.completions[min(self.calls, len(self.completions) - 1)]
+        self.calls += 1
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=list(out),
+            output_logprobs=[-0.5] * len(out),
+            output_versions=[3] * len(out),
+            stop_reason="stop",
+        )
+
+    def get_version(self):
+        return 3
+
+
+def test_multi_turn_retries_and_discounts():
+    # Reward: only the completion [42] is correct.
+    def reward_fn(prompt, completion, prompt_ids, completion_ids, **kw):
+        return 1.0 if completion_ids == [42] else 0.0
+
+    eng = ScriptedEngine([[5, 6], [42]])
+    wf = MultiTurnWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=8),
+        FakeTokenizer(),
+        max_turns=3,
+        turn_discount=0.5,
+    )
+    batch = asyncio.run(wf.arun_episode(eng, {"input_ids": [1, 2, 3]}))
+    assert eng.calls == 2  # wrong once, then right
+    # Discounted: 1.0 * 0.5 (one feedback round)
+    assert float(batch["rewards"][0]) == 0.5
+    ids = np.asarray(batch["input_ids"][0])
+    lm = np.asarray(batch["loss_mask"][0])
+    # Loss mask covers exactly the two completions (2 + 1 tokens).
+    assert int(lm.sum()) == 3
+    # The feedback tokens sit between the turns with loss_mask 0.
+    first_completion_at = np.flatnonzero(lm)[0]
+    assert ids[first_completion_at] == 5
+
+
+def test_multi_turn_gives_up_at_max_turns():
+    def reward_fn(prompt, completion, prompt_ids, completion_ids, **kw):
+        return 0.0
+
+    eng = ScriptedEngine([[5], [6], [7], [8]])
+    wf = MultiTurnWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=8),
+        FakeTokenizer(),
+        max_turns=2,
+        turn_discount=0.5,
+    )
+    batch = asyncio.run(wf.arun_episode(eng, {"input_ids": [1, 2]}))
+    assert eng.calls == 2
+    assert float(batch["rewards"][0]) == 0.0
+
+
+def test_vision_rlvr_passes_images_and_groups():
+    seen_image_data = []
+
+    class VisionEngine(ScriptedEngine):
+        async def agenerate(self, req):
+            seen_image_data.append(req.image_data)
+            return await super().agenerate(req)
+
+    def reward_fn(prompt, completion, prompt_ids, completion_ids, **kw):
+        return float(len(completion_ids))
+
+    eng = VisionEngine([[4, 4]])
+    wf = VisionRLVRWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=3, max_new_tokens=8),
+        tokenizer=FakeTokenizer(),
+    )
+    data = {"input_ids": [1, 2], "images": ["imgbytes"]}
+    batch = asyncio.run(wf.arun_episode(eng, data))
+    assert len(seen_image_data) == 3 and seen_image_data[0] == ["imgbytes"]
+    assert batch["input_ids"].shape[0] == 3  # the GRPO group
+    assert np.allclose(np.asarray(batch["rewards"]), 2.0)
+
+
+def test_image_data_rides_generate_payload():
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core.remote_inf_engine import JaxDecodeBackend
+
+    req = ModelRequest(input_ids=[1, 2], image_data=[b"rawbytes", "already-b64"])
+    payload = JaxDecodeBackend().build_generate_payload(req)
+    import base64
+
+    assert payload["image_data"] == [
+        base64.b64encode(b"rawbytes").decode(),
+        "already-b64",
+    ]
+    # Text-only requests keep the lean payload.
+    assert "image_data" not in JaxDecodeBackend().build_generate_payload(
+        ModelRequest(input_ids=[1])
+    )
