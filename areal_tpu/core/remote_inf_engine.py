@@ -64,16 +64,30 @@ class RemoteBackend:
             "gconfig": dataclasses.asdict(req.gconfig),
         }
         if req.image_data:
-            # VLM inputs ride as base64 strings (callers pass bytes or str).
-            import base64
-
             payload["image_data"] = [
-                base64.b64encode(img).decode()
-                if isinstance(img, (bytes, bytearray))
-                else img
-                for img in req.image_data
+                self._encode_image_impl(img) for img in req.image_data
             ]
         return payload
+
+    @staticmethod
+    def _encode_image_impl(img: Any) -> str:
+        """bytes / base64-str / PIL-style image → base64 string."""
+        import base64
+
+        if isinstance(img, (bytes, bytearray)):
+            return base64.b64encode(img).decode()
+        if isinstance(img, str):
+            return img
+        if hasattr(img, "save"):  # PIL.Image duck type
+            import io
+
+            buf = io.BytesIO()
+            img.save(buf, format="PNG")
+            return base64.b64encode(buf.getvalue()).decode()
+        raise TypeError(
+            f"image_data entries must be bytes, base64 str, or PIL images; "
+            f"got {type(img).__name__}"
+        )
 
     def parse_generate_response(self, data: dict[str, Any]) -> dict[str, Any]:
         return {

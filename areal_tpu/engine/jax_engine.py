@@ -415,6 +415,16 @@ class JaxTrainEngine(TrainEngine):
         grad_dtype = jnp.dtype(self.config.grad_reduce_dtype)
 
         def loss_of(params, mb):
+            if model_cfg.num_experts and model_cfg.router_aux_loss_coef > 0:
+                logits, aux = model_forward(
+                    params,
+                    mb["input_ids"],
+                    mb["position_ids"],
+                    mb["segment_ids"],
+                    model_cfg,
+                    with_aux=True,
+                )
+                return loss_fn(logits, mb) + model_cfg.router_aux_loss_coef * aux
             logits = model_forward(
                 params,
                 mb["input_ids"],

@@ -221,6 +221,7 @@ def run_experiment(
                 launcher.wait_decode_servers(n_servers)
             launcher.submit_trainers(entrypoint, n_procs=1)
             launcher.wait()
+            launcher.stop_all()  # trainers done: tear down decode servers
             return
         except JobFailure as e:
             launcher.stop_all()
@@ -247,7 +248,16 @@ def main(argv: list[str] | None = None) -> None:
     )
     entry = argv[0]
     config, _ = load_expr_config(argv[1:], BaseExperimentConfig)
-    run_experiment(config, [sys.executable, entry] + argv[1:])
+    max_restarts = (
+        config.recover.retries
+        if config.recover.mode in ("auto", "fault")
+        else 0
+    )
+    run_experiment(
+        config,
+        [sys.executable, entry] + argv[1:],
+        max_restarts=max_restarts,
+    )
 
 
 if __name__ == "__main__":

@@ -34,24 +34,42 @@ def _require_ray():
 
 
 def resolve_coordinator(
-    experiment_name: str, trial_name: str, rank: int, *, timeout: float = 300.0
+    experiment_name: str,
+    trial_name: str,
+    rank: int,
+    *,
+    group: str = "ray_coord",
+    timeout: float = 300.0,
 ) -> str:
     """jax.distributed rendezvous address, decided *inside* the tasks.
 
     The driver cannot know where Ray will place rank 0, so rank 0 binds a
     free port on whatever node it landed on and publishes host:port through
     name_resolve (which must be a cross-host backend — nfs/etcd); other
-    ranks block on the key.
+    ranks block on the key. `group` must be unique per submit_array so
+    concurrent arrays (and restarted trials, see clear below) don't read
+    each other's coordinator.
     """
     from areal_tpu.utils import name_resolve, names
     from areal_tpu.utils.network import find_free_ports
 
-    key = names.distributed_peer(experiment_name, trial_name, "ray_coord", 0)
+    key = names.distributed_peer(experiment_name, trial_name, group, 0)
     if rank == 0:
         addr = f"{gethostip()}:{find_free_ports(1)[0]}"
         name_resolve.add(key, addr, replace=True)
         return addr
     return name_resolve.wait(key, timeout=timeout)
+
+
+def clear_coordinator(experiment_name: str, trial_name: str, group: str) -> None:
+    from areal_tpu.utils import name_resolve, names
+
+    try:
+        name_resolve.delete(
+            names.distributed_peer(experiment_name, trial_name, group, 0)
+        )
+    except Exception:
+        pass
 
 
 def trainer_env_hook(rank: int, world: int, coordinator: str) -> dict[str, str]:
@@ -63,12 +81,16 @@ def trainer_env_hook(rank: int, world: int, coordinator: str) -> dict[str, str]:
     }
 
 
-def _dist_task_wrapper(fn: Callable, experiment_name: str, trial_name: str):
+def _dist_task_wrapper(
+    fn: Callable, experiment_name: str, trial_name: str, group: str
+):
     """Wrap the user fn so each task resolves the coordinator at runtime and
     exports the distributed env before user code imports jax."""
 
     def task(rank: int, world: int, *args):
-        coord = resolve_coordinator(experiment_name, trial_name, rank)
+        coord = resolve_coordinator(
+            experiment_name, trial_name, rank, group=group
+        )
         os.environ.update(trainer_env_hook(rank, world, coord))
         return fn(rank, *args)
 
@@ -99,7 +121,13 @@ class RayLauncher:
             ray.init(address=os.environ.get("RAY_ADDRESS", "auto"))
 
         resources = {"TPU": tpus_per_task} if tpus_per_task else None
-        task = _dist_task_wrapper(fn, self.experiment_name, self.trial_name)
+        group = f"ray_coord/{name}"
+        # Drop any stale coordinator key from a previous run of this trial
+        # before ranks start racing on it.
+        clear_coordinator(self.experiment_name, self.trial_name, group)
+        task = _dist_task_wrapper(
+            fn, self.experiment_name, self.trial_name, group
+        )
 
         refs = []
         for rank in range(count):

@@ -127,19 +127,45 @@ class SlurmLauncher:
         logger.info(f"sbatch {spec.name}: job {job_id}")
         return job_id
 
+    def _final_state(self, jid: str) -> JobState:
+        """A job vanished from squeue: ask sacct how it ended; assume
+        COMPLETED only when accounting is unavailable."""
+        try:
+            out = subprocess.run(
+                ["sacct", "-j", jid, "-n", "-X", "-o", "State"],
+                capture_output=True,
+                text=True,
+                timeout=30,
+            )
+            state = out.stdout.split()[0] if out.stdout.split() else ""
+            # sacct states may carry suffixes like "CANCELLED by 123"
+            for known, mapped in SQUEUE_STATE_MAP.items():
+                if state.startswith(known):
+                    return mapped
+        except (OSError, subprocess.SubprocessError):
+            pass
+        return JobState.COMPLETED
+
     def poll(self) -> dict[str, JobState]:
         if not self.job_ids:
             return {}
         ids = ",".join(self.job_ids.values())
-        out = subprocess.check_output(
-            ["squeue", "-j", ids, "-h", "-o", "%i %T"], text=True
+        # squeue exits non-zero when every queried id has been purged; that
+        # is not an error, it means "none still queued".
+        out = subprocess.run(
+            ["squeue", "-j", ids, "-h", "-o", "%i %T"],
+            capture_output=True,
+            text=True,
         )
         by_id = {}
-        for line in out.splitlines():
-            jid, state = line.split()
-            by_id[jid] = SQUEUE_STATE_MAP.get(state, JobState.NOT_FOUND)
+        for line in out.stdout.splitlines():
+            parts = line.split()
+            if len(parts) == 2:
+                by_id[parts[0]] = SQUEUE_STATE_MAP.get(
+                    parts[1], JobState.NOT_FOUND
+                )
         return {
-            name: by_id.get(jid, JobState.COMPLETED)  # gone = finished
+            name: by_id.get(jid) or self._final_state(jid)
             for name, jid in self.job_ids.items()
         }
 

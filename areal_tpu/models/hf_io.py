@@ -78,11 +78,22 @@ def hf_name_to_ours(name: str) -> tuple[str, ...] | None:
             "mlp.gate_proj.weight": ("mlp", "gate_kernel"),
             "mlp.up_proj.weight": ("mlp", "up_kernel"),
             "mlp.down_proj.weight": ("mlp", "down_kernel"),
+            "mlp.gate.weight": ("mlp", "router_kernel"),  # MoE router
             "input_layernorm.weight": ("input_norm",),
             "post_attention_layernorm.weight": ("post_attn_norm",),
         }
         if rest in table:
             return (f"layers_{i}",) + table[rest]
+        # MoE experts: mlp.experts.{m}.{gate,up,down}_proj.weight → a
+        # per-expert path that assemble_params stacks along axis 0.
+        if rest.startswith("mlp.experts."):
+            sub = rest.split(".")
+            m = int(sub[2])
+            proj = sub[3]  # gate_proj | up_proj | down_proj
+            leaf = {"gate_proj": "gate_kernel", "up_proj": "up_kernel",
+                    "down_proj": "down_kernel"}.get(proj)
+            if leaf and sub[4] == "weight":
+                return (f"layers_{i}", "mlp", f"expert_{m}", leaf)
     return None
 
 
@@ -100,7 +111,8 @@ def _convert_tensor(path: tuple[str, ...], w: np.ndarray, cfg: ModelConfig) -> n
         return w.reshape(nH, hd)
     if leaf in ("k_bias", "v_bias"):
         return w.reshape(nKV, hd)
-    if leaf in ("gate_kernel", "up_kernel", "down_kernel", "kernel"):
+    if leaf in ("gate_kernel", "up_kernel", "down_kernel", "kernel",
+                "router_kernel"):
         return np.ascontiguousarray(w.T)
     return w  # norms, embedding
 
@@ -115,7 +127,8 @@ def _unconvert_tensor(path: tuple[str, ...], w: np.ndarray, cfg: ModelConfig) ->
         return np.ascontiguousarray(w.reshape(-1, H).T)
     if leaf in ("q_bias", "k_bias", "v_bias"):
         return w.reshape(-1)
-    if leaf in ("gate_kernel", "up_kernel", "down_kernel", "kernel"):
+    if leaf in ("gate_kernel", "up_kernel", "down_kernel", "kernel",
+                "router_kernel"):
         return np.ascontiguousarray(w.T)
     return w
 
@@ -150,6 +163,21 @@ def assemble_params(
         tree[path[-1]] = value
 
     cast = lambda x: jnp.asarray(x, dtype=jnp.dtype(dtype))  # noqa: E731
+    if cfg.num_experts:
+        # Stack per-expert entries (…, "expert_{m}", leaf) → (…, leaf) [E, ...]
+        expert_keys = [
+            p for p in flat if any(s.startswith("expert_") for s in p)
+        ]
+        grouped: dict[tuple, dict[int, np.ndarray]] = {}
+        for p in expert_keys:
+            k = next(i for i, s in enumerate(p) if s.startswith("expert_"))
+            m = int(p[k].split("_")[1])
+            tgt = p[:k] + p[k + 1 :]
+            grouped.setdefault(tgt, {})[m] = flat.pop(p)
+        for tgt, by_idx in grouped.items():
+            flat[tgt] = np.stack(
+                [by_idx[m] for m in range(cfg.num_experts)], axis=0
+            )
     if cfg.tie_word_embeddings or cfg.is_critic:
         flat = {p: w for p, w in flat.items() if p[0] != "lm_head"}
     if cfg.is_critic and ("value_head", "kernel") not in flat:
@@ -227,6 +255,20 @@ def flatten_params(params: dict, cfg: ModelConfig) -> dict[tuple[str, ...], np.n
             else:
                 out[p] = w
         flat = out
+    if cfg.num_experts:
+        # Unstack [E, ...] expert tensors into per-expert paths.
+        out2: dict[tuple[str, ...], np.ndarray] = {}
+        for p, w in flat.items():
+            if (
+                len(p) >= 2
+                and p[-2] == "mlp"
+                and p[-1] in ("gate_kernel", "up_kernel", "down_kernel")
+            ):
+                for m in range(cfg.num_experts):
+                    out2[p[:-1] + (f"expert_{m}", p[-1])] = w[m]
+            else:
+                out2[p] = w
+        flat = out2
     return flat
 
 
@@ -244,6 +286,7 @@ def ours_name_to_hf(path: tuple[str, ...]) -> str:
         ("mlp", "gate_kernel"): "mlp.gate_proj.weight",
         ("mlp", "up_kernel"): "mlp.up_proj.weight",
         ("mlp", "down_kernel"): "mlp.down_proj.weight",
+        ("mlp", "router_kernel"): "mlp.gate.weight",
         ("input_norm",): "input_layernorm.weight",
         ("post_attn_norm",): "post_attention_layernorm.weight",
     }
@@ -259,6 +302,14 @@ def ours_name_to_hf(path: tuple[str, ...]) -> str:
         return "score.bias"
     if path[0].startswith("layers_"):
         i = int(path[0].split("_")[1])
+        if len(path) == 4 and path[2].startswith("expert_"):
+            m = int(path[2].split("_")[1])
+            proj = {
+                "gate_kernel": "gate_proj",
+                "up_kernel": "up_proj",
+                "down_kernel": "down_proj",
+            }[path[3]]
+            return f"model.layers.{i}.mlp.experts.{m}.{proj}.weight"
         return f"model.layers.{i}." + leaf_table[path[1:]]
     raise KeyError(path)
 

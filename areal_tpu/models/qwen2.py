@@ -71,6 +71,22 @@ class ModelConfig:
     # (parity: the reference's AutoModelForTokenClassification path,
     # areal/engine/base_hf_engine.py:180-187)
     is_critic: bool = False
+    # -- MoE (Qwen3-MoE / Mixtral-class; reference MoE support lives in
+    # Megatron EP + realhf/impl/model/modules/moe/{router,experts}.py) --
+    # num_experts == 0 means dense MLP. Dispatch is GShard-style grouped
+    # einsum with a capacity factor: expert weights are stacked [E, ...] and
+    # sharded over the "experts" logical axis, so under GSPMD the dispatch
+    # einsums lower to all-to-alls over the EP mesh axes.
+    num_experts: int = 0
+    num_experts_per_tok: int = 8
+    moe_intermediate_size: int | None = None
+    norm_topk_prob: bool = True
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.0
+    # token-group size for dispatch (memory of the dispatch tensor scales
+    # T * moe_group_size * top_k; smaller groups = less memory, slightly
+    # worse balance)
+    moe_group_size: int = 1024
 
     @property
     def head_dim_(self) -> int:
@@ -100,10 +116,29 @@ class ModelConfig:
             tie_word_embeddings=hf.get("tie_word_embeddings", False),
             max_position_embeddings=hf.get("max_position_embeddings", 32768),
             qkv_bias=model_type in ("qwen2",),
-            qk_norm=model_type in ("qwen3",),
+            qk_norm=model_type in ("qwen3", "qwen3_moe"),
         )
+        if model_type == "qwen3_moe":
+            kw.update(
+                num_experts=hf.get("num_experts", 0),
+                num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+                moe_intermediate_size=hf.get("moe_intermediate_size"),
+                norm_topk_prob=hf.get("norm_topk_prob", True),
+                router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.0),
+            )
+        elif model_type in ("qwen2_moe", "mixtral"):
+            # Loading these would silently drop shared-expert weights
+            # (qwen2_moe) or miss the block_sparse_moe.* names (mixtral).
+            raise NotImplementedError(
+                f"model_type={model_type!r}: shared-expert / mixtral weight "
+                "mapping not implemented — supported MoE family is qwen3_moe"
+            )
         kw.update(overrides)
         return cls(**kw)
+
+    @property
+    def moe_intermediate_size_(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
 
 
 # ---------------------------------------------------------------------------
@@ -121,11 +156,20 @@ def _layer_shapes(cfg: ModelConfig) -> dict:
             "v_kernel": (H, nKV, hd),
             "o_kernel": (nH, hd, H),
         },
-        "mlp": {
-            "gate_kernel": (H, M),
-            "up_kernel": (H, M),
-            "down_kernel": (M, H),
-        },
+        "mlp": (
+            {
+                "gate_kernel": (H, M),
+                "up_kernel": (H, M),
+                "down_kernel": (M, H),
+            }
+            if cfg.num_experts == 0
+            else {
+                "router_kernel": (H, cfg.num_experts),
+                "gate_kernel": (cfg.num_experts, H, cfg.moe_intermediate_size_),
+                "up_kernel": (cfg.num_experts, H, cfg.moe_intermediate_size_),
+                "down_kernel": (cfg.num_experts, cfg.moe_intermediate_size_, H),
+            }
+        ),
         "input_norm": (H,),
         "post_attn_norm": (H,),
     }
@@ -159,6 +203,17 @@ _LAYER_AXES = {
     "input_norm": ("norm",),
     "post_attn_norm": ("norm",),
 }
+
+_MOE_MLP_AXES = {
+    "router_kernel": ("embed", None),
+    "gate_kernel": ("experts", "embed", "mlp"),
+    "up_kernel": ("experts", "embed", "mlp"),
+    "down_kernel": ("experts", "mlp", "embed"),
+}
+
+
+def _mlp_axes(cfg: ModelConfig) -> dict:
+    return dict(_MOE_MLP_AXES) if cfg.num_experts else dict(_LAYER_AXES["mlp"])
 
 
 def param_shapes(cfg: ModelConfig) -> dict:
@@ -200,7 +255,7 @@ def param_logical_axes(cfg: ModelConfig) -> dict:
     shapes = _layer_shapes(cfg)
     layer_axes = {
         "attn": {k: _LAYER_AXES["attn"][k] for k in shapes["attn"]},
-        "mlp": dict(_LAYER_AXES["mlp"]),
+        "mlp": _mlp_axes(cfg),
         "input_norm": _LAYER_AXES["input_norm"],
         "post_attn_norm": _LAYER_AXES["post_attn_norm"],
     }
@@ -234,7 +289,10 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
     def init_one(shape, k):
         if len(shape) == 1 or (len(shape) == 2 and 0 in ()):  # norms
             return jnp.ones(shape, dtype=dtype)
-        fan_in = shape[0] if len(shape) >= 2 else 1
+        # fan-in = the contracted input dim: last-but-one for plain/stacked
+        # matrices ((H,M), (L,H,M), (E,H,M) → H), first for factored attention
+        # projections ((H, nH, hd) → H).
+        fan_in = shape[-2] if len(shape) >= 3 and shape[-2] >= shape[0] else shape[0]
         scale = 1.0 / np.sqrt(max(fan_in, 1))
         return (jax.random.truncated_normal(k, -2, 2, shape, jnp.float32) * scale).astype(dtype)
 
@@ -378,6 +436,92 @@ def mlp(layer_p: dict, x: jax.Array) -> jax.Array:
     return jnp.einsum("tm,mh->th", jax.nn.silu(gate) * up, layer_p["down_kernel"])
 
 
+def _moe_group_size(T: int, target: int) -> int:
+    """Largest divisor of T that is <= target (T is static under jit)."""
+    s = min(T, target)
+    while T % s != 0:
+        s -= 1
+    return s
+
+
+def moe_mlp(
+    layer_p: dict,
+    x: jax.Array,
+    cfg: ModelConfig,
+    valid: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Grouped GShard-style MoE: top-k routing with a per-group capacity,
+    dense dispatch/combine einsums, experts stacked [E, ...].
+
+    Returns (y [T, H], aux_loss scalar). Under GSPMD the dispatch einsums
+    contract the group/token dims against E-sharded expert weights — XLA
+    lowers that to all-to-alls over the mesh axes backing the "experts"
+    logical axis, which IS expert parallelism (no hand-written NCCL
+    grouped-GEMM path as in the reference's Megatron EP).
+    """
+    T, H = x.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    S = _moe_group_size(T, cfg.moe_group_size)
+    G = T // S
+    C = max(1, int(np.ceil(S * K / E * cfg.capacity_factor)))
+
+    router_logits = jnp.einsum(
+        "th,he->te", x.astype(jnp.float32), layer_p["router_kernel"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
+    gate_vals, topk_idx = jax.lax.top_k(probs, K)  # [T, K]
+    if cfg.norm_topk_prob:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    if valid is not None:
+        # Pad tokens neither claim expert capacity nor produce output.
+        gate_vals = gate_vals * valid[:, None].astype(gate_vals.dtype)
+
+    xg = x.reshape(G, S, H)
+    idx_g = topk_idx.reshape(G, S, K)
+    gates_g = gate_vals.reshape(G, S, K)
+    valid_g = None if valid is None else valid.reshape(G, S)
+
+    # Capacity assignment: k-th choices claim slots after all (k-1)-th
+    # choices (mesh-tf convention); overflow tokens are dropped for that
+    # expert (their gate weight is simply lost — capacity_factor > 1 keeps
+    # drops rare under balanced routing).
+    dispatch = jnp.zeros((G, S, E, C), dtype=x.dtype)
+    combine = jnp.zeros((G, S, E, C), dtype=jnp.float32)
+    counts = jnp.zeros((G, E), dtype=jnp.int32)
+    for k in range(K):
+        oh = jax.nn.one_hot(idx_g[..., k], E, dtype=jnp.int32)  # [G, S, E]
+        if valid_g is not None:
+            oh = oh * valid_g[..., None].astype(jnp.int32)
+        pos = jnp.cumsum(oh, axis=1) - oh + counts[:, None, :]  # [G, S, E]
+        keep = (pos < C) & (oh > 0)
+        slot_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[..., None]
+        dispatch = dispatch + slot_oh.astype(x.dtype)
+        combine = combine + slot_oh * gates_g[..., k][..., None, None]
+        counts = counts + oh.sum(axis=1)
+
+    xe = jnp.einsum("gsec,gsh->gech", dispatch, xg)  # [G, E, C, H]
+    h_gate = jnp.einsum("gech,ehm->gecm", xe, layer_p["gate_kernel"])
+    h_up = jnp.einsum("gech,ehm->gecm", xe, layer_p["up_kernel"])
+    he = jax.nn.silu(h_gate) * h_up
+    ye = jnp.einsum("gecm,emh->gech", he, layer_p["down_kernel"])
+    y = jnp.einsum("gsec,gech->gsh", combine.astype(ye.dtype), ye)
+    y = y.reshape(T, H).astype(x.dtype)
+
+    # Switch/GShard load-balancing aux over REAL tokens only:
+    # E * sum_e fraction_assigned_e * mean_prob_e
+    assign = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [T, K, E]
+    if valid is not None:
+        w = valid.astype(jnp.float32)
+        denom = jnp.maximum(w.sum(), 1.0)
+        frac = (assign * w[:, None, None]).sum(axis=(0, 1)) / (denom * K)
+        mean_prob = (probs * w[:, None]).sum(axis=0) / denom
+    else:
+        frac = assign.mean(axis=(0, 1))
+        mean_prob = probs.mean(axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return y, aux
+
+
 def decoder_layer(
     layer_p: dict,
     x: jax.Array,
@@ -386,11 +530,18 @@ def decoder_layer(
     segment_ids: jax.Array,
     mask: jax.Array | None,
     cfg: ModelConfig,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (hidden [T, H], router aux loss scalar — 0 for dense)."""
     h = rms_norm(x, layer_p["input_norm"], cfg.rms_norm_eps)
     x = x + attention(layer_p["attn"], h, cos, sin, segment_ids, mask, cfg)
     h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
-    return x + mlp(layer_p["mlp"], h)
+    if cfg.num_experts:
+        y, aux = moe_mlp(
+            layer_p["mlp"], h, cfg, valid=segment_ids != PADDING_SEGMENT
+        )
+    else:
+        y, aux = mlp(layer_p["mlp"], h), jnp.float32(0.0)
+    return x + y, aux
 
 
 def forward(
@@ -399,11 +550,14 @@ def forward(
     position_ids: jax.Array,
     segment_ids: jax.Array,
     cfg: ModelConfig,
+    *,
+    with_aux: bool = False,
 ) -> jax.Array:
     """Packed forward: [T] ids → [T, V] logits (f32).
 
     `segment_ids` mark sequence membership (PADDING_SEGMENT for pad tail);
-    attention is causal within a segment.
+    attention is causal within a segment. With `with_aux=True` also returns
+    the summed MoE router load-balancing loss (0 for dense models).
     """
     compute_dtype = jnp.dtype(cfg.dtype)
     x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
@@ -422,21 +576,20 @@ def forward(
 
     if cfg.scan_layers:
         def body(carry, layer_p):
-            return layer_fn(layer_p, carry, cos, sin, segment_ids, mask, cfg), None
+            h, aux_sum = carry
+            h, aux = layer_fn(layer_p, h, cos, sin, segment_ids, mask, cfg)
+            return (h, aux_sum + aux), None
 
-        # scan over the stacked [L, ...] layer params
-        def scan_body(x0):
-            y, _ = jax.lax.scan(
-                lambda c, p: body(c, p), x0, params["layers"]
-            )
-            return y
-
-        x = scan_body(x)
+        (x, aux_total), _ = jax.lax.scan(
+            body, (x, jnp.float32(0.0)), params["layers"]
+        )
     else:
+        aux_total = jnp.float32(0.0)
         for i in range(cfg.num_hidden_layers):
-            x = layer_fn(
+            x, aux = layer_fn(
                 params[f"layers_{i}"], x, cos, sin, segment_ids, mask, cfg
             )
+            aux_total = aux_total + aux
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if cfg.is_critic:
@@ -444,14 +597,18 @@ def forward(
             jnp.einsum("th,hk->tk", x, params["value_head"]["kernel"])
             + params["value_head"]["bias"]
         )
-        return values[:, 0].astype(jnp.float32)
-    if cfg.tie_word_embeddings:
-        logits = jnp.einsum(
+        out = values[:, 0].astype(jnp.float32)
+    elif cfg.tie_word_embeddings:
+        out = jnp.einsum(
             "th,vh->tv", x, params["embed"]["embedding"].astype(compute_dtype)
-        )
+        ).astype(jnp.float32)
     else:
-        logits = jnp.einsum("th,hv->tv", x, params["lm_head"]["kernel"])
-    return logits.astype(jnp.float32)
+        out = jnp.einsum(
+            "th,hv->tv", x, params["lm_head"]["kernel"]
+        ).astype(jnp.float32)
+    if with_aux:
+        return out, aux_total
+    return out
 
 
 def segment_ids_from_cu_seqlens(cu_seqlens: np.ndarray, total: int) -> np.ndarray:
@@ -529,7 +686,11 @@ def prefill(
             "tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"]
         )
         h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + mlp(layer_p["mlp"], h)
+        if cfg.num_experts:
+            y, _ = moe_mlp(layer_p["mlp"], h, cfg)
+        else:
+            y = mlp(layer_p["mlp"], h)
+        x = x + y
         return x, (k, v)
 
     if cfg.scan_layers:
@@ -598,7 +759,11 @@ def decode_step(
         ).reshape(R, nH, hd)
         x = x + jnp.einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"])
         h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + mlp(layer_p["mlp"], h)
+        if cfg.num_experts:
+            y, _ = moe_mlp(layer_p["mlp"], h, cfg)
+        else:
+            y = mlp(layer_p["mlp"], h)
+        x = x + y
         return x, (kc, vc)
 
     if cfg.scan_layers:

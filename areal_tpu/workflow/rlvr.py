@@ -57,15 +57,21 @@ class RLVRWorkflow(RolloutWorkflow):
             )
         return self.tokenizer.encode(data["prompt"])
 
-    async def arun_episode(self, engine, data: dict[str, Any]):
-        prompt_ids = self._encode_prompt(data)
-        n = self.gconfig.n_samples
-        req = ModelRequest(
+    def _build_request(
+        self, data: dict[str, Any], prompt_ids: list[int]
+    ) -> ModelRequest:
+        """Request-construction hook; VisionRLVRWorkflow adds image_data."""
+        return ModelRequest(
             rid=str(uuid.uuid4()),
             input_ids=prompt_ids,
             gconfig=self.gconfig.new(n_samples=1),
             tokenizer=self.tokenizer,
         )
+
+    async def arun_episode(self, engine, data: dict[str, Any]):
+        prompt_ids = self._encode_prompt(data)
+        n = self.gconfig.n_samples
+        req = self._build_request(data, prompt_ids)
         resps = await asyncio.gather(
             *[engine.agenerate(req.copy()) for _ in range(n)]
         )

@@ -1,16 +1,15 @@
 """Vision RLVR workflow (parity: areal/workflow/vision_rlvr.py).
 
-RLVR for vision-language models: the prompt carries images, which ride the
-ModelRequest.image_data field to the decode backend; the processor (an HF
-AutoProcessor-style object) renders the multimodal chat template. Training
-tensors are identical in shape to text RLVR — the image tensors live on the
-inference side only (the reference likewise trains on token streams with
-pixel values re-computed by the trainer's processor when needed).
+RLVR for vision-language models: identical episode algorithm to
+RLVRWorkflow (n samples → reward → padded group batch), differing only in
+how the prompt is encoded (an HF AutoProcessor renders the multimodal chat
+template) and in the request carrying `image_data` to the decode backend.
+Training tensors are token-only — the image tensors live on the inference
+side (the reference likewise trains on token streams).
 """
 
 from __future__ import annotations
 
-import asyncio
 import uuid
 from typing import Any, Callable
 
@@ -18,12 +17,10 @@ import numpy as np
 
 from areal_tpu.api.cli_args import GenerationHyperparameters
 from areal_tpu.api.io_struct import ModelRequest
-from areal_tpu.api.reward_api import AsyncRewardWrapper
-from areal_tpu.api.workflow_api import RolloutWorkflow
-from areal_tpu.utils.data import pad_sequences_to_tensors
+from areal_tpu.workflow.rlvr import RLVRWorkflow
 
 
-class VisionRLVRWorkflow(RolloutWorkflow):
+class VisionRLVRWorkflow(RLVRWorkflow):
     def __init__(
         self,
         reward_fn: Callable[..., float],
@@ -31,17 +28,20 @@ class VisionRLVRWorkflow(RolloutWorkflow):
         tokenizer: Any = None,
         processor: Any = None,
         enable_thinking: bool = False,
+        dump_dir: str | None = None,
         reward_timeout_seconds: float = 15.0,
     ):
-        self.reward_fn = AsyncRewardWrapper(
-            reward_fn, timeout_seconds=reward_timeout_seconds
+        super().__init__(
+            reward_fn,
+            gconfig,
+            tokenizer=tokenizer,
+            enable_thinking=enable_thinking,
+            dump_dir=dump_dir,
+            reward_timeout_seconds=reward_timeout_seconds,
         )
-        self.gconfig = gconfig
-        self.tokenizer = tokenizer
         self.processor = processor
-        self.enable_thinking = enable_thinking
 
-    def _encode(self, data: dict[str, Any]) -> list[int]:
+    def _encode_prompt(self, data: dict[str, Any]) -> list[int]:
         if "input_ids" in data:
             return list(np.asarray(data["input_ids"]).reshape(-1))
         if self.processor is not None and "messages" in data:
@@ -49,60 +49,22 @@ class VisionRLVRWorkflow(RolloutWorkflow):
                 data["messages"],
                 add_generation_prompt=True,
                 tokenize=False,
+                enable_thinking=self.enable_thinking,
             )
             enc = self.processor(
                 text=[text], images=data.get("images"), return_tensors="np"
             )
             return list(np.asarray(enc["input_ids"]).reshape(-1))
-        assert self.tokenizer is not None
-        return self.tokenizer.encode(data["prompt"])
+        return super()._encode_prompt(data)
 
-    async def arun_episode(self, engine, data: dict[str, Any]):
-        prompt_ids = self._encode(data)
+    def _build_request(
+        self, data: dict[str, Any], prompt_ids: list[int]
+    ) -> ModelRequest:
         images = data.get("images")
-        n = self.gconfig.n_samples
-        req = ModelRequest(
+        return ModelRequest(
             rid=str(uuid.uuid4()),
             input_ids=prompt_ids,
             gconfig=self.gconfig.new(n_samples=1),
             tokenizer=self.tokenizer,
             image_data=list(images) if images is not None else None,
         )
-        resps = await asyncio.gather(
-            *[engine.agenerate(req.copy()) for _ in range(n)]
-        )
-        results = []
-        for resp in resps:
-            seq = resp.input_tokens + resp.output_tokens
-            completion_str = (
-                self.tokenizer.decode(resp.output_tokens)
-                if self.tokenizer is not None
-                else None
-            )
-            reward = await self.reward_fn(
-                None,
-                completion_str,
-                resp.input_tokens,
-                resp.output_tokens,
-                **data,
-            )
-            results.append(
-                dict(
-                    input_ids=np.array(seq, dtype=np.int32),
-                    loss_mask=np.array(
-                        [0] * resp.input_len + [1] * resp.output_len,
-                        dtype=np.int32,
-                    ),
-                    logprobs=np.array(
-                        [0.0] * resp.input_len + resp.output_logprobs,
-                        dtype=np.float32,
-                    ),
-                    versions=np.array(
-                        [-1] * resp.input_len + resp.output_versions,
-                        dtype=np.int32,
-                    ),
-                    rewards=np.float32(reward),
-                    begin_of_answer=np.int32(resp.input_len),
-                )
-            )
-        return pad_sequences_to_tensors(results)

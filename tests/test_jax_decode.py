@@ -84,7 +84,9 @@ def test_greedy_decode_matches_forward(engine):
 def test_stop_token_truncates(engine):
     prompt = [1, 5, 9, 13, 2]
     full = greedy_reference(engine.params, prompt, 12)
-    stop_tok = full[4]  # force a stop at the 5th generated token
+    stop_tok = full[4]
+    # generation halts at the stop token's FIRST occurrence (inclusive)
+    cut = full.index(stop_tok) + 1
     resp = engine.generate(
         ModelRequest(
             input_ids=prompt,
@@ -95,9 +97,9 @@ def test_stop_token_truncates(engine):
         timeout=300,
     )
     assert resp.stop_reason == "stop"
-    assert resp.output_tokens == full[:5]
-    assert len(resp.output_logprobs) == 5
-    assert len(resp.output_versions) == 5
+    assert resp.output_tokens == full[:cut]
+    assert len(resp.output_logprobs) == cut
+    assert len(resp.output_versions) == cut
 
 
 @pytest.mark.slow
