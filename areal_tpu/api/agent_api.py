@@ -1,0 +1,46 @@
+"""Agent / environment APIs (parity: realhf/api/core/agent_api.py:15 Agent,
+realhf/impl/environment EnvironmentService).
+
+The legacy reference runs agents inside RolloutWorkers that talk to
+generation servers through obs/act queues and a PartialRolloutManager. In
+the TPU stack the equivalent machinery is the async workflow executor, so
+the agent contract is expressed directly against `InferenceEngine` and the
+adapter `AgentWorkflow` plugs any Agent+env pair into the standard rollout
+pipeline (submit/wait/prepare_batch, staleness control, interrupt-resume —
+all inherited for free).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+
+class EnvironmentService(abc.ABC):
+    """Gym-style async environment (parity: realhf EnvironmentService)."""
+
+    @abc.abstractmethod
+    async def reset(self, seed: int | None = None, options: dict | None = None):
+        """-> observation"""
+
+    @abc.abstractmethod
+    async def step(self, action: Any):
+        """-> (observation, reward, terminated, truncated, info)"""
+
+    async def close(self) -> None:
+        pass
+
+
+class Agent(abc.ABC):
+    """Collects one trajectory for one prompt (parity: agent_api.py:15
+    `collect_trajectory`; obs/act queues are subsumed by direct async calls)."""
+
+    @abc.abstractmethod
+    async def collect_trajectory(
+        self,
+        engine: Any,  # InferenceEngine
+        prompt: dict[str, Any],
+        env: EnvironmentService,
+    ) -> list[dict[str, Any]]:
+        """-> list of training rows (input_ids/loss_mask/logprobs/versions/
+        rewards per row), possibly empty to reject the episode."""

@@ -225,6 +225,13 @@ class InferenceEngine(abc.ABC):
     def update_weights_from_disk(self, meta: WeightUpdateMeta):
         raise NotImplementedError()
 
+    def update_weights_from_tensor(
+        self, named: dict, version: int | None = None, chunk_mb: int = 512
+    ) -> None:
+        """Install host tensors keyed by `/`-joined param-tree path (the
+        "dcn" in-memory push; see areal_tpu/core/weight_transfer.py)."""
+        raise NotImplementedError()
+
     def set_version(self, version: int) -> None:
         raise NotImplementedError()
 

@@ -60,7 +60,9 @@ class RemoteBackend:
     def build_generate_payload(self, req: ModelRequest) -> dict[str, Any]:
         payload = {
             "rid": req.rid,
-            "input_ids": list(req.input_ids),
+            # int() each id: numpy int64s (np.asarray-derived prompts) are
+            # not JSON serializable.
+            "input_ids": [int(t) for t in req.input_ids],
             "gconfig": dataclasses.asdict(req.gconfig),
         }
         if req.image_data:
@@ -281,10 +283,67 @@ class RemoteInfEngine(InferenceEngine):
             {"path": meta.path, "version": self._version},
         )
 
+    def update_weights_from_tensor(
+        self,
+        named: dict[str, Any],
+        version: int | None = None,
+        chunk_mb: int = 512,
+    ) -> None:
+        """In-memory push: stream framed weight buckets to every server,
+        then commit (pause → N×POST /update_weights_from_tensor →
+        /commit_weights → continue). The TPU analogue of the reference's
+        NCCL broadcast fast path (fsdp_engine.py:298-401), with DCN/HTTP as
+        the transport and the version stamped inside the servers' pause
+        window."""
+        from areal_tpu.core.weight_transfer import pack_buckets
+
+        async def _run():
+            try:
+                # Stream: one bucket in memory at a time, broadcast to all
+                # servers before building the next.
+                for b in pack_buckets(named, chunk_mb=chunk_mb):
+                    await asyncio.gather(
+                        *[
+                            arequest_with_retry(
+                                a,
+                                "/update_weights_from_tensor",
+                                data=b,
+                                max_retries=self.config.request_retries,
+                                timeout=self.config.request_timeout,
+                            )
+                            for a in self.addresses
+                        ]
+                    )
+                await asyncio.gather(
+                    *[
+                        arequest_with_retry(
+                            a,
+                            "/commit_weights",
+                            payload={"version": version},
+                            max_retries=self.config.request_retries,
+                            timeout=self.config.request_timeout,
+                        )
+                        for a in self.addresses
+                    ]
+                )
+            finally:
+                await close_current_session()
+
+        self.pause_generation(abort=False)
+        try:
+            asyncio.run(_run())
+            if version is not None:
+                self._version = int(version)
+                if self._executor is not None:
+                    self._executor.set_version(int(version))
+        finally:
+            self.continue_generation()
+
     def update_weights_from_distributed(self, meta: WeightUpdateMeta, **kw):
         raise NotImplementedError(
             "remote engines receive weights via disk or the DCN transfer "
-            "server; in-memory handoff is for colocated JaxDecodeEngine"
+            "server (update_weights_from_tensor); in-memory jax.Array "
+            "handoff is for colocated JaxDecodeEngine"
         )
 
     def update_weights(self, meta: WeightUpdateMeta) -> None:

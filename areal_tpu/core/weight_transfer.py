@@ -1,0 +1,190 @@
+"""Learner → decode-server weight transfer wire format (the "dcn" path).
+
+The reference's fast path broadcasts parameters over a dedicated NCCL group
+spanning trainer rank-0 + all inference workers, bucketed in ~1 GiB chunks
+with a param-spec manifest sent over HTTP first (fsdp_engine.py:298-401,
+io_struct.py WeightUpdateMeta/ParamSpec). TPU pods have no NCCL; the
+learner↔decode link is DCN, and the natural transport is the same HTTP
+control plane the decode servers already speak.
+
+Wire format per bucket (one POST body):
+
+    [8 bytes little-endian manifest length][manifest JSON][raw tensor bytes]
+
+The manifest lists {name, shape, dtype, offset, nbytes} per tensor; tensor
+bytes are the arrays' native layouts concatenated — bfloat16 stays bfloat16
+on the wire (half the bytes of the safetensors-numpy fallback, which cannot
+store bf16). Buckets are capped at `chunk_mb` (parity: the reference's
+weight_chunked_mem_mb) so server memory stays bounded and transfers
+pipeline across servers.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Iterable
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; gives numpy a bfloat16 dtype
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    _BFLOAT16 = None
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        assert _BFLOAT16 is not None, "bfloat16 wire format needs ml_dtypes"
+        return _BFLOAT16
+    return np.dtype(name)
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    if _BFLOAT16 is not None and dt == _BFLOAT16:
+        return "bfloat16"
+    return dt.name
+
+
+def flatten_named(tree: Any, prefix: tuple[str, ...] = ()) -> dict[str, np.ndarray]:
+    """Param pytree → {"a/b/c": ndarray} (host numpy, original dtype)."""
+    out: dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(flatten_named(v, prefix + (str(k),)))
+    else:
+        out["/".join(prefix)] = np.asarray(tree)
+    return out
+
+
+def set_named(tree: Any, named: dict[str, np.ndarray], cast=None) -> Any:
+    """Replace leaves of `tree` by name; unknown names error, missing names
+    keep the old leaf. Returns a new tree of the same structure."""
+    used: set[str] = set()
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            return {k: walk(v, prefix + (str(k),)) for k, v in node.items()}
+        name = "/".join(prefix)
+        if name in named:
+            used.add(name)
+            val = named[name]
+            return cast(val, node) if cast is not None else val
+        return node
+
+    new = walk(tree, ())
+    unknown = set(named) - used
+    if unknown:
+        raise KeyError(f"weight names not in target tree: {sorted(unknown)[:5]}")
+    return new
+
+
+def pack_buckets(
+    named: dict[str, np.ndarray], chunk_mb: int = 512
+) -> Iterable[bytes]:
+    """Yield framed bucket payloads, each <= chunk_mb. Tensors larger than
+    one bucket are split into byte-range parts (part_offset/total_nbytes in
+    the manifest) so no single HTTP body ever exceeds the limit — a 2.5 GiB
+    embedding streams as five 512 MiB frames. Yielding lazily keeps peak
+    extra host memory at one bucket."""
+    limit = chunk_mb * 1024 * 1024
+    manifest: list[dict] = []
+    chunks: list[bytes] = []
+    size = 0
+
+    def flush():
+        nonlocal manifest, chunks, size
+        mjson = json.dumps(manifest).encode()
+        payload = struct.pack("<Q", len(mjson)) + mjson + b"".join(chunks)
+        manifest, chunks, size = [], [], 0
+        return payload
+
+    for name, arr in named.items():
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        total = len(raw)
+        part_off = 0
+        while True:
+            take = min(limit - size, total - part_off)
+            manifest.append(
+                dict(
+                    name=name,
+                    shape=list(arr.shape),
+                    dtype=_dtype_name(arr.dtype),
+                    offset=size,
+                    nbytes=take,
+                    part_offset=part_off,
+                    total_nbytes=total,
+                )
+            )
+            chunks.append(raw[part_off : part_off + take])
+            size += take
+            part_off += take
+            if size >= limit:
+                yield flush()
+            if part_off >= total:
+                break
+    if manifest:
+        yield flush()
+
+
+def unpack_bucket_parts(payload: bytes) -> list[tuple[dict, bytes]]:
+    """One frame → [(spec, raw_bytes)] — parts of possibly-split tensors."""
+    (mlen,) = struct.unpack_from("<Q", payload, 0)
+    manifest = json.loads(payload[8 : 8 + mlen].decode())
+    base = 8 + mlen
+    return [
+        (spec, payload[base + spec["offset"] : base + spec["offset"] + spec["nbytes"]])
+        for spec in manifest
+    ]
+
+
+class WeightStaging:
+    """Server-side accumulator: feed it frames in any order; tensors
+    materialise once all their byte ranges have arrived."""
+
+    def __init__(self):
+        self._bufs: dict[str, bytearray] = {}
+        self._meta: dict[str, dict] = {}
+        self._received: dict[str, int] = {}
+        self.ready: dict[str, np.ndarray] = {}
+
+    def __len__(self) -> int:
+        return len(self.ready)
+
+    def add_bucket(self, payload: bytes) -> None:
+        for spec, raw in unpack_bucket_parts(payload):
+            name = spec["name"]
+            total = spec["total_nbytes"]
+            if name not in self._bufs:
+                self._bufs[name] = bytearray(total)
+                self._meta[name] = spec
+                self._received[name] = 0
+            off = spec["part_offset"]
+            self._bufs[name][off : off + len(raw)] = raw
+            self._received[name] += len(raw)
+            if self._received[name] >= total:
+                m = self._meta[name]
+                self.ready[name] = np.frombuffer(
+                    bytes(self._bufs.pop(name)), dtype=_np_dtype(m["dtype"])
+                ).reshape(m["shape"])
+                self._meta.pop(name)
+                self._received.pop(name)
+
+    def finalize(self) -> dict[str, np.ndarray]:
+        if self._bufs:
+            raise RuntimeError(
+                f"incomplete weight transfer: missing bytes for "
+                f"{sorted(self._bufs)[:5]}"
+            )
+        out, self.ready = self.ready, {}
+        return out
+
+
+def unpack_bucket(payload: bytes) -> dict[str, np.ndarray]:
+    """Single-frame convenience: all parts must be complete in this frame."""
+    st = WeightStaging()
+    st.add_bucket(payload)
+    return st.finalize()

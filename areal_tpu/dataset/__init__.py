@@ -135,3 +135,87 @@ class SimpleDataLoader:
         self._epoch = state["epoch"]
         self._pos = state["pos"]
         self.seed = state["seed"]
+
+
+@register_dataset("hh-rlhf")
+def _hh_rlhf(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
+    """Anthropic HH-RLHF pairwise preferences for reward-model training
+    (parity: areal/dataset hh-rlhf loader). Items: {chosen_input_ids,
+    rejected_input_ids} when a tokenizer is given, else raw text pairs."""
+    import datasets as hf_datasets
+
+    ds = hf_datasets.load_dataset(
+        path if path not in ("", "hh-rlhf", None) else "Anthropic/hh-rlhf",
+        split=split,
+    )
+
+    def to_item(x):
+        out = dict(chosen=x["chosen"], rejected=x["rejected"])
+        if tokenizer is not None:
+            for k in ("chosen", "rejected"):
+                ids = tokenizer.encode(x[k])
+                out[f"{k}_input_ids"] = ids[:max_length] if max_length else ids
+        return out
+
+    return ds.map(to_item, remove_columns=ds.column_names)
+
+
+def _vqa_loader(path: str, split: str):
+    """Shared CLEVR/Geometry3K mapper: {problem/question, image(s), answer}
+    -> the vision-RLVR item schema {messages, images, answer}."""
+    import datasets as hf_datasets
+
+    ds = hf_datasets.load_dataset(path, split=split)
+
+    def to_item(x):
+        question = x.get("problem", x.get("question", ""))
+        return dict(
+            messages=[
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "image"},
+                        {"type": "text", "text": question},
+                    ],
+                }
+            ],
+            images=x.get("images", [x.get("image")]),
+            answer=str(x.get("answer", "")),
+        )
+
+    keep = [c for c in ds.column_names if c in ("images", "image")]
+    return ds.map(
+        to_item, remove_columns=[c for c in ds.column_names if c not in keep]
+    )
+
+
+@register_dataset("clevr_count_70k")
+def _clevr_count(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
+    """CLEVR counting VQA (vision RLVR; parity: areal/dataset clevr_count_70k)."""
+    return _vqa_loader(path, split)
+
+
+@register_dataset("geometry3k")
+def _geometry3k(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
+    """Geometry3K multimodal geometry problems (parity: areal/dataset geometry3k)."""
+    return _vqa_loader(path, split)
+
+
+@register_dataset("torl_data")
+def _torl(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
+    """ToRL tool-integrated math reasoning prompts (parity: areal/dataset
+    torl_data). Items: {messages, prompt, answer}."""
+    import datasets as hf_datasets
+
+    ds = hf_datasets.load_dataset(path, split=split)
+
+    def to_item(x):
+        q = x.get("question", x.get("prompt", x.get("problem", "")))
+        ans = x.get("answer", x.get("solution", ""))
+        return dict(
+            messages=[{"role": "user", "content": q}],
+            prompt=q,
+            answer=str(ans),
+        )
+
+    return ds.map(to_item, remove_columns=ds.column_names)

@@ -218,7 +218,7 @@ class JaxDecodeEngine(InferenceEngine):
             def step(carry, _):
                 tokens, lengths, kc, vc, key = carry
                 logits, kc, vc = decode_step(
-                    params, tokens, lengths, kc, vc, cfg
+                    params, tokens, lengths, kc, vc, cfg, active=active
                 )
                 tok, logp, key = sample(logits, key, temps, top_ps, greedy)
                 tok = jnp.where(active, tok, tokens)
@@ -239,8 +239,9 @@ class JaxDecodeEngine(InferenceEngine):
         if bucket not in self._prefill_fns:
             cfg = self.model_config
 
-            def prefill_and_write(params, kc, vc, ids, positions, slot):
-                logits, k, v = prefill(params, ids, positions, cfg)
+            def prefill_and_write(params, kc, vc, ids, positions, slot, true_len):
+                valid = jnp.arange(ids.shape[0]) < true_len
+                logits, k, v = prefill(params, ids, positions, cfg, valid=valid)
                 kc = jax.lax.dynamic_update_slice(
                     kc,
                     k[:, None].astype(kc.dtype),
@@ -290,6 +291,7 @@ class JaxDecodeEngine(InferenceEngine):
                     jnp.asarray(ids),
                     jnp.asarray(positions),
                     slot_idx,
+                    P,
                 )
                 tok, logp = self._sample_host_one(
                     np.asarray(logits[P - 1]), item.gconfig
@@ -613,6 +615,36 @@ class JaxDecodeEngine(InferenceEngine):
                         # cache shapes depend only on L/nKV/hd which cannot
                         # change for the same run
                         self.model_config = decode_cfg
+        finally:
+            if not was_paused:
+                self.continue_generation()
+
+    def update_weights_from_tensor(
+        self, named: dict, version: int | None = None, chunk_mb: int = 512
+    ) -> None:
+        """Install host tensors shipped over the wire (the "dcn" fast path;
+        see areal_tpu/core/weight_transfer.py). Names are `/`-joined tree
+        paths matching this engine's own param tree. Preserves an external
+        pause, and stamps the new version inside the same pause window so no
+        token mixes new weights with the old version."""
+        from areal_tpu.core.weight_transfer import set_named
+
+        was_paused = self._gen_paused.is_set()
+        self.pause_generation()
+        try:
+            with self._weight_lock:
+                dtype = jnp.dtype(self.config.dtype)
+
+                def cast(new, old):
+                    arr = jnp.asarray(np.asarray(new), dtype=dtype)
+                    assert arr.shape == old.shape, (arr.shape, old.shape)
+                    return arr
+
+                self.params = set_named(self.params, named, cast=cast)
+                if version is not None:
+                    self._version = int(version)
+                    if self._executor is not None:
+                        self._executor.set_version(int(version))
         finally:
             if not was_paused:
                 self.continue_generation()

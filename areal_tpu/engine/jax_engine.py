@@ -381,6 +381,33 @@ class JaxTrainEngine(TrainEngine):
             logger.info(
                 f"disk weight update took {time.monotonic() - start:.2f}s"
             )
+        elif meta.type == "dcn":
+            # In-memory network push: gather bf16 host copies of every param
+            # and stream them to the decode servers over HTTP — the DCN
+            # replacement for the reference's cross-system NCCL broadcast
+            # (fsdp_engine.py:298-401). Multi-host learners: only process 0
+            # pushes (params must be process-0-addressable or replicated).
+            assert self.rollout_engine is not None
+            start = time.monotonic()
+            if jax.process_index() == 0:
+                from areal_tpu.core.weight_transfer import flatten_named
+
+                host = jax.tree.map(
+                    lambda x: jax.device_get(
+                        x.astype(jnp.bfloat16)
+                        if jnp.issubdtype(x.dtype, jnp.floating)
+                        else x
+                    ),
+                    self.params,
+                )
+                self.rollout_engine.update_weights_from_tensor(
+                    flatten_named(host),
+                    version=self.get_version(),
+                    chunk_mb=getattr(meta, "chunk_mb", 512),
+                )
+            logger.info(
+                f"dcn weight push took {time.monotonic() - start:.2f}s"
+            )
         else:
             raise NotImplementedError(f"weight update type {meta.type}")
 

@@ -1,0 +1,3 @@
+from areal_tpu.evaluation.offline import EvalResult, evaluate_offline
+
+__all__ = ["EvalResult", "evaluate_offline"]

@@ -1,0 +1,130 @@
+"""Offline benchmark evaluation (parity: the reference's `evaluation/`
+harness + AutomaticEvaluator, realhf/scheduler/evaluator.py — minus the
+vendored latex2sympy, which areal_tpu.reward.math_parser covers).
+
+Generates n samples per problem against any InferenceEngine, scores with a
+verifiable reward function, and reports mean reward, pass@1 and pass@k
+(unbiased estimator), and length stats. Used both standalone (benchmark a
+checkpoint on AIME/MATH/GSM8K-style sets) and from the training loop's
+freq-gated Evaluator callback (DECOUPLED_EVAL parity: point it at separate
+eval decode servers)."""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import uuid
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("evaluation")
+
+
+@dataclasses.dataclass
+class EvalResult:
+    n_problems: int
+    n_samples: int
+    mean_reward: float
+    pass_at_1: float
+    pass_at_k: dict[int, float]
+    mean_output_len: float
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.update({f"pass@{k}": v for k, v in d.pop("pass_at_k").items()})
+        return d
+
+
+def pass_at_k_estimate(n: int, c: int, k: int) -> float:
+    """Unbiased pass@k (Codex paper): 1 - C(n-c, k)/C(n, k)."""
+    if n - c < k:
+        return 1.0
+    prod = 1.0
+    for i in range(k):
+        prod *= (n - c - i) / (n - i)
+    return 1.0 - prod
+
+
+def evaluate_offline(
+    engine: Any,
+    items: list[dict[str, Any]],
+    *,
+    reward_fn: Callable[..., float],
+    gconfig: GenerationHyperparameters,
+    tokenizer: Any = None,
+    n_samples: int | None = None,
+    ks: tuple[int, ...] = (1, 4, 8),
+    max_concurrency: int = 64,
+    reward_timeout_seconds: float = 60.0,
+) -> EvalResult:
+    """Run the benchmark: for each item, sample `n_samples` completions and
+    score each; aggregate."""
+    n = n_samples or gconfig.n_samples
+    areward = AsyncRewardWrapper(reward_fn, timeout_seconds=reward_timeout_seconds)
+    sem = asyncio.Semaphore(max_concurrency)
+
+    def encode(item):
+        if "input_ids" in item:
+            return list(np.asarray(item["input_ids"]).reshape(-1))
+        if "messages" in item and tokenizer is not None:
+            return tokenizer.apply_chat_template(
+                item["messages"], add_generation_prompt=True, tokenize=True
+            )
+        assert tokenizer is not None, "need a tokenizer for text prompts"
+        return tokenizer.encode(item.get("prompt", item.get("question")))
+
+    async def one_sample(item, ids):
+        async with sem:
+            resp = await engine.agenerate(
+                ModelRequest(
+                    rid=str(uuid.uuid4()),
+                    input_ids=ids,
+                    gconfig=gconfig.new(n_samples=1),
+                    tokenizer=tokenizer,
+                )
+            )
+        completion = (
+            tokenizer.decode(resp.output_tokens) if tokenizer is not None else None
+        )
+        reward = await areward(
+            None, completion, resp.input_tokens, resp.output_tokens, **item
+        )
+        return float(reward), resp.output_len
+
+    async def run():
+        tasks = []
+        for item in items:
+            ids = encode(item)
+            tasks.append(
+                asyncio.gather(*[one_sample(item, ids) for _ in range(n)])
+            )
+        return await asyncio.gather(*tasks)
+
+    per_problem = asyncio.run(run())
+
+    rewards = np.array(
+        [[r for r, _ in samples] for samples in per_problem], dtype=np.float64
+    )  # [P, n]
+    lens = np.array([[l for _, l in samples] for samples in per_problem])
+    correct = (rewards > 0).sum(axis=1)  # [P]
+    pass_k = {
+        k: float(np.mean([pass_at_k_estimate(n, int(c), k) for c in correct]))
+        for k in ks
+        if k <= n
+    }
+    res = EvalResult(
+        n_problems=len(items),
+        n_samples=n,
+        mean_reward=float(rewards.mean()),
+        pass_at_1=float((rewards > 0).mean()),
+        pass_at_k=pass_k,
+        mean_output_len=float(lens.mean()),
+    )
+    logger.info(f"offline eval: {res.to_dict()}")
+    return res

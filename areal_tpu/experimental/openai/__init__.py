@@ -1,0 +1,4 @@
+from areal_tpu.experimental.openai.client import ArealOpenAI
+from areal_tpu.experimental.openai.types import InteractionWithTokenLogpReward
+
+__all__ = ["ArealOpenAI", "InteractionWithTokenLogpReward"]

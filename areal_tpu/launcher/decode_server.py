@@ -77,6 +77,11 @@ class DecodeServer:
         # not resume decoding in the middle of an in-flight swap, or tokens
         # from the new weights would carry the old version stamp.
         self._ctl_lock = asyncio.Lock()
+        # Buckets staged by /update_weights_from_tensor until /commit_weights.
+        from areal_tpu.core.weight_transfer import WeightStaging
+
+        self._weight_staging = WeightStaging()
+        self._last_commit_version: int | None = None
 
     # -- handlers -------------------------------------------------------
     async def _health(self, request: web.Request) -> web.Response:
@@ -167,6 +172,48 @@ class DecodeServer:
         self.engine.set_version(int(body["version"]))
         return web.json_response({"status": "ok"})
 
+    # -- "dcn" in-memory weight push (areal_tpu/core/weight_transfer.py) --
+    async def _update_weights_from_tensor(
+        self, request: web.Request
+    ) -> web.Response:
+        payload = await request.read()
+        async with self._ctl_lock:
+            self._weight_staging.add_bucket(payload)
+        return web.json_response(
+            {"status": "ok", "staged": len(self._weight_staging)}
+        )
+
+    async def _commit_weights(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        version = body.get("version")
+        async with self._ctl_lock:
+            if not len(self._weight_staging):
+                # Idempotent retry: a commit whose response got lost leaves
+                # empty staging + the version already stamped — succeed.
+                if (
+                    version is not None
+                    and self._last_commit_version == int(version)
+                ):
+                    return web.json_response(
+                        {"status": "ok", "version": self.engine.get_version()}
+                    )
+                return web.json_response(
+                    {"status": "error", "message": "no staged weights"},
+                    status=400,
+                )
+            staged = self._weight_staging.finalize()
+
+            def _install():
+                self.engine.update_weights_from_tensor(staged, version=version)
+
+            await asyncio.get_running_loop().run_in_executor(None, _install)
+            self._last_commit_version = (
+                int(version) if version is not None else None
+            )
+        return web.json_response(
+            {"status": "ok", "version": self.engine.get_version()}
+        )
+
     # -- lifecycle ------------------------------------------------------
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=1024**3)
@@ -178,6 +225,10 @@ class DecodeServer:
         app.router.add_post(
             "/update_weights_from_disk", self._update_weights_from_disk
         )
+        app.router.add_post(
+            "/update_weights_from_tensor", self._update_weights_from_tensor
+        )
+        app.router.add_post("/commit_weights", self._commit_weights)
         app.router.add_post("/set_version", self._set_version)
         return app
 

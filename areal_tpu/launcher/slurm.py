@@ -150,13 +150,18 @@ class SlurmLauncher:
         if not self.job_ids:
             return {}
         ids = ",".join(self.job_ids.values())
-        # squeue exits non-zero when every queried id has been purged; that
-        # is not an error, it means "none still queued".
+        # squeue exits non-zero with "Invalid job id" when every queried id
+        # has been purged — that means "none still queued". Any OTHER
+        # failure (slurmctld down) must surface, not read as all-complete.
         out = subprocess.run(
             ["squeue", "-j", ids, "-h", "-o", "%i %T"],
             capture_output=True,
             text=True,
         )
+        if out.returncode != 0 and "invalid job id" not in out.stderr.lower():
+            raise RuntimeError(
+                f"squeue failed (rc={out.returncode}): {out.stderr.strip()}"
+            )
         by_id = {}
         for line in out.stdout.splitlines():
             parts = line.split()

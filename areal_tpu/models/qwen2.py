@@ -662,9 +662,14 @@ def prefill(
     input_ids: jax.Array,
     position_ids: jax.Array,
     cfg: ModelConfig,
+    valid: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Causal forward over ONE sequence [T], returning (logits [T, V],
-    k_cache [L, T, nKV, hd], v_cache [L, T, nKV, hd])."""
+    k_cache [L, T, nKV, hd], v_cache [L, T, nKV, hd]).
+
+    `valid` [T] bool marks real (non-bucket-pad) tokens; MoE routing must
+    see it so pad rows don't claim expert capacity. (Attention needs no
+    mask: causality already hides the pad tail from real tokens.)"""
     compute_dtype = jnp.dtype(cfg.dtype)
     x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
     cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta)
@@ -687,7 +692,7 @@ def prefill(
         )
         h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
         if cfg.num_experts:
-            y, _ = moe_mlp(layer_p["mlp"], h, cfg)
+            y, _ = moe_mlp(layer_p["mlp"], h, cfg, valid=valid)
         else:
             y = mlp(layer_p["mlp"], h)
         x = x + y
@@ -720,11 +725,14 @@ def decode_step(
     k_cache: jax.Array,  # [L, R, S, nKV, hd]
     v_cache: jax.Array,  # [L, R, S, nKV, hd]
     cfg: ModelConfig,
+    active: jax.Array | None = None,  # [R] bool: slot holds a live request
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One batched decode step over R slots.
 
     Writes this step's K/V at `positions` and attends over s <= position
-    per slot. Returns (logits [R, V], k_cache, v_cache).
+    per slot. Returns (logits [R, V], k_cache, v_cache). `active` keeps
+    MoE routing of dead slots from claiming expert capacity shared with
+    live ones.
     """
     compute_dtype = jnp.dtype(cfg.dtype)
     R = tokens.shape[0]
@@ -760,7 +768,7 @@ def decode_step(
         x = x + jnp.einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"])
         h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
         if cfg.num_experts:
-            y, _ = moe_mlp(layer_p["mlp"], h, cfg)
+            y, _ = moe_mlp(layer_p["mlp"], h, cfg, valid=active)
         else:
             y = mlp(layer_p["mlp"], h)
         x = x + y

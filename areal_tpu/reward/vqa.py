@@ -1,0 +1,54 @@
+"""Vision RLVR rewards (parity: areal/reward/{clevr_count_70k,geometry3k}.py).
+
+Both extract the model's final answer (boxed or <answer> tag or trailing
+token) and compare against the ground truth: exact count match for CLEVR
+counting, math/choice equivalence for Geometry3K.
+"""
+
+from __future__ import annotations
+
+import re
+
+from areal_tpu.reward.math_parser import extract_answer, math_equal
+
+
+def _extract(completion: str) -> str | None:
+    m = re.search(r"<answer>(.*?)</answer>", completion, re.DOTALL)
+    if m:
+        return m.group(1).strip()
+    return extract_answer(completion)
+
+
+def clevr_count_reward(
+    prompt, completion, prompt_ids=None, completion_ids=None, **data
+) -> float:
+    """Binary reward: predicted object count equals the label."""
+    target = data.get("answer")
+    if completion is None or target is None:
+        return 0.0
+    pred = _extract(completion)
+    if pred is None:
+        return 0.0
+    digits = re.findall(r"-?\d+", pred)
+    tdigits = re.findall(r"-?\d+", str(target))
+    if not digits or not tdigits:
+        return 0.0
+    return 1.0 if int(digits[-1]) == int(tdigits[-1]) else 0.0
+
+
+def geometry3k_reward(
+    prompt, completion, prompt_ids=None, completion_ids=None, **data
+) -> float:
+    """Binary reward: answer equivalent to ground truth (numeric/symbolic
+    via the math parser; falls back to case-insensitive string match for
+    multiple-choice letters)."""
+    target = data.get("answer")
+    if completion is None or target is None:
+        return 0.0
+    pred = _extract(completion)
+    if pred is None:
+        return 0.0
+    t = str(target).strip()
+    if math_equal(pred, t):
+        return 1.0
+    return 1.0 if pred.strip().lower() == t.lower() else 0.0

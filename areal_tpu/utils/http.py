@@ -65,9 +65,11 @@ async def arequest_with_retry(
     max_retries: int = DEFAULT_RETRIES,
     timeout: float = DEFAULT_REQUEST_TIMEOUT,
     retry_delay: float = 1.0,
+    data: bytes | None = None,
 ) -> dict[str, Any]:
     """POST/GET `http://{addr}{endpoint}`, return parsed JSON; retry on
-    connection errors and 5xx. 4xx raise immediately."""
+    connection errors and 5xx. 4xx raise immediately. `data` sends a raw
+    binary body instead of JSON (weight-transfer buckets)."""
     last_exc: Exception | None = None
     url = f"http://{addr}{endpoint}"
     for attempt in range(max_retries):
@@ -76,7 +78,8 @@ async def arequest_with_retry(
             async with session.request(
                 method,
                 url,
-                json=payload if method != "GET" else None,
+                json=payload if method != "GET" and data is None else None,
+                data=data,
                 timeout=aiohttp.ClientTimeout(total=timeout, sock_connect=30),
             ) as resp:
                 if resp.status >= 400:

@@ -1,0 +1,131 @@
+"""Agent layer + offline evaluation harness with scripted engines."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from areal_tpu.agent.math_single_step import (
+    AgentWorkflow,
+    MathSingleStepAgent,
+    MathSingleStepEnv,
+)
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.evaluation import evaluate_offline
+from areal_tpu.evaluation.offline import pass_at_k_estimate
+from tests.test_workflows import FakeTokenizer, ScriptedEngine
+
+
+class MathTokenizer(FakeTokenizer):
+    def decode(self, ids):
+        # token 42 decodes to the correct boxed answer
+        return "the answer is \\boxed{4}" if ids == [42] else "\\boxed{9}"
+
+
+def test_math_agent_collects_group():
+    agent = MathSingleStepAgent(
+        GenerationHyperparameters(n_samples=4, max_new_tokens=4),
+        MathTokenizer(),
+    )
+    wf = AgentWorkflow(agent, MathSingleStepEnv)
+    eng = ScriptedEngine([[42], [7], [42], [7]])
+    batch = asyncio.run(
+        wf.arun_episode(eng, {"input_ids": [1, 2], "answer": "4"})
+    )
+    rewards = np.asarray(batch["rewards"])
+    assert batch["input_ids"].shape[0] == 4
+    assert sorted(rewards.tolist()) == [0.0, 0.0, 1.0, 1.0]
+
+
+def test_math_agent_rejects_out_of_band_groups():
+    agent = MathSingleStepAgent(
+        GenerationHyperparameters(n_samples=2, max_new_tokens=4),
+        MathTokenizer(),
+        success_rate_lb=0.1,
+        success_rate_ub=0.9,
+    )
+    wf = AgentWorkflow(agent, MathSingleStepEnv)
+    eng = ScriptedEngine([[42], [42]])  # all correct -> rate 1.0 > ub
+    out = asyncio.run(wf.arun_episode(eng, {"input_ids": [1], "answer": "4"}))
+    assert out is None
+
+
+def test_pass_at_k_estimator():
+    assert pass_at_k_estimate(10, 10, 1) == 1.0
+    assert pass_at_k_estimate(10, 0, 5) == 0.0
+    # n=4, c=1, k=1 -> 1/4
+    assert abs(pass_at_k_estimate(4, 1, 1) - 0.25) < 1e-9
+    # n=4, c=1, k=4 -> 1.0 (some sample always included)
+    assert pass_at_k_estimate(4, 1, 4) == 1.0
+
+
+def test_evaluate_offline_metrics():
+    from areal_tpu.reward.math_parser import math_verify_reward
+
+    # Engine answers correctly only on calls 0 and 2 of each 2-sample pair.
+    class AltEngine(ScriptedEngine):
+        async def agenerate(self, req):
+            out = [42] if self.calls % 2 == 0 else [7]
+            self.calls += 1
+            from areal_tpu.api.io_struct import ModelResponse
+
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=out,
+                output_logprobs=[-0.1],
+                output_versions=[0],
+                stop_reason="stop",
+            )
+
+    eng = AltEngine([])
+    res = evaluate_offline(
+        eng,
+        [
+            {"input_ids": [1], "answer": "4"},
+            {"input_ids": [2], "answer": "4"},
+        ],
+        reward_fn=math_verify_reward,
+        gconfig=GenerationHyperparameters(max_new_tokens=4),
+        tokenizer=MathTokenizer(),
+        n_samples=2,
+        ks=(1, 2),
+    )
+    assert res.n_problems == 2 and res.n_samples == 2
+    assert abs(res.mean_reward - 0.5) < 1e-9
+    assert abs(res.pass_at_1 - 0.5) < 1e-9
+    assert res.pass_at_k[2] == 1.0  # each problem has one correct sample
+    d = res.to_dict()
+    assert "pass@2" in d
+
+
+def test_vqa_rewards():
+    from areal_tpu.reward.vqa import clevr_count_reward, geometry3k_reward
+
+    assert clevr_count_reward(None, "I count \\boxed{3} objects", answer="3") == 1.0
+    assert clevr_count_reward(None, "<answer>5</answer>", answer="3") == 0.0
+    assert clevr_count_reward(None, "no digits here", answer="3") == 0.0
+    assert geometry3k_reward(None, "<answer>B</answer>", answer="b") == 1.0
+    assert geometry3k_reward(None, "\\boxed{2\\pi}", answer="2\\pi") == 1.0
+    assert geometry3k_reward(None, "\\boxed{7}", answer="8") == 0.0
+
+
+def test_dataset_registry_mappers():
+    hf_datasets = pytest.importorskip("datasets")
+    from areal_tpu.dataset import _REGISTRY
+
+    raw = hf_datasets.Dataset.from_list(
+        [{"chosen": "good text", "rejected": "bad text"}]
+    )
+    import unittest.mock as mock
+
+    with mock.patch.object(hf_datasets, "load_dataset", return_value=raw):
+        ds = _REGISTRY["hh-rlhf"](path="hh-rlhf", split="train", type="rw")
+    assert ds[0]["chosen"] == "good text"
+
+    raw2 = hf_datasets.Dataset.from_list(
+        [{"question": "2+2?", "answer": "4"}]
+    )
+    with mock.patch.object(hf_datasets, "load_dataset", return_value=raw2):
+        ds2 = _REGISTRY["torl_data"](path="x/torl_data", split="train", type="rl")
+    assert ds2[0]["messages"][0]["content"] == "2+2?"
+    assert ds2[0]["answer"] == "4"
