@@ -1,0 +1,61 @@
+"""Scheduler API (parity: areal/api/scheduler_api.py:36 Scheduler ABC).
+
+The experimental single-controller mode: a controller process asks a
+Scheduler to create worker processes, instantiate engines inside them, and
+invoke engine methods remotely. The TPU implementation backs this with the
+HTTP RPC pair in areal_tpu/scheduler/rpc/.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class Worker:
+    id: str
+    ip: str
+    ports: list[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def rpc_addr(self) -> str:
+        return f"{self.ip}:{self.ports[0]}"
+
+
+@dataclasses.dataclass
+class SchedulingSpec:
+    """Resources for one worker (reference Scheduling, engine_api.py:24)."""
+
+    cpu: int = 4
+    gpu: int = 0  # accelerator chips (TPU here)
+    mem: int = 16 * 1024  # MB
+    port_count: int = 1
+    env_vars: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+class Scheduler(abc.ABC):
+    @abc.abstractmethod
+    def create_workers(
+        self, role: str, spec: SchedulingSpec, count: int, **kwargs
+    ) -> list[str]:
+        """Spawn `count` workers; returns worker ids."""
+
+    @abc.abstractmethod
+    def get_workers(self, role: str, timeout: float | None = None) -> list[Worker]:
+        """Wait until the role's workers are up; return their endpoints."""
+
+    @abc.abstractmethod
+    def delete_workers(self, role: str | None = None) -> None:
+        """Tear down workers (all roles when role is None)."""
+
+    @abc.abstractmethod
+    def create_engine(
+        self, worker_id: str, engine_type: str, *args, **kwargs
+    ) -> Any:
+        """Instantiate an engine (by import path) inside a worker."""
+
+    @abc.abstractmethod
+    def call_engine(self, worker_id: str, method: str, *args, **kwargs) -> Any:
+        """Invoke a method on the worker's engine and return the result."""

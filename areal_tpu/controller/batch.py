@@ -1,0 +1,133 @@
+"""DistributedBatch: the controller↔engine data plane.
+
+Parity: areal/api/controller_api.py:22 (DistributedBatch ABC) +
+areal/controller/batch.py:16 (DistributedBatchMemory) — a dict-of-arrays
+container the controller splits across DP workers (`chunk`,
+`chunk_by_ffd`), merges back (`union`, `concat`), and ships over RPC.
+Memory-mode only (the reference's file mode is a spill optimisation; our
+RPC layer streams the same pickled payloads).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from areal_tpu.utils.datapack import reorder_to_balanced_batches
+
+
+class DistributedBatchMemory:
+    def __init__(self, data: dict[str, Any] | None = None):
+        self.data: dict[str, np.ndarray] = {
+            k: np.asarray(v) for k, v in (data or {}).items()
+        }
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "DistributedBatchMemory":
+        return cls(data)
+
+    @classmethod
+    def from_list(cls, rows: list[dict[str, Any]]) -> "DistributedBatchMemory":
+        keys = rows[0].keys()
+        return cls({k: np.stack([np.asarray(r[k]) for r in rows]) for k in keys})
+
+    # -- introspection --------------------------------------------------
+    @property
+    def batch_size(self) -> int:
+        for v in self.data.values():
+            if v.ndim >= 1:
+                return v.shape[0]
+        return 0
+
+    def __len__(self) -> int:
+        return self.batch_size
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return self.data[key]
+        # row/slice indexing
+        return DistributedBatchMemory(
+            {k: v[key] for k, v in self.data.items()}
+        )
+
+    def __setitem__(self, key: str, value) -> None:
+        self.data[key] = np.asarray(value)
+
+    def keys(self):
+        return self.data.keys()
+
+    def to_dict(self) -> dict[str, np.ndarray]:
+        return dict(self.data)
+
+    # -- splitting ------------------------------------------------------
+    def chunk(self, n: int) -> list["DistributedBatchMemory"]:
+        """Even split into n contiguous chunks (batch must divide by n)."""
+        B = self.batch_size
+        assert B % n == 0, (B, n)
+        step = B // n
+        return [
+            DistributedBatchMemory(
+                {k: v[i * step : (i + 1) * step] for k, v in self.data.items()}
+            )
+            for i in range(n)
+        ]
+
+    def chunk_by_ffd(self, group_size: int, n: int) -> list["DistributedBatchMemory"]:
+        """Split into n parts, keeping each `group_size` block together and
+        balancing token counts (FFD; reference batch.py chunk_by_ffd)."""
+        B = self.batch_size
+        assert B % group_size == 0, (B, group_size)
+        n_groups = B // group_size
+        assert n_groups % n == 0, (n_groups, n)
+        if "attention_mask" in self.data:
+            lens = (
+                self.data["attention_mask"]
+                .reshape(n_groups, -1)
+                .sum(axis=1)
+                .astype(np.int64)
+            )
+        else:
+            lens = np.ones(n_groups, dtype=np.int64)
+        chunks = reorder_to_balanced_batches(lens, n_groups // n)
+        out = []
+        for groups in chunks:
+            rows = np.concatenate(
+                [
+                    np.arange(g * group_size, (g + 1) * group_size)
+                    for g in sorted(groups)
+                ]
+            )
+            out.append(
+                DistributedBatchMemory(
+                    {k: v[rows] for k, v in self.data.items()}
+                )
+            )
+        return out
+
+    # -- merging --------------------------------------------------------
+    @staticmethod
+    def concat(batches: list["DistributedBatchMemory"]) -> "DistributedBatchMemory":
+        keys = batches[0].data.keys()
+        out = {}
+        for k in keys:
+            arrs = [b.data[k] for b in batches]
+            if arrs[0].ndim >= 2:
+                # pad dim-1 (sequence) to the max before concatenating
+                T = max(a.shape[1] for a in arrs)
+                arrs = [
+                    np.pad(a, [(0, 0), (0, T - a.shape[1])] + [(0, 0)] * (a.ndim - 2))
+                    if a.shape[1] < T
+                    else a
+                    for a in arrs
+                ]
+            out[k] = np.concatenate(arrs, axis=0)
+        return DistributedBatchMemory(out)
+
+    def union(self, other: "DistributedBatchMemory") -> "DistributedBatchMemory":
+        """Merge columns of two batches over the same rows (reference
+        union: later keys win on conflict)."""
+        merged = dict(self.data)
+        merged.update(other.data)
+        return DistributedBatchMemory(merged)

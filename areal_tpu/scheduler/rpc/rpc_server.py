@@ -1,0 +1,144 @@
+"""Worker-side RPC server: instantiate engines, execute their methods.
+
+Parity: areal/scheduler/rpc/rpc_server.py:44 — an HTTP server each worker
+runs; the controller POSTs pickled method calls. Endpoints:
+
+  POST /create_engine   {"engine_type": "pkg.mod:Class"} + pickled (args, kwargs)
+  POST /call_engine     {"method": name} + pickled (args, kwargs) → pickled result
+  GET  /health
+
+Payloads are pickle framed as [8B LE header-json len][header json][pickle].
+Trust model matches the reference: cluster-internal only — pickle executes
+arbitrary code, so the port must never be exposed outside the job.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import json
+import pickle
+import struct
+from typing import Any
+
+from aiohttp import web
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("rpc_server")
+
+
+def frame(header: dict, payload: bytes) -> bytes:
+    hj = json.dumps(header).encode()
+    return struct.pack("<Q", len(hj)) + hj + payload
+
+
+def unframe(body: bytes) -> tuple[dict, bytes]:
+    (hlen,) = struct.unpack_from("<Q", body, 0)
+    header = json.loads(body[8 : 8 + hlen].decode())
+    return header, body[8 + hlen :]
+
+
+def _resolve(engine_type: str):
+    mod_name, _, cls_name = engine_type.partition(":")
+    mod = importlib.import_module(mod_name)
+    obj: Any = mod
+    for part in cls_name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+class RPCServer:
+    def __init__(self):
+        self.engine: Any = None
+        self._runner: web.AppRunner | None = None
+        self.addr: str | None = None
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "engine": type(self.engine).__name__ if self.engine else None}
+        )
+
+    async def _create_engine(self, request: web.Request) -> web.Response:
+        header, payload = unframe(await request.read())
+        args, kwargs = pickle.loads(payload) if payload else ((), {})
+        cls = _resolve(header["engine_type"])
+
+        def _make():
+            self.engine = cls(*args, **kwargs)
+
+        await asyncio.get_running_loop().run_in_executor(None, _make)
+        logger.info(f"created engine {header['engine_type']}")
+        return web.Response(
+            body=frame({"status": "ok"}, pickle.dumps(None)),
+            content_type="application/octet-stream",
+        )
+
+    async def _call_engine(self, request: web.Request) -> web.Response:
+        header, payload = unframe(await request.read())
+        if self.engine is None:
+            return web.json_response(
+                {"status": "error", "message": "no engine"}, status=400
+            )
+        args, kwargs = pickle.loads(payload) if payload else ((), {})
+        method = getattr(self.engine, header["method"])
+
+        def _run():
+            return method(*args, **kwargs)
+
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(None, _run)
+        except Exception as e:  # noqa: BLE001 — ship the error to the caller
+            logger.warning(f"call_engine {header['method']} raised: {e!r}")
+            return web.Response(
+                body=frame(
+                    {"status": "error", "message": repr(e)}, pickle.dumps(e)
+                ),
+                content_type="application/octet-stream",
+                status=500,
+            )
+        return web.Response(
+            body=frame({"status": "ok"}, pickle.dumps(result)),
+            content_type="application/octet-stream",
+        )
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1024**3)
+        app.router.add_get("/health", self._health)
+        app.router.add_post("/create_engine", self._create_engine)
+        app.router.add_post("/call_engine", self._call_engine)
+        return app
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> str:
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual_port = self._runner.addresses[0][1]
+        self.addr = f"{host}:{actual_port}"
+        logger.info(f"rpc server on {self.addr}")
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(argv)
+
+    async def _serve():
+        server = RPCServer()
+        await server.start(args.host, args.port)
+        await asyncio.Event().wait()
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()
