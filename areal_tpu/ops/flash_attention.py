@@ -137,7 +137,7 @@ def _fwd_kernel(
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
         lse = jnp.where(l > 0.0, m_ref[:] + jnp.log(safe_l), _NEG_INF)
-        lse_ref[0] = lse[:, 0]
+        lse_ref[0, 0] = lse[:, 0]
 
 
 def _fwd_call(
@@ -175,11 +175,15 @@ def _fwd_call(
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, hd), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            # LSE rides as [nH, 1, Tq]: the trailing block dims (1, block_q)
+            # match the trailing array dims (1, Tq) under Mosaic's rule for
+            # ANY head count (a (1, block_q) block over [nH, Tq] is illegal
+            # whenever nH is not a multiple of 8 — e.g. Qwen2.5-0.5B's 14).
+            pl.BlockSpec((1, 1, block_q), lambda h, i, j: (h, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((nH, Tq, hd), q3.dtype),
-            jax.ShapeDtypeStruct((nH, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((nH, 1, Tq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, hd), jnp.float32),
@@ -187,6 +191,9 @@ def _fwd_call(
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
     )(
         seg_q.reshape(1, Tq),
         seg_k.reshape(1, Tk),
@@ -196,7 +203,7 @@ def _fwd_call(
         k3,
         v3,
     )
-    return o, lse
+    return o, lse.reshape(nH, Tq)
 
 
 # ---------------------------------------------------------------------------
@@ -245,9 +252,9 @@ def _bwd_dq_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]  # [Bq]
-        delta = delta_ref[0]  # [Bq]
-        dlse = dlse_ref[0]  # [Bq]
+        lse = lse_ref[0, 0]  # [Bq]
+        delta = delta_ref[0, 0]  # [Bq]
+        dlse = dlse_ref[0, 0]  # [Bq]
         s = _scores(
             q, k, seg_q_ref[0], seg_k_ref[0], qpos_ref[0], kpos_ref[0], sm_scale
         )
@@ -307,9 +314,9 @@ def _bwd_dkv_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
-        dlse = dlse_ref[0]
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        dlse = dlse_ref[0, 0]
         s = _scores(
             q, k, seg_q_ref[0], seg_k_ref[0], qpos_ref[0], kpos_ref[0], sm_scale
         )
@@ -353,6 +360,11 @@ def _bwd_call(
     delta = jnp.sum(
         o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1
     )  # [nH, Tq]
+    # Per-row vectors travel as [nH, 1, Tq] so their (1, 1, block_q) blocks
+    # satisfy Mosaic's trailing-dims rule for any nH (see _fwd_call out_specs).
+    lse3 = lse.reshape(nH, 1, Tq)
+    delta3 = delta.reshape(nH, 1, Tq)
+    dlse3 = dlse.reshape(nH, 1, Tq)
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel,
@@ -377,15 +389,18 @@ def _bwd_call(
                 (1, block_k, hd), lambda h, i, j, g=group: (h // g, j, 0)
             ),
             pl.BlockSpec((1, block_q, hd), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
-            pl.BlockSpec((1, block_q), lambda h, i, j: (h, i)),
+            pl.BlockSpec((1, 1, block_q), lambda h, i, j: (h, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda h, i, j: (h, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda h, i, j: (h, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, hd), lambda h, i, j: (h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((nH, Tq, hd), q3.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
         interpret=interpret,
-    )(seg_q2, seg_k2, qpos2, kpos2, q3, k3, v3, do, lse, delta, dlse)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(seg_q2, seg_k2, qpos2, kpos2, q3, k3, v3, do, lse3, delta3, dlse3)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel,
@@ -411,9 +426,9 @@ def _bwd_call(
                 (1, block_k, hd), lambda h, jk, iq, g=group: (h // g, jk, 0)
             ),
             pl.BlockSpec((1, block_q, hd), lambda h, jk, iq: (h, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda h, jk, iq: (h, iq)),
-            pl.BlockSpec((1, block_q), lambda h, jk, iq: (h, iq)),
-            pl.BlockSpec((1, block_q), lambda h, jk, iq: (h, iq)),
+            pl.BlockSpec((1, 1, block_q), lambda h, jk, iq: (h, 0, iq)),
+            pl.BlockSpec((1, 1, block_q), lambda h, jk, iq: (h, 0, iq)),
+            pl.BlockSpec((1, 1, block_q), lambda h, jk, iq: (h, 0, iq)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, hd), lambda h, jk, iq: (h, jk, 0)),
@@ -428,7 +443,10 @@ def _bwd_call(
             pltpu.VMEM((block_k, hd), jnp.float32),
         ],
         interpret=interpret,
-    )(seg_q2, seg_k2, qpos2, kpos2, q3, k3, v3, do, lse, delta, dlse)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(seg_q2, seg_k2, qpos2, kpos2, q3, k3, v3, do, lse3, delta3, dlse3)
 
     dk = dk_h.reshape(nKV, group, Tk, hd).sum(axis=1).astype(k3.dtype)
     dv = dv_h.reshape(nKV, group, Tk, hd).sum(axis=1).astype(v3.dtype)
@@ -478,6 +496,17 @@ def _flash_bwd(sm_scale, block_q, block_k, skip_blocks, interpret, res, cts):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _fit_block(requested: int, t: int) -> int:
+    """Largest usable block ≤ `requested` for a length-`t` axis.
+
+    Always a multiple of 128: Mosaic requires lane dims divisible by 128 and
+    sublane dims divisible by 8, so a block equal to a ragged T (e.g. 130)
+    would fail to lower — we round T *up* to 128 instead and rely on padding.
+    """
+    requested = max(128, (requested // 128) * 128)
+    return min(requested, ((max(t, 1) + 127) // 128) * 128)
+
+
 def _pad_to(x, n, axis, value=0):
     pad = n - x.shape[axis]
     if pad <= 0:
@@ -514,8 +543,8 @@ def flash_attention_chunk(
         sm_scale = 1.0 / math.sqrt(hd)
     if interpret is None:
         interpret = _default_interpret()
-    block_q = min(block_q, max(128, Tq))
-    block_k = min(block_k, max(128, Tk))
+    block_q = _fit_block(block_q, Tq)
+    block_k = _fit_block(block_k, Tk)
     Tqp = ((Tq + block_q - 1) // block_q) * block_q
     Tkp = ((Tk + block_k - 1) // block_k) * block_k
 
@@ -560,8 +589,8 @@ def flash_attention(
     if interpret is None:
         interpret = _default_interpret()
 
-    block_q = min(block_q, max(128, T))
-    block_k = min(block_k, max(128, T))
+    block_q = _fit_block(block_q, T)
+    block_k = _fit_block(block_k, T)
     blk = math.lcm(block_q, block_k)
     Tp = ((T + blk - 1) // blk) * blk
 

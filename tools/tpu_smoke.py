@@ -1,0 +1,134 @@
+"""Real-TPU lowering + numerics smoke for the Pallas kernels.
+
+Round 1 shipped a flash kernel that passed every CPU (interpret-mode) test
+but failed Mosaic lowering on hardware for Qwen2.5-0.5B's 14 heads — numerics
+tests validate math, never lowering constraints. This script is the gate the
+test suite cannot be: it runs the actual Mosaic pipeline on the attached TPU
+for every supported (heads, kv_heads, head_dim) family and odd packed lengths,
+forward AND backward, and checks numerics against a dense reference.
+
+Usage: python tools/tpu_smoke.py   (requires jax.default_backend() == "tpu")
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.ops.flash_attention import PADDING_SEGMENT, flash_attention
+
+
+def dense_reference(q, k, v, seg, sm_scale):
+    T, nH, hd = q.shape
+    nKV = k.shape[1]
+    group = nH // nKV
+    kf = jnp.repeat(k, group, axis=1).astype(jnp.float32)
+    vf = jnp.repeat(v, group, axis=1).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    s = jnp.einsum("qhd,khd->hqk", qf, kf) * sm_scale
+    pos = jnp.arange(T)
+    mask = (
+        (seg[:, None] == seg[None, :])
+        & (pos[:, None] >= pos[None, :])
+        & (seg[:, None] != PADDING_SEGMENT)
+    )
+    s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(mask[None], p, 0.0)
+    o = jnp.einsum("hqk,khd->qhd", p, vf)
+    return o.astype(q.dtype)
+
+
+def run_case(T, nH, nKV, hd, seed=0):
+    key = jax.random.PRNGKey(seed)
+    kq, kk, kv, ks = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (T, nH, hd), jnp.bfloat16)
+    k = jax.random.normal(kk, (T, nKV, hd), jnp.bfloat16)
+    v = jax.random.normal(kv, (T, nKV, hd), jnp.bfloat16)
+    # three packed segments + pad tail
+    b1, b2 = T // 3, 2 * T // 3
+    seg = jnp.where(
+        jnp.arange(T) < b1, 0, jnp.where(jnp.arange(T) < b2, 1, 2)
+    ).astype(jnp.int32)
+    pad_from = max(T - max(T // 8, 1), 1)
+    seg = jnp.where(jnp.arange(T) >= pad_from, PADDING_SEGMENT, seg)
+    sm_scale = hd**-0.5
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, seg, sm_scale=sm_scale, interpret=False)
+        w = jnp.where(seg[:, None, None] != PADDING_SEGMENT, 1.0, 0.0)
+        return jnp.sum((o.astype(jnp.float32) * w) ** 2)
+
+    def loss_ref(q, k, v):
+        o = dense_reference(q, k, v, seg, sm_scale)
+        w = jnp.where(seg[:, None, None] != PADDING_SEGMENT, 1.0, 0.0)
+        return jnp.sum((o.astype(jnp.float32) * w) ** 2)
+
+    o_flash = jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, seg, sm_scale=sm_scale, interpret=False
+        )
+    )(q, k, v)
+    o_ref = dense_reference(q, k, v, seg, sm_scale)
+    mask = np.asarray(seg != PADDING_SEGMENT)
+    fwd_err = float(
+        jnp.max(
+            jnp.abs(
+                (o_flash.astype(jnp.float32) - o_ref.astype(jnp.float32))[mask]
+            )
+        )
+    )
+
+    g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    bwd_err = max(
+        float(
+            jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+            / (1e-3 + float(jnp.max(jnp.abs(b.astype(jnp.float32)))))
+        )
+        for a, b in zip(g_flash, g_ref)
+    )
+    return fwd_err, bwd_err
+
+
+def main():
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print(f"SKIP: default backend is {backend}, need tpu")
+        return 1
+    # (nH, nKV) families: qwen2.5-0.5B (14,2), 7B (28,4), 1.5B (12,2),
+    # qwen3-32B-ish (64,8) trimmed, MHA (8,8); head dims 64 and 128.
+    cases = [
+        (512, 14, 2, 64),
+        (4096, 14, 2, 64),
+        (1024, 28, 4, 128),
+        (512, 12, 2, 128),
+        (512, 8, 8, 128),
+        (130, 14, 2, 64),   # ragged packed length -> padded block path
+        (2048, 16, 8, 64),
+    ]
+    failures = 0
+    for T, nH, nKV, hd in cases:
+        try:
+            fwd_err, bwd_err = run_case(T, nH, nKV, hd)
+            ok = fwd_err < 0.06 and bwd_err < 0.06
+            print(
+                f"{'OK ' if ok else 'BAD'} T={T:5d} nH={nH:2d} nKV={nKV:2d} "
+                f"hd={hd:3d}  fwd_maxerr={fwd_err:.4f} bwd_relerr={bwd_err:.4f}"
+            )
+            failures += 0 if ok else 1
+        except Exception as e:  # lowering failures land here
+            print(f"FAIL T={T} nH={nH} nKV={nKV} hd={hd}: {type(e).__name__}: {e}")
+            failures += 1
+    print("RESULT:", "PASS" if failures == 0 else f"{failures} FAILURES")
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
