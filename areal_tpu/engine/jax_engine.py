@@ -144,10 +144,13 @@ class JaxTrainEngine(TrainEngine):
         self._step_count = 0
         self._train_mode = True
         self._param_shardings = None
+        self._opt_shardings = None
         self._mb_sharding = None
         self._grad_step_cache: dict[int, Callable] = {}
         self._fwd_cache: dict[int, Callable] = {}
         self._apply_update_fn = None
+        self._zero_grads_fn = None
+        self._push_cast_fn = None
         self.rollout_engine: InferenceEngine | None = None
         self.weight_update_meta: WeightUpdateMeta | None = None
 
@@ -227,35 +230,48 @@ class JaxTrainEngine(TrainEngine):
             self.opt_state = opt_state
 
     def _opt_state_shardings(self):
-        """Shard optimizer moments exactly like their parameters."""
+        """Shard optimizer moments exactly like their parameters.
+
+        optax states embed *copies of the param tree* (ScaleByAdamState.mu/nu
+        etc.), so every moment leaf's key path ends with the key path of the
+        param it mirrors. Matching on that path suffix is exact — unlike
+        shape matching, two distinct params with equal shapes (e.g. gate and
+        up projections) can never swap shardings. Leaves whose path matches
+        no param (step counters) are replicated.
+        """
+        if self._opt_shardings is not None:
+            return self._opt_shardings
         shape = jax.eval_shape(self.optimizer.init, self.params)
+        param_paths = {
+            tuple(str(k) for k in path): shard
+            for path, shard in jax.tree_util.tree_leaves_with_path(
+                self._param_shardings
+            )
+        }
+        replicated = mesh_lib.replicated(self.mesh)
 
-        def match(leaf_shape_struct):
-            # Moments mirror param pytrees; scalars (counters) are replicated.
-            return None
+        def assign(path, leaf):
+            keys = tuple(str(k) for k in path)
+            for i in range(len(keys)):
+                hit = param_paths.get(keys[i:])
+                if hit is not None:
+                    return hit
+            return replicated
 
-        # Build by structure: any leaf whose shape matches a param leaf gets
-        # that param's sharding. optax states are pytrees containing copies
-        # of the param tree, so map by matching subtree structure.
-        param_leaves = jax.tree.leaves(self._param_shardings)
-        param_shapes = [
-            tuple(x.shape) for x in jax.tree.leaves(self.params)
-        ]
-
-        def guess(leaf):
-            try:
-                idx = param_shapes.index(tuple(leaf.shape))
-                return param_leaves[idx]
-            except ValueError:
-                return mesh_lib.replicated(self.mesh)
-
-        return jax.tree.map(guess, shape)
+        self._opt_shardings = jax.tree_util.tree_map_with_path(assign, shape)
+        return self._opt_shardings
 
     def destroy(self):
         self.params = None
         self.opt_state = None
+        self._opt_shardings = None
         self._grad_step_cache.clear()
         self._fwd_cache.clear()
+        # Compiled programs hold NamedShardings bound to this mesh/optimizer;
+        # a re-initialized engine must not reuse them.
+        self._apply_update_fn = None
+        self._zero_grads_fn = None
+        self._push_cast_fn = None
 
     # -- topology -------------------------------------------------------
     @property
@@ -385,21 +401,33 @@ class JaxTrainEngine(TrainEngine):
             # In-memory network push: gather bf16 host copies of every param
             # and stream them to the decode servers over HTTP — the DCN
             # replacement for the reference's cross-system NCCL broadcast
-            # (fsdp_engine.py:298-401). Multi-host learners: only process 0
-            # pushes (params must be process-0-addressable or replicated).
+            # (fsdp_engine.py:298-401). On a multi-host learner the params are
+            # fsdp-sharded across processes, so the gather is a *collective*:
+            # every process participates in process_allgather (ICI/DCN
+            # all-gather under jit), then only process 0 streams the fully
+            # assembled tensors out over HTTP.
             assert self.rollout_engine is not None
             start = time.monotonic()
+            if self._push_cast_fn is None:
+                self._push_cast_fn = jax.jit(
+                    lambda t: jax.tree.map(
+                        lambda x: x.astype(jnp.bfloat16)
+                        if jnp.issubdtype(x.dtype, jnp.floating)
+                        else x,
+                        t,
+                    )
+                )
+            casted = self._push_cast_fn(self.params)
+            if jax.process_count() > 1:  # pragma: no cover - multi-host only
+                from jax.experimental import multihost_utils
+
+                host = multihost_utils.process_allgather(casted, tiled=True)
+            else:
+                host = jax.tree.map(jax.device_get, casted)
+            del casted
             if jax.process_index() == 0:
                 from areal_tpu.core.weight_transfer import flatten_named
 
-                host = jax.tree.map(
-                    lambda x: jax.device_get(
-                        x.astype(jnp.bfloat16)
-                        if jnp.issubdtype(x.dtype, jnp.floating)
-                        else x
-                    ),
-                    self.params,
-                )
                 self.rollout_engine.update_weights_from_tensor(
                     flatten_named(host),
                     version=self.get_version(),
@@ -461,14 +489,25 @@ class JaxTrainEngine(TrainEngine):
             )
             return loss_fn(logits, mb)
 
+        param_sh = self._param_shardings
+
         def grad_step(params, acc, weight, mb):
             loss, grads = jax.value_and_grad(loss_of)(params, mb)
+            # Pin gradients to their parameter's layout BEFORE accumulation:
+            # left free, XLA may lay the backward's psum outputs out
+            # differently from the donated accumulator and fall back to
+            # "involuntary full rematerialization" reshards on every step.
+            grads = jax.lax.with_sharding_constraint(grads, param_sh)
             acc = jax.tree.map(
                 lambda a, g: a + g.astype(grad_dtype) * weight, acc, grads
             )
             return loss, acc
 
-        fn = jax.jit(grad_step, donate_argnums=(1,))
+        fn = jax.jit(
+            grad_step,
+            donate_argnums=(1,),
+            out_shardings=(mesh_lib.replicated(self.mesh), param_sh),
+        )
         self._grad_step_cache[key] = fn
         return fn
 
@@ -495,7 +534,18 @@ class JaxTrainEngine(TrainEngine):
             params = optax.apply_updates(params, updates)
             return params, opt_state, gnorm
 
-        self._apply_update_fn = jax.jit(apply_update, donate_argnums=(0, 1, 2))
+        # NOTE: grads (arg 2) are NOT donated — they have no same-shaped
+        # output to alias (params/opt_state inputs already cover those), so
+        # donating them only produces "donated buffers were not usable" noise.
+        self._apply_update_fn = jax.jit(
+            apply_update,
+            donate_argnums=(0, 1),
+            out_shardings=(
+                self._param_shardings,
+                self._opt_state_shardings(),
+                mesh_lib.replicated(self.mesh),
+            ),
+        )
         return self._apply_update_fn
 
     def _zero_grads(self):
@@ -520,6 +570,7 @@ class JaxTrainEngine(TrainEngine):
         # with different strategies coexist in one process (actor + critic).
         mesh_lib.set_current_mesh(self.mesh)
         assert self.optimizer is not None, "engine has no optimizer"
+        t_start = time.perf_counter()
         mb_list = split_padded_tensor_dict_into_mb_list(
             input_, self.config.mb_spec
         )
@@ -537,17 +588,67 @@ class JaxTrainEngine(TrainEngine):
         self.params, self.opt_state, gnorm = apply_update(
             self.params, self.opt_state, acc, total_weight
         )
+        gnorm_f = float(gnorm)  # blocks until the step is done on device
+        step_time = time.perf_counter() - t_start
         self._step_count += 1
         lr = float(self.lr_schedule(self._step_count))
         loss_avg = float(
             sum(float(l) * w for l, w in zip(losses, weights)) / total_weight
         )
-        return dict(
+        stats = dict(
             loss=loss_avg,
-            grad_norm=float(gnorm),
+            grad_norm=gnorm_f,
             lr=lr,
             n_mbs=len(mb_list.mbs),
             update_steps=self._step_count,
+        )
+        stats.update(self._throughput_stats(input_, step_time))
+        return stats
+
+    def _throughput_stats(
+        self, input_: dict[str, Any], step_time: float
+    ) -> dict[str, float]:
+        """Emit the log-parseable throughput series the reference benchmark
+        harness consumes (`time_perf/*` + `n_tokens`, BASELINE.md notes;
+        realhf/system/master_worker.py:497-533) plus live TFLOP/s / MFU."""
+        from areal_tpu.utils import stats_tracker
+        from areal_tpu.utils.flops import peak_flops, train_flops_per_token
+
+        mask = input_.get("attention_mask")
+        if mask is not None:
+            lens = np.asarray(mask).sum(axis=-1).astype(np.int64)
+        else:
+            lens = np.asarray([input_["input_ids"].shape[-1]])
+        n_tokens = int(lens.sum())
+        # mean causal context per token: sum L(L+1)/2 over seqs / total
+        avg_ctx = float((lens * (lens + 1) / 2).sum() / max(n_tokens, 1))
+        n_chips = self.mesh.devices.size if self.mesh is not None else 1
+        tflops = (
+            train_flops_per_token(self.model_config, avg_ctx) * n_tokens
+        ) / step_time / 1e12
+        tokens_per_sec_per_chip = n_tokens / step_time / n_chips
+        dev_kind = jax.devices()[0].device_kind
+        mfu = tflops * 1e12 / n_chips / peak_flops(dev_kind)
+        # "throughput/n_tokens" (not bare "n_tokens"): algorithm engines
+        # register n_tokens as a bool-mask *denominator* in the same scope.
+        # A colocated critic engine prefixes its series so actor and critic
+        # don't average into one stream on the shared default tracker.
+        p = "critic/" if self.config.is_critic else ""
+        stats_tracker.scalar(
+            **{
+                f"{p}time_perf/train_batch": step_time,
+                f"{p}throughput/n_tokens": float(n_tokens),
+                f"{p}throughput/tokens_per_sec_per_chip": tokens_per_sec_per_chip,
+                f"{p}throughput/tflops_per_chip": tflops / n_chips,
+                f"{p}throughput/mfu": mfu,
+            }
+        )
+        return dict(
+            n_tokens=float(n_tokens),
+            train_batch_time=step_time,
+            tokens_per_sec_per_chip=tokens_per_sec_per_chip,
+            tflops_per_chip=tflops / n_chips,
+            mfu=mfu,
         )
 
     def eval_batch(

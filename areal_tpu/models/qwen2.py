@@ -39,6 +39,15 @@ import numpy as np
 PADDING_SEGMENT = -1
 
 
+def _cstr(x: jax.Array, *logical_axes: str | None) -> jax.Array:
+    """Activation sharding constraint by logical axes (no-op off-mesh).
+    Pinning layer-boundary layouts keeps GSPMD from inventing conflicting
+    layouts for scan residuals in the backward pass (full-remat reshards)."""
+    from areal_tpu.parallel import mesh as mesh_lib
+
+    return mesh_lib.constrain(x, *logical_axes)
+
+
 @dataclass(frozen=True)
 class ModelConfig:
     vocab_size: int = 32000
@@ -404,6 +413,9 @@ def attention(
         k = rms_norm(k, layer_p["k_norm"], cfg.rms_norm_eps)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    q = _cstr(q, "tokens", "act_heads", None)
+    k = _cstr(k, "tokens", "act_kv_heads", None)
+    v = _cstr(v, "tokens", "act_kv_heads", None)
 
     T = x.shape[0]
     impl = resolve_attn_impl(cfg)
@@ -427,13 +439,23 @@ def attention(
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         out = jnp.einsum("kgts,skd->tkgd", probs, v)
         out = out.reshape(T, nH, hd)
-    return jnp.einsum("tnd,ndh->th", out, layer_p["o_kernel"])
+    out = _cstr(out, "tokens", "act_heads", None)
+    return _cstr(
+        jnp.einsum("tnd,ndh->th", out, layer_p["o_kernel"]),
+        "tokens",
+        "act_embed",
+    )
 
 
 def mlp(layer_p: dict, x: jax.Array) -> jax.Array:
     gate = jnp.einsum("th,hm->tm", x, layer_p["gate_kernel"])
     up = jnp.einsum("th,hm->tm", x, layer_p["up_kernel"])
-    return jnp.einsum("tm,mh->th", jax.nn.silu(gate) * up, layer_p["down_kernel"])
+    h = _cstr(jax.nn.silu(gate) * up, "tokens", "act_mlp")
+    return _cstr(
+        jnp.einsum("tm,mh->th", h, layer_p["down_kernel"]),
+        "tokens",
+        "act_embed",
+    )
 
 
 def _moe_group_size(T: int, target: int) -> int:
@@ -560,7 +582,16 @@ def forward(
     the summed MoE router load-balancing loss (0 for dense models).
     """
     compute_dtype = jnp.dtype(cfg.dtype)
-    x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
+    # Gather from a table whose hidden dim is UNSHARDED: leaving the fsdp
+    # (dp) shards on the hidden dim makes SPMD pass them through the gather
+    # output, which then collides with the tokens-over-(dp,sp) layout every
+    # consumer wants and forces a full-remat reshard in the backward.
+    table = _cstr(params["embed"]["embedding"], "vocab", None)
+    x = _cstr(
+        table[input_ids].astype(compute_dtype),
+        "tokens",
+        "act_embed",
+    )
     cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta)
     # Dense path: build the [T,T] mask ONCE here (outside the per-layer remat
     # region); flash/ring never materialise it.
@@ -602,10 +633,12 @@ def forward(
         out = jnp.einsum(
             "th,vh->tv", x, params["embed"]["embedding"].astype(compute_dtype)
         ).astype(jnp.float32)
+        out = _cstr(out, "tokens", "act_vocab")
     else:
         out = jnp.einsum(
             "th,hv->tv", x, params["lm_head"]["kernel"]
         ).astype(jnp.float32)
+        out = _cstr(out, "tokens", "act_vocab")
     if with_aux:
         return out, aux_total
     return out

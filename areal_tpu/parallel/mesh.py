@@ -109,6 +109,7 @@ def default_rules(fsdp: bool = True) -> LogicalRules:
         # activations
         ("batch", AXIS_DP),
         ("seq", AXIS_SP),
+        ("tokens", (AXIS_DP, AXIS_SP)),  # packed 1-D token streams
         ("act_embed", None),
         ("act_heads", AXIS_TP),
         ("act_kv_heads", AXIS_TP),
@@ -167,3 +168,37 @@ def packed_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def constrain(x: jax.Array, *logical_axes: str | None) -> jax.Array:
+    """Pin an activation's layout by logical axis names (no-op without an
+    ambient mesh).
+
+    Model code calls this at layer boundaries so GSPMD's propagation never
+    has to *guess* activation layouts — an unconstrained backward pass is
+    where "involuntary full rematerialization" reshards come from: XLA
+    derives one layout for a scan residual from the forward and a different
+    one from the gradient flow, then replicates to bridge them.
+    """
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    spec = logical_to_mesh_axes(logical_axes, default_rules())
+    # A logical axis mapping to no mesh axis is deliberately PINNED
+    # replicated (None) — that is the layout statement. But a mesh axis that
+    # doesn't divide the dim (tiny test shapes) becomes UNCONSTRAINED —
+    # "let GSPMD choose" — because pinning replicated there would force an
+    # all-gather the caller never asked for.
+    fixed = []
+    for dim, axes in zip(x.shape, spec):
+        if axes is None:
+            fixed.append(None)
+            continue
+        group = (axes,) if isinstance(axes, str) else tuple(axes)
+        size = 1
+        for a in group:
+            size *= mesh.shape.get(a, 1)
+        fixed.append(axes if dim % size == 0 else PartitionSpec.UNCONSTRAINED)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*fixed))
+    )
