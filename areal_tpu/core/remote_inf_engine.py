@@ -295,7 +295,16 @@ class RemoteInfEngine(InferenceEngine):
         NCCL broadcast fast path (fsdp_engine.py:298-401), with DCN/HTTP as
         the transport and the version stamped inside the servers' pause
         window."""
+        import time as _time
+        import uuid
+
         from areal_tpu.core.weight_transfer import pack_buckets
+
+        # Unique AND monotonically ordered (ns timestamp prefix, fixed
+        # width): servers reset staging when a *newer* push id appears and
+        # reject frames from *older* pushes, so a late retransmitted frame
+        # from an aborted push can never wipe the current push's staging.
+        push_id = f"{_time.time_ns():020d}-{uuid.uuid4().hex[:8]}"
 
         async def _run():
             try:
@@ -306,7 +315,7 @@ class RemoteInfEngine(InferenceEngine):
                         *[
                             arequest_with_retry(
                                 a,
-                                "/update_weights_from_tensor",
+                                f"/update_weights_from_tensor?push_id={push_id}",
                                 data=b,
                                 max_retries=self.config.request_retries,
                                 timeout=self.config.request_timeout,

@@ -143,35 +143,51 @@ def unpack_bucket_parts(payload: bytes) -> list[tuple[dict, bytes]]:
 
 class WeightStaging:
     """Server-side accumulator: feed it frames in any order; tensors
-    materialise once all their byte ranges have arrived."""
+    materialise once all their byte ranges have arrived.
+
+    Duplicate frames are EXPECTED: the client's arequest_with_retry re-sends
+    a bucket whenever a response times out even though the server may have
+    already applied it. Received coverage is therefore tracked as a set of
+    (part_offset, nbytes) ranges — a range seen twice counts once — and
+    parts of a tensor that already materialised are dropped outright."""
 
     def __init__(self):
         self._bufs: dict[str, bytearray] = {}
         self._meta: dict[str, dict] = {}
-        self._received: dict[str, int] = {}
+        self._parts: dict[str, set[tuple[int, int]]] = {}
         self.ready: dict[str, np.ndarray] = {}
 
     def __len__(self) -> int:
         return len(self.ready)
 
+    def reset(self) -> None:
+        """Drop all staged state (start of a new push / failed commit)."""
+        self._bufs.clear()
+        self._meta.clear()
+        self._parts.clear()
+        self.ready.clear()
+
     def add_bucket(self, payload: bytes) -> None:
         for spec, raw in unpack_bucket_parts(payload):
             name = spec["name"]
+            if name in self.ready:  # duplicate of a completed tensor
+                continue
             total = spec["total_nbytes"]
             if name not in self._bufs:
                 self._bufs[name] = bytearray(total)
                 self._meta[name] = spec
-                self._received[name] = 0
+                self._parts[name] = set()
             off = spec["part_offset"]
             self._bufs[name][off : off + len(raw)] = raw
-            self._received[name] += len(raw)
-            if self._received[name] >= total:
+            self._parts[name].add((off, len(raw)))
+            covered = sum(n for _, n in self._parts[name])
+            if covered >= total:
                 m = self._meta[name]
                 self.ready[name] = np.frombuffer(
                     bytes(self._bufs.pop(name)), dtype=_np_dtype(m["dtype"])
                 ).reshape(m["shape"])
                 self._meta.pop(name)
-                self._received.pop(name)
+                self._parts.pop(name)
 
     def finalize(self) -> dict[str, np.ndarray]:
         if self._bufs:

@@ -81,6 +81,7 @@ class DecodeServer:
         from areal_tpu.core.weight_transfer import WeightStaging
 
         self._weight_staging = WeightStaging()
+        self._staging_push_id: str | None = None
         self._last_commit_version: int | None = None
 
     # -- handlers -------------------------------------------------------
@@ -177,7 +178,22 @@ class DecodeServer:
         self, request: web.Request
     ) -> web.Response:
         payload = await request.read()
+        push_id = request.query.get("push_id")
         async with self._ctl_lock:
+            # Push ids are timestamp-ordered (remote_inf_engine): a NEWER id
+            # invalidates whatever a previous (failed / abandoned) push left
+            # behind; an OLDER id is a stale straggler frame whose retry
+            # must stop rather than wipe the current push's staging.
+            if push_id is not None:
+                cur = self._staging_push_id
+                if cur is not None and push_id < cur:
+                    return web.json_response(
+                        {"status": "error", "message": "stale push_id"},
+                        status=409,
+                    )
+                if push_id != cur:
+                    self._weight_staging.reset()
+                    self._staging_push_id = push_id
             self._weight_staging.add_bucket(payload)
         return web.json_response(
             {"status": "ok", "staged": len(self._weight_staging)}
@@ -201,12 +217,25 @@ class DecodeServer:
                     {"status": "error", "message": "no staged weights"},
                     status=400,
                 )
-            staged = self._weight_staging.finalize()
+            try:
+                staged = self._weight_staging.finalize()
 
-            def _install():
-                self.engine.update_weights_from_tensor(staged, version=version)
+                def _install():
+                    self.engine.update_weights_from_tensor(
+                        staged, version=version
+                    )
 
-            await asyncio.get_running_loop().run_in_executor(None, _install)
+                await asyncio.get_running_loop().run_in_executor(
+                    None, _install
+                )
+            except Exception as e:
+                # A wedged staging area would poison every later push —
+                # clear it so the learner can retry from scratch.
+                self._weight_staging.reset()
+                self._staging_push_id = None
+                return web.json_response(
+                    {"status": "error", "message": str(e)}, status=500
+                )
             self._last_commit_version = (
                 int(version) if version is not None else None
             )

@@ -1,52 +1,36 @@
 """Benchmark harness: one JSON line for the driver.
 
-Measures the GSPMD trainer's packed-SFT step throughput on the flagship
-Qwen2.5-0.5B-geometry decoder (bf16, remat, scan-over-layers) on whatever
-accelerator is attached, and reports MFU against the chip's bf16 peak.
+Two measurements on whatever accelerator is attached:
 
-`vs_baseline` compares our trainer MFU to 0.20 — the ballpark dense-7B
-train-step MFU of the reference's Megatron/FSDP GPU trainer in the published
-boba² runs (BASELINE.md; AReaL does not publish MFU directly, 0.20 is the
-standard H800 Megatron figure for this class of run).
+1. TRAIN (primary metric): GSPMD trainer packed-SFT step on the flagship
+   Qwen2.5-0.5B geometry (bf16, remat, scan-over-layers, Pallas flash
+   attention) at a realistic 64k tokens/step. MFU uses the explicit
+   per-token matmul FLOPs model (areal_tpu/utils/flops.py) — embedding
+   *lookup* excluded, lm_head matmul + causal attention term included —
+   against the chip's bf16 peak.
+2. DECODE (detail): in-process continuous-batching engine
+   (areal_tpu/engine/jax_decode.py) serving concurrent requests; reports
+   steady-state generated tokens/sec/chip — the rollout half of the
+   async-RL throughput story (BASELINE.md "rollout tokens/sec").
+
+`vs_baseline` compares trainer MFU to 0.20 — the ballpark dense-model
+train-step MFU of the reference's Megatron/FSDP GPU trainer in the
+published boba² runs (BASELINE.md; AReaL does not publish MFU directly,
+0.20 is the standard H800 Megatron figure for this class of run).
 """
 
 from __future__ import annotations
 
 import json
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
-
 BASELINE_TRAINER_MFU = 0.20
 
-# bf16 peak FLOP/s per chip by device kind substring.
-PEAK_FLOPS = [
-    ("v6", 918e12),
-    ("v5 lite", 197e12),
-    ("v5e", 197e12),
-    ("v5", 459e12),  # v5p
-    ("v4", 275e12),
-]
 
-
-def peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for sub, f in PEAK_FLOPS:
-        if sub in kind:
-            return f
-    return 100e12  # unknown accelerator / CPU: nominal figure
-
-
-def count_params(params) -> int:
-    import jax
-
-    return int(sum(np.prod(x.shape) for x in jax.tree.leaves(params)))
-
-
-def main() -> None:
-    import jax
-
+def bench_train(model, tokens_per_step, seq_len, mb_tokens, warmup, iters):
     from areal_tpu.api.alloc_mode import ParallelStrategy
     from areal_tpu.api.cli_args import (
         MicroBatchSpec,
@@ -55,8 +39,111 @@ def main() -> None:
     )
     from areal_tpu.api.io_struct import FinetuneSpec
     from areal_tpu.engine.sft.lm_engine import JaxLMEngine
-    from areal_tpu.models.qwen2 import ModelConfig
     from areal_tpu.utils.data import pad_sequences_to_tensors
+
+    cfg = TrainEngineConfig(
+        experiment_name="bench",
+        trial_name="b",
+        path="",
+        init_from_scratch=True,
+        dtype=model.dtype,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=mb_tokens),
+        optimizer=OptimizerConfig(
+            lr=1e-4,
+            warmup_steps_proportion=0.0,
+            lr_scheduler_type="constant",
+            gradient_clipping=1.0,
+        ),
+        gradient_checkpointing=model.remat,
+    )
+    eng = JaxLMEngine(cfg)
+    eng.model_config = model
+    eng.create_process_group(ParallelStrategy())
+    eng.initialize(None, FinetuneSpec(1, 1000, 1))
+
+    rng = np.random.RandomState(0)
+    seqs = [
+        dict(
+            input_ids=rng.randint(1, model.vocab_size, (seq_len,)),
+            loss_mask=np.ones(seq_len, dtype=np.int32),
+        )
+        for _ in range(tokens_per_step // seq_len)
+    ]
+    batch = pad_sequences_to_tensors(seqs)
+
+    for _ in range(warmup):
+        eng.train_lm(batch)
+    stats = []
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        stats.append(eng.train_lm(batch))
+    dt = (time.perf_counter() - t0) / iters
+    eng.destroy()
+    # engine-reported MFU (same flops model), averaged over timed iters
+    mfu = float(np.mean([s["mfu"] for s in stats]))
+    tps = float(np.mean([s["tokens_per_sec_per_chip"] for s in stats]))
+    return dict(
+        mfu=mfu,
+        tokens_per_sec_per_chip=tps,
+        step_time_s=dt,
+        tokens_per_step=tokens_per_step,
+    )
+
+
+def bench_decode(model, n_requests, prompt_len, new_tokens, max_running):
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.models.qwen2 import init_params
+
+    import jax
+
+    dcfg = JaxDecodeConfig(
+        context_length=prompt_len + new_tokens + 128,
+        max_running_requests=max_running,
+        new_tokens_per_chunk=min(128, new_tokens),
+        dtype=model.dtype,
+        kv_cache_dtype=model.dtype,
+    )
+    eng = JaxDecodeEngine(dcfg, InferenceEngineConfig(max_concurrent_rollouts=n_requests))
+    eng.set_model(init_params(model, jax.random.PRNGKey(0)), model)
+    eng.initialize()
+
+    rng = np.random.RandomState(1)
+    g = GenerationHyperparameters(
+        max_new_tokens=new_tokens, temperature=1.0, top_p=1.0
+    )
+
+    def one(i):
+        req = ModelRequest(
+            input_ids=rng.randint(1, model.vocab_size, (prompt_len,)).tolist(),
+            gconfig=g,
+        )
+        return eng.generate(req, timeout=1800)
+
+    with ThreadPoolExecutor(max_workers=n_requests) as pool:
+        # warmup wave triggers prefill+chunk compiles
+        list(pool.map(one, range(max(2, max_running // 8))))
+        t0 = time.perf_counter()
+        results = list(pool.map(one, range(n_requests)))
+        dt = time.perf_counter() - t0
+    eng.destroy()
+    gen_tokens = sum(len(r.output_tokens) for r in results)
+    return dict(
+        decode_tokens_per_sec_per_chip=gen_tokens / dt,
+        decode_requests=n_requests,
+        decode_new_tokens=new_tokens,
+    )
+
+
+def main() -> None:
+    import jax
+
+    from areal_tpu.models.qwen2 import ModelConfig
 
     dev = jax.devices()[0]
     on_accel = dev.platform != "cpu"
@@ -75,9 +162,22 @@ def main() -> None:
             remat=True,
             scan_layers=True,
         )
-        tokens_per_step = 4096
-        seq_len = 512
-        warmup, iters = 2, 8
+        # mb of 4096 tokens: the f32 [T, vocab] logits + their grad dominate
+        # HBM (151936-wide vocab → ~2.5 GiB per 4k tokens); 16 grad-accum
+        # micro-batches make up the 64k-token step.
+        train = bench_train(
+            model,
+            tokens_per_step=65536,
+            seq_len=1024,
+            mb_tokens=4096,
+            warmup=2,
+            iters=5,
+        )
+        decode = bench_decode(
+            model, n_requests=128, prompt_len=128, new_tokens=256,
+            max_running=64,
+        )
+        metric = "trainer_mfu_qwen2.5-0.5b_bf16_packed_sft"
     else:  # CPU smoke fallback so the harness always emits a line
         model = ModelConfig(
             vocab_size=1024,
@@ -89,72 +189,29 @@ def main() -> None:
             dtype="float32",
             param_dtype="float32",
         )
-        tokens_per_step = 512
-        seq_len = 128
-        warmup, iters = 1, 3
+        train = bench_train(
+            model, tokens_per_step=512, seq_len=128, mb_tokens=640,
+            warmup=1, iters=3,
+        )
+        decode = bench_decode(
+            model, n_requests=4, prompt_len=16, new_tokens=16, max_running=4
+        )
+        metric = "trainer_mfu_cpu_smoke"
 
-    cfg = TrainEngineConfig(
-        experiment_name="bench",
-        trial_name="b",
-        path="",
-        init_from_scratch=True,
-        dtype=model.dtype,
-        mb_spec=MicroBatchSpec(max_tokens_per_mb=tokens_per_step + seq_len),
-        optimizer=OptimizerConfig(
-            lr=1e-4,
-            warmup_steps_proportion=0.0,
-            lr_scheduler_type="constant",
-            gradient_clipping=1.0,
-        ),
-        gradient_checkpointing=model.remat,
-    )
-    eng = JaxLMEngine(cfg)
-    eng.model_config = model
-    eng.create_process_group(ParallelStrategy())
-    eng.initialize(None, FinetuneSpec(1, 1000, 1))
-
-    rng = np.random.RandomState(0)
-    n_seqs = tokens_per_step // seq_len
-    seqs = []
-    for _ in range(n_seqs):
-        ids = rng.randint(1, model.vocab_size, (seq_len,))
-        mask = np.ones(seq_len, dtype=np.int32)
-        seqs.append(dict(input_ids=ids, loss_mask=mask))
-    batch = pad_sequences_to_tensors(seqs)
-
-    for _ in range(warmup):
-        eng.train_lm(batch)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        eng.train_lm(batch)
-    dt = (time.perf_counter() - t0) / iters
-
-    n_params = count_params(eng.params)
-    # 6ND dense matmul FLOPs + causal attention term 6·L·T·ctx·H (fwd+bwd).
-    attn_flops = (
-        6 * model.num_hidden_layers * tokens_per_step * seq_len
-        * model.num_attention_heads * (model.hidden_size // model.num_attention_heads)
-    )
-    flops = 6 * n_params * tokens_per_step + attn_flops
-    mfu = flops / dt / peak_flops(dev.device_kind)
-    tokens_per_sec = tokens_per_step / dt
-
+    detail = {
+        "device": dev.device_kind,
+        **{k: round(v, 4) if isinstance(v, float) else v for k, v in train.items()},
+        **{k: round(v, 1) if isinstance(v, float) else v for k, v in decode.items()},
+    }
+    detail["step_time_s"] = round(train["step_time_s"], 3)
     print(
         json.dumps(
             {
-                "metric": "trainer_mfu_qwen2.5-0.5b_bf16_packed_sft"
-                if on_accel
-                else "trainer_mfu_cpu_smoke",
-                "value": round(mfu, 4),
+                "metric": metric,
+                "value": round(train["mfu"], 4),
                 "unit": "fraction_of_peak",
-                "vs_baseline": round(mfu / BASELINE_TRAINER_MFU, 3),
-                "detail": {
-                    "device": dev.device_kind,
-                    "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
-                    "step_time_s": round(dt, 4),
-                    "n_params": n_params,
-                    "tokens_per_step": tokens_per_step,
-                },
+                "vs_baseline": round(train["mfu"] / BASELINE_TRAINER_MFU, 3),
+                "detail": detail,
             }
         )
     )

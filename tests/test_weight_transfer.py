@@ -149,3 +149,41 @@ def test_staging_rejects_incomplete():
     st.add_bucket(buckets[0])  # only the first part
     with pytest.raises(RuntimeError, match="incomplete"):
         st.finalize()
+
+
+def test_staging_ignores_duplicate_frames():
+    """arequest_with_retry re-sends frames whose response was lost; coverage
+    is tracked by byte range so duplicates must not double-count (a
+    duplicated middle part previously materialised tensors with zero-filled
+    tails)."""
+    from areal_tpu.core.weight_transfer import WeightStaging
+
+    rng = np.random.RandomState(3)
+    big = rng.randn(1200, 1024).astype(np.float32)  # splits at chunk_mb=1
+    named = {"big": big, "small": np.arange(8, dtype=np.float32)}
+    buckets = list(pack_buckets(named, chunk_mb=1))
+    st = WeightStaging()
+    # every frame delivered twice, including after completion
+    for b in buckets:
+        st.add_bucket(b)
+        st.add_bucket(b)
+    for b in buckets:
+        st.add_bucket(b)
+    out = st.finalize()
+    np.testing.assert_array_equal(out["big"], big)
+    np.testing.assert_array_equal(out["small"], named["small"])
+
+
+def test_staging_reset_clears_partial_state():
+    from areal_tpu.core.weight_transfer import WeightStaging
+
+    named = {"w": np.zeros((600, 1024), np.float32)}
+    buckets = list(pack_buckets(named, chunk_mb=1))
+    st = WeightStaging()
+    st.add_bucket(buckets[0])
+    st.reset()
+    # a fresh complete push reassembles cleanly after the reset
+    for b in buckets:
+        st.add_bucket(b)
+    out = st.finalize()
+    assert out["w"].shape == (600, 1024)
