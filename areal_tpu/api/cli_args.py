@@ -229,6 +229,11 @@ class JaxDecodeConfig:
     random_seed: int = 1
     dtype: str = "bfloat16"
     kv_cache_dtype: str = "bfloat16"
+    # Gen-side tensor parallelism: params + KV cache are sharded over a
+    # [1,1,1,tp] decode mesh (parity: the server-side d/t/p dims of the
+    # reference's allocation grammar, areal/api/alloc_mode.py:277-280 — dp
+    # maps to independent server replicas, tp to this).
+    tensor_parallel_size: int = 1
     context_length: int = 32768
     max_running_requests: int = 64
     page_size: int = 128  # tokens per KV page (TPU-friendly multiple of 128)

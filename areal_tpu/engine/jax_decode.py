@@ -113,12 +113,24 @@ class JaxDecodeEngine(InferenceEngine):
         self._thread_exc: BaseException | None = None
 
         # device state (created in initialize)
+        self.mesh = None
+        self._param_shardings = None
+        self._cache_sharding = None
         self._k_cache = None
         self._v_cache = None
         self._slot_lengths = None  # np [R]
         self._slots: list[_Slot | None] = []
+        # Interrupted requests keep their KV parked in the slot so a resume
+        # with rid affinity prefll's nothing (server-side prefix reuse; the
+        # radix-cache property the reference gets from SGLang,
+        # areal/core/remote_inf_engine.py:404-478).
+        self._parked: dict[str, tuple[int, int, float]] = {}  # rid -> (slot, covered, ts)
+        self._parked_tokens: dict[str, list[int]] = {}
+        # Requests popped from the queue that found no capacity; consulted
+        # before the queue so admission order is preserved.
+        self._overflow: list[_Slot] = []
         self._rng = None
-        self._chunk_fn = None
+        self._chunk_fns: dict[bool, Callable] = {}
         self._prefill_fns: dict[int, Callable] = {}
         self._write_fns: dict[int, Callable] = {}
 
@@ -150,6 +162,13 @@ class JaxDecodeEngine(InferenceEngine):
             host = hf_io.load_hf_params(self.config.model_path, self.model_config)
             self.params = jax.tree.map(jnp.asarray, host)
         cfg = self.model_config
+        self._build_mesh()
+        if self._param_shardings is not None:
+            self.params = jax.tree.map(
+                lambda x, s: jax.device_put(x, s),
+                self.params,
+                self._param_shardings,
+            )
         R = self.config.max_running_requests
         S = self.config.context_length
         kv_dtype = jnp.dtype(self.config.kv_cache_dtype)
@@ -162,10 +181,12 @@ class JaxDecodeEngine(InferenceEngine):
         )
         self._k_cache = jnp.zeros(shape, kv_dtype)
         self._v_cache = jnp.zeros(shape, kv_dtype)
+        if self._cache_sharding is not None:
+            self._k_cache = jax.device_put(self._k_cache, self._cache_sharding)
+            self._v_cache = jax.device_put(self._v_cache, self._cache_sharding)
         self._slot_lengths = np.zeros(R, dtype=np.int32)
         self._slots = [None] * R
         self._rng = jax.random.PRNGKey(self.config.random_seed)
-        self._build_chunk_fn()
 
         from areal_tpu.core.workflow_executor import WorkflowExecutor
 
@@ -189,7 +210,69 @@ class JaxDecodeEngine(InferenceEngine):
         self._k_cache = self._v_cache = None
 
     # -- jitted programs -----------------------------------------------
-    def _build_chunk_fn(self):
+    def _build_mesh(self):
+        """Decode mesh: [1, 1, 1, tp] over the first tp local devices.
+
+        Params are sharded by the same logical-axis rules as the trainer
+        (heads/mlp/vocab over tp); the KV cache shards its kv-head dim when
+        tp divides it, else stays replicated (GQA models with few kv heads).
+        Gen-side dp = independent server replicas, handled by the launcher.
+        """
+        tp = max(int(self.config.tensor_parallel_size), 1)
+        if tp == 1:
+            self.mesh = None
+            self._param_shardings = None
+            self._cache_sharding = None
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from areal_tpu.models.qwen2 import param_logical_axes
+        from areal_tpu.parallel import mesh as mesh_lib
+        from areal_tpu.api.alloc_mode import ParallelStrategy
+
+        devices = jax.devices()
+        assert len(devices) >= tp, (
+            f"decode tp={tp} needs {tp} devices, have {len(devices)}"
+        )
+        self.mesh = mesh_lib.build_mesh(
+            ParallelStrategy(tensor_parallel_size=tp), devices[:tp]
+        )
+        rules = mesh_lib.default_rules(fsdp=False)
+        if self.model_config.num_key_value_heads % tp != 0:
+            # GQA with fewer kv heads than tp: replicate the k/v projections
+            # (and their activations) instead of failing the device_put.
+            rules = tuple(
+                (k, None) if k in ("kv_heads", "act_kv_heads") else (k, v)
+                for k, v in rules
+            )
+        axes = param_logical_axes(self.model_config)
+        self._param_shardings = jax.tree.map(
+            lambda a: mesh_lib.named_sharding(self.mesh, a, rules),
+            axes,
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
+        kv_axis = (
+            mesh_lib.AXIS_TP
+            if self.model_config.num_key_value_heads % tp == 0
+            else None
+        )
+        self._cache_sharding = NamedSharding(
+            self.mesh, P(None, None, None, kv_axis, None)
+        )
+
+    def _get_chunk_fn(self, use_topp: bool):
+        """Chunked decode loop; two static sampler variants.
+
+        `use_topp=False` (the common RL rollout setting, top_p == 1):
+        plain categorical over temperature-scaled logits. `use_topp=True`:
+        top-p filtering *within the top-64 candidates* (lax.top_k) — a full
+        [R, vocab] argsort per decode step costs ~130 ms on a v5e chip and
+        was the round-1 decode bottleneck; the tail mass beyond the top 64
+        of a trained LM at top_p < 1 is negligible. Reported logprobs are
+        always exact log-softmax over the FULL vocab for the chosen token.
+        """
+        if use_topp in self._chunk_fns:
+            return self._chunk_fns[use_topp]
         cfg = self.model_config
         n_chunk = self.config.new_tokens_per_chunk
 
@@ -198,18 +281,24 @@ class JaxDecodeEngine(InferenceEngine):
             logprobs_all = jax.nn.log_softmax(logits, axis=-1)
             greedy_tok = jnp.argmax(logits, axis=-1)
             scaled = logits / jnp.maximum(temps[:, None], 1e-6)
-            # top-p: sort desc, keep the minimal prefix with cum prob >= p
-            sort_idx = jnp.argsort(-scaled, axis=-1)
-            sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
-            sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(sorted_probs, axis=-1)
-            keep = cum - sorted_probs < top_ps[:, None]
-            sorted_logits = jnp.where(keep, sorted_logits, -1e30)
             key, sub = jax.random.split(key)
-            sampled_sorted = jax.random.categorical(sub, sorted_logits, axis=-1)
-            sampled = jnp.take_along_axis(
-                sort_idx, sampled_sorted[:, None], axis=-1
-            )[:, 0]
+            if use_topp:
+                # Per-slot exactness: co-scheduled top_p == 1 slots keep the
+                # FULL distribution (plain categorical); only slots that
+                # asked for top-p filtering get the top-64 truncation.
+                k = min(64, logits.shape[-1])
+                vals, idx = jax.lax.top_k(scaled, k)
+                probs = jax.nn.softmax(vals, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = cum - probs < top_ps[:, None]
+                vals = jnp.where(keep, vals, -1e30)
+                key, sub2 = jax.random.split(key)
+                s = jax.random.categorical(sub, vals, axis=-1)
+                sampled_topp = jnp.take_along_axis(idx, s[:, None], axis=-1)[:, 0]
+                sampled_full = jax.random.categorical(sub2, scaled, axis=-1)
+                sampled = jnp.where(top_ps < 1.0, sampled_topp, sampled_full)
+            else:
+                sampled = jax.random.categorical(sub, scaled, axis=-1)
             tok = jnp.where(greedy, greedy_tok, sampled)
             logp = jnp.take_along_axis(logprobs_all, tok[:, None], axis=-1)[:, 0]
             return tok, logp, key
@@ -233,15 +322,24 @@ class JaxDecodeEngine(InferenceEngine):
             )
             return kc, vc, last, lengths, key, toks, logps
 
-        self._chunk_fn = jax.jit(chunk, donate_argnums=(1, 2))
+        self._chunk_fns[use_topp] = jax.jit(chunk, donate_argnums=(1, 2))
+        return self._chunk_fns[use_topp]
 
     def _get_prefill_fn(self, bucket: int):
+        """Cache-warm only: writes the prompt's KV rows at a slot offset.
+
+        No lm_head, no logits, no host round-trip — the first generated
+        token is sampled by the chunk loop like every other token (the
+        prompt's LAST token is withheld from prefill and fed as the chunk's
+        first decode input)."""
         if bucket not in self._prefill_fns:
             cfg = self.model_config
 
             def prefill_and_write(params, kc, vc, ids, positions, slot, true_len):
                 valid = jnp.arange(ids.shape[0]) < true_len
-                logits, k, v = prefill(params, ids, positions, cfg, valid=valid)
+                _, k, v = prefill(
+                    params, ids, positions, cfg, valid=valid, with_logits=False
+                )
                 kc = jax.lax.dynamic_update_slice(
                     kc,
                     k[:, None].astype(kc.dtype),
@@ -252,7 +350,7 @@ class JaxDecodeEngine(InferenceEngine):
                     v[:, None].astype(vc.dtype),
                     (0, slot, 0, 0, 0),
                 )
-                return logits, kc, vc
+                return kc, vc
 
             self._prefill_fns[bucket] = jax.jit(
                 prefill_and_write, donate_argnums=(1, 2)
@@ -261,76 +359,105 @@ class JaxDecodeEngine(InferenceEngine):
 
     # -- scheduler ------------------------------------------------------
     def _free_slots(self) -> list[int]:
-        return [i for i, s in enumerate(self._slots) if s is None]
+        parked = {slot for slot, _, _ in self._parked.values()}
+        return [
+            i
+            for i, s in enumerate(self._slots)
+            if s is None and i not in parked
+        ]
 
     def _active_mask(self) -> np.ndarray:
         return np.array([s is not None for s in self._slots], dtype=bool)
 
+    def _evict_parked_lru(self) -> int | None:
+        """Free the least-recently-parked slot; returns its index."""
+        if not self._parked:
+            return None
+        rid = min(self._parked, key=lambda r: self._parked[r][2])
+        slot, _, _ = self._parked.pop(rid)
+        self._parked_tokens.pop(rid, None)
+        self._slot_lengths[slot] = 0
+        return slot
+
+    def _take_parked(self, item: _Slot) -> int | None:
+        """Slot index whose parked KV covers exactly item.prompt[:-1].
+
+        An interrupted request resumes with prompt' = prompt + partial
+        tokens; the parked cache holds KV for precisely those tokens minus
+        the last (whose KV the chunk loop writes when it consumes it). On
+        an exact match the resume needs NO prefill at all."""
+        entry = self._parked.get(item.rid)
+        if entry is None:
+            return None
+        slot, covered, _ = entry
+        cached = self._parked_tokens.get(item.rid, [])
+        if covered == len(item.prompt) - 1 and cached == item.prompt[:-1]:
+            self._parked.pop(item.rid)
+            self._parked_tokens.pop(item.rid, None)
+            return slot
+        # prompt diverged (edited/truncated): drop the stale cache
+        self._parked.pop(item.rid)
+        self._parked_tokens.pop(item.rid, None)
+        self._slot_lengths[slot] = 0
+        return None
+
+    def _next_request(self) -> "_Slot | None":
+        if self._overflow:
+            return self._overflow.pop(0)
+        try:
+            return self._request_q.get_nowait()
+        except queue.Empty:
+            return None
+
     def _admit(self) -> bool:
         admitted = False
-        for slot_idx in self._free_slots():
-            try:
-                item: _Slot = self._request_q.get_nowait()
-            except queue.Empty:
+        while True:
+            item = self._next_request()
+            if item is None:
                 break
             prompt = item.prompt
             P = len(prompt)
             if P + item.gconfig.max_new_tokens > self.config.context_length:
                 self._complete(item, stop_reason="length")
                 continue
-            bucket = _next_bucket(min(P, self.config.context_length))
-            ids = np.zeros(bucket, dtype=np.int32)
-            ids[:P] = prompt
-            positions = np.arange(bucket, dtype=np.int32)
-            fn = self._get_prefill_fn(bucket)
-            with self._weight_lock:
-                logits, self._k_cache, self._v_cache = fn(
-                    self.params,
-                    self._k_cache,
-                    self._v_cache,
-                    jnp.asarray(ids),
-                    jnp.asarray(positions),
-                    slot_idx,
-                    P,
-                )
-                tok, logp = self._sample_host_one(
-                    np.asarray(logits[P - 1]), item.gconfig
-                )
-            item.ttft = time.monotonic() - item.start_time
-            item.tokens.append(int(tok))
-            item.logprobs.append(float(logp))
-            item.versions.append(self._version)
+            # Resume check comes FIRST: after a flush-and-resume cycle every
+            # slot may be parked, and evicting before matching would destroy
+            # the very cache this request came back for.
+            resumed = self._take_parked(item)
+            if resumed is None:
+                free = self._free_slots()
+                if not free:
+                    evicted = self._evict_parked_lru()
+                    if evicted is None:
+                        # no capacity at all: hold the request for the next
+                        # scheduler pass (order preserved via _overflow)
+                        self._overflow.insert(0, item)
+                        break
+                    free = [evicted]
+                slot_idx = free[0]
+            else:
+                slot_idx = resumed
+            if resumed is None and P > 1:
+                pre = P - 1
+                bucket = _next_bucket(min(pre, self.config.context_length))
+                ids = np.zeros(bucket, dtype=np.int32)
+                ids[:pre] = prompt[:-1]
+                positions = np.arange(bucket, dtype=np.int32)
+                fn = self._get_prefill_fn(bucket)
+                with self._weight_lock:
+                    self._k_cache, self._v_cache = fn(
+                        self.params,
+                        self._k_cache,
+                        self._v_cache,
+                        jnp.asarray(ids),
+                        jnp.asarray(positions),
+                        slot_idx,
+                        pre,
+                    )
             self._slots[slot_idx] = item
-            self._slot_lengths[slot_idx] = P
+            self._slot_lengths[slot_idx] = P - 1
             admitted = True
-            if self._finished(item):
-                self._retire(slot_idx)
         return admitted
-
-    def _sample_host_one(self, logits: np.ndarray, g: GenerationHyperparameters):
-        """Sample the first token (prefill output) on host."""
-        logits = logits.astype(np.float64)
-        logprobs_all = logits - _logsumexp(logits)
-        if g.greedy or g.temperature <= 0:
-            tok = int(np.argmax(logits))
-            return tok, logprobs_all[tok]
-        scaled = logits / max(g.temperature, 1e-6)
-        probs = np.exp(scaled - _logsumexp(scaled))
-        if g.top_p < 1.0:
-            order = np.argsort(-probs)
-            cum = np.cumsum(probs[order])
-            keep_n = max(1, int(np.searchsorted(cum, g.top_p) + 1))
-            mask = np.zeros_like(probs)
-            mask[order[:keep_n]] = 1
-            probs = probs * mask
-            probs /= probs.sum()
-        self._rng, sub = jax.random.split(self._rng)
-        tok = int(
-            np.random.default_rng(
-                int(jax.random.randint(sub, (), 0, 2**31 - 1))
-            ).choice(len(probs), p=probs)
-        )
-        return tok, logprobs_all[tok]
 
     def _finished(self, item: _Slot) -> bool:
         g = item.gconfig
@@ -368,7 +495,17 @@ class JaxDecodeEngine(InferenceEngine):
     def _retire(self, slot_idx: int) -> None:
         item = self._slots[slot_idx]
         self._slots[slot_idx] = None
-        self._slot_lengths[slot_idx] = 0
+        if item is not None and item.stop_reason == "interrupt":
+            # Park the slot's KV: the client will resume this rid with
+            # prompt + partial tokens, whose KV (minus the final token) is
+            # exactly what the cache already holds — resume prefills nothing.
+            covered = int(self._slot_lengths[slot_idx])
+            self._parked[item.rid] = (slot_idx, covered, time.monotonic())
+            self._parked_tokens[item.rid] = (
+                list(item.prompt) + list(item.tokens)
+            )[:covered]
+        else:
+            self._slot_lengths[slot_idx] = 0
         if item is not None:
             self._complete(item, stop_reason=item.stop_reason or "stop")
 
@@ -432,10 +569,19 @@ class JaxDecodeEngine(InferenceEngine):
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
-            last[i] = s.tokens[-1]
+            # fresh slots decode their prompt's final token first (its KV
+            # is deliberately not prefilled — see _get_prefill_fn)
+            last[i] = s.tokens[-1] if s.tokens else s.prompt[-1]
             temps[i] = max(s.gconfig.temperature, 1e-6)
             top_ps[i] = s.gconfig.top_p
             greedy[i] = s.gconfig.greedy
+        use_topp = bool(
+            any(
+                s is not None and not s.gconfig.greedy and s.gconfig.top_p < 1.0
+                for s in self._slots
+            )
+        )
+        chunk_fn = self._get_chunk_fn(use_topp)
         version_at_chunk = self._version
         with self._weight_lock:
             self._rng, sub = jax.random.split(self._rng)
@@ -447,7 +593,7 @@ class JaxDecodeEngine(InferenceEngine):
                 _,
                 toks,
                 logps,
-            ) = self._chunk_fn(
+            ) = chunk_fn(
                 self.params,
                 self._k_cache,
                 self._v_cache,
@@ -466,14 +612,17 @@ class JaxDecodeEngine(InferenceEngine):
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
+            if s.ttft == float("inf"):
+                s.ttft = time.monotonic() - s.start_time
             s.tokens.extend(int(t) for t in toks[:, i])
             s.logprobs.extend(float(x) for x in logps[:, i])
             s.versions.extend([version_at_chunk] * n_chunk)
             self._truncate_at_stop(s)
             if s.stop_reason is not None:
-                # rewind the slot length to the true end (cache positions
+                # rewind the slot length to the true end: KV rows cover
+                # prompt[:-1] plus every *consumed* token (cache positions
                 # past it are never attended again before overwrite)
-                self._slot_lengths[i] = len(s.prompt) + len(s.tokens)
+                self._slot_lengths[i] = len(s.prompt) - 1 + len(s.tokens)
                 self._retire(i)
 
     # -- InferenceEngine surface ---------------------------------------
@@ -574,17 +723,33 @@ class JaxDecodeEngine(InferenceEngine):
                 s.stop_reason = "interrupt"
                 self._retire(i)
                 n += 1
+            queued = list(self._overflow)
+            self._overflow.clear()
             while True:
                 try:
-                    item = self._request_q.get_nowait()
+                    queued.append(self._request_q.get_nowait())
                 except queue.Empty:
                     break
+            for item in queued:
                 item.stop_reason = "interrupt"
                 self._complete(item, stop_reason="interrupt")
                 n += 1
         return n
 
     # -- weight updates -------------------------------------------------
+    def _invalidate_parked(self) -> None:
+        """Drop every parked KV cache.
+
+        Called on weight installs (while generation is paused): a resume
+        against KV computed by OLD weights would emit tokens stamped with
+        the NEW version whose logprobs the new policy never produced —
+        silently corrupting the trainer's importance ratios. Resumes after
+        a weight update therefore re-prefill under the new weights."""
+        for rid in list(self._parked):
+            slot, _, _ = self._parked.pop(rid)
+            self._parked_tokens.pop(rid, None)
+            self._slot_lengths[slot] = 0
+
     def init_weights_update_group(self, meta: WeightUpdateMeta):
         pass
 
@@ -601,10 +766,20 @@ class JaxDecodeEngine(InferenceEngine):
         self.pause_generation()
         try:
             with self._weight_lock:
-                # copy — the trainer will donate these buffers next step
-                self.params = jax.tree.map(
-                    lambda x: jnp.copy(jnp.asarray(x)), params
-                )
+                # copy — the trainer will donate these buffers next step;
+                # device_put also reshards from the trainer's (fsdp/tp)
+                # layout onto the decode mesh's layout.
+                if self._param_shardings is not None:
+                    self.params = jax.tree.map(
+                        lambda x, s: jax.device_put(jnp.asarray(x), s),
+                        params,
+                        self._param_shardings,
+                    )
+                else:
+                    self.params = jax.tree.map(
+                        lambda x: jnp.copy(jnp.asarray(x)), params
+                    )
+                self._invalidate_parked()
                 if model_config is not None:
                     decode_cfg = dataclasses.replace(
                         model_config,
@@ -638,9 +813,12 @@ class JaxDecodeEngine(InferenceEngine):
                 def cast(new, old):
                     arr = jnp.asarray(np.asarray(new), dtype=dtype)
                     assert arr.shape == old.shape, (arr.shape, old.shape)
+                    if isinstance(old, jax.Array) and hasattr(old, "sharding"):
+                        arr = jax.device_put(arr, old.sharding)
                     return arr
 
                 self.params = set_named(self.params, named, cast=cast)
+                self._invalidate_parked()
                 if version is not None:
                     self._version = int(version)
                     if self._executor is not None:
@@ -658,7 +836,15 @@ class JaxDecodeEngine(InferenceEngine):
         try:
             with self._weight_lock:
                 host = hf_io.load_hf_params(meta.path, self.model_config)
-                self.params = jax.tree.map(jnp.asarray, host)
+                if self._param_shardings is not None:
+                    self.params = jax.tree.map(
+                        lambda x, s: jax.device_put(jnp.asarray(x), s),
+                        host,
+                        self._param_shardings,
+                    )
+                else:
+                    self.params = jax.tree.map(jnp.asarray, host)
+                self._invalidate_parked()
         finally:
             if not was_paused:
                 self.continue_generation()
@@ -671,7 +857,3 @@ class JaxDecodeEngine(InferenceEngine):
     def get_version(self) -> int:
         return self._version
 
-
-def _logsumexp(x: np.ndarray) -> float:
-    m = x.max()
-    return m + np.log(np.exp(x - m).sum())

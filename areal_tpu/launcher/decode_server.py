@@ -310,6 +310,7 @@ async def _serve(args: argparse.Namespace) -> None:
         max_running_requests=args.max_running_requests,
         new_tokens_per_chunk=args.new_tokens_per_chunk,
         random_seed=args.seed,
+        tensor_parallel_size=args.tp_size,
     )
     tokenizer = None
     if args.model_path and not args.skip_tokenizer_init:
@@ -339,6 +340,12 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--context-length", type=int, default=32768)
     p.add_argument("--max-running-requests", type=int, default=64)
     p.add_argument("--new-tokens-per-chunk", type=int, default=128)
+    p.add_argument(
+        "--tp-size",
+        type=int,
+        default=1,
+        help="gen-side tensor parallelism (alloc grammar's server t dim)",
+    )
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 0)))

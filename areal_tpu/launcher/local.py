@@ -215,8 +215,27 @@ def run_experiment(
                 if alloc.type_ in (AllocationType.DECOUPLED_TRAIN,)
                 else 0
             )
+            gen_tp = alloc.gen.tp_size if alloc.gen is not None else 1
             for i in range(n_servers):
-                launcher.submit_decode_server(i, model_path)
+                env = {}
+                if n_servers > 1 or gen_tp > 1:
+                    # Partition the host's chips between server replicas so
+                    # replica i's jax.devices() sees only its tp chips
+                    # (gen dp = independent replicas; without this every
+                    # replica would claim devices[:tp]).
+                    chips = ",".join(
+                        str(c) for c in range(i * gen_tp, (i + 1) * gen_tp)
+                    )
+                    env["TPU_VISIBLE_CHIPS"] = chips
+                    env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+                launcher.submit_decode_server(
+                    i,
+                    model_path,
+                    extra_args=(
+                        ["--tp-size", str(gen_tp)] if gen_tp > 1 else []
+                    ),
+                    env=env or None,
+                )
             if n_servers:
                 launcher.wait_decode_servers(n_servers)
             launcher.submit_trainers(entrypoint, n_procs=1)

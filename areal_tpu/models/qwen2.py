@@ -696,13 +696,19 @@ def prefill(
     position_ids: jax.Array,
     cfg: ModelConfig,
     valid: jax.Array | None = None,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    with_logits: bool = True,
+) -> tuple[jax.Array | None, jax.Array, jax.Array]:
     """Causal forward over ONE sequence [T], returning (logits [T, V],
     k_cache [L, T, nKV, hd], v_cache [L, T, nKV, hd]).
 
     `valid` [T] bool marks real (non-bucket-pad) tokens; MoE routing must
     see it so pad rows don't claim expert capacity. (Attention needs no
-    mask: causality already hides the pad tail from real tokens.)"""
+    mask: causality already hides the pad tail from real tokens.)
+
+    `with_logits=False` skips the lm_head projection and returns None
+    logits — the cache-warm path: the decode engine samples every token
+    (including the first) inside its chunked decode loop, so prefill only
+    needs to write KV."""
     compute_dtype = jnp.dtype(cfg.dtype)
     x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
     cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta)
@@ -741,6 +747,8 @@ def prefill(
             vs_list.append(v)
         ks, vs = jnp.stack(ks_list), jnp.stack(vs_list)
 
+    if not with_logits:
+        return None, ks, vs
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if cfg.tie_word_embeddings:
         logits = jnp.einsum(

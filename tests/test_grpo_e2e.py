@@ -138,10 +138,13 @@ def test_grpo_end_to_end(pipeline):
         rollout.set_version(step + 1)
         rollout.resume()
 
-    # version stamping flowed through generation
+    # Version stamping flowed through generation. prepare_batch keeps >=2
+    # batches in flight, so the returned rollout may have been generated up
+    # to max_head_offpolicyness (=2) versions before the current one (6) —
+    # the guaranteed lower bound is 4, not 6.
     batch = rollout.prepare_batch(loader, workflow=workflow)
     out_versions = batch["versions"][batch["versions"] >= 0]
-    assert out_versions.max() >= 5
+    assert out_versions.max() >= 4
 
     # Reward trend over 6 tiny steps is dominated by sampling noise; the
     # deterministic update-direction check lives in test_ppo_actor.py. Here
