@@ -51,6 +51,21 @@ GpuPlatform = Platform(device_type="gpu", communication_backend="nccl")
 _platform: Platform | None = None
 
 
+def honor_jax_platforms_env() -> None:
+    """Re-assert JAX_PLATFORMS over any sitecustomize override.
+
+    Some deployments install a sitecustomize that points jax at an
+    accelerator relay at interpreter start, which silently overrides the
+    JAX_PLATFORMS env var. Entry points that support a CPU smoke mode call
+    this before any jax backend initialises so `JAX_PLATFORMS=cpu` is
+    honored (otherwise the process hangs dialing the tunnel)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
 def current_platform() -> Platform:
     """Detect the platform lazily (importing jax initializes the backend)."""
     global _platform

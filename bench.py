@@ -22,12 +22,84 @@ published boba² runs (BASELINE.md; AReaL does not publish MFU directly,
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 BASELINE_TRAINER_MFU = 0.20
+
+# ---------------------------------------------------------------------------
+# Driver-environment resilience.
+#
+# The accelerator in the driver environment is reached through a remote-compile
+# relay; when that relay hiccups, XLA surfaces transport-class errors
+# (UNAVAILABLE / "Connection refused" / DEADLINE_EXCEEDED) out of otherwise
+# valid programs.  Round-2's bench made a single unguarded attempt and died
+# with rc=1 before emitting any JSON.  Policy now:
+#   1. preflight: a trivial jit compiles first, so relay failures surface in
+#      seconds, not after the 24-layer trainer program is built;
+#   2. transport-class failures are retried with bounded exponential backoff
+#      (the compile cache makes retries cheap);
+#   3. whatever happens, exactly one JSON line is printed and rc is 0 —
+#      on unrecoverable accelerator failure we re-exec ourselves on CPU
+#      (JAX_PLATFORMS=cpu) so the driver still records a parsed line, with
+#      the accelerator error recorded in `detail`.
+# ---------------------------------------------------------------------------
+
+_TRANSPORT_MARKERS = (
+    "UNAVAILABLE",
+    "Connection refused",
+    "Connection Failed",
+    "Connect error",
+    "DEADLINE_EXCEEDED",
+    "transport",
+    "Socket closed",
+    "RESOURCE_EXHAUSTED: Attempting to reserve",
+)
+
+
+def _is_transport_error(e: BaseException) -> bool:
+    msg = f"{type(e).__name__}: {e}"
+    return any(m in msg for m in _TRANSPORT_MARKERS)
+
+
+def _retry_transport(fn, *, what: str, attempts: int = 6, base_delay: float = 5.0,
+                     max_delay: float = 120.0):
+    """Run fn(); retry on transport-class errors with exponential backoff."""
+    last = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — classify, re-raise non-transport
+            if not _is_transport_error(e):
+                raise
+            last = e
+            delay = min(base_delay * (2**i), max_delay)
+            print(
+                f"[bench] transport error in {what} (attempt {i + 1}/{attempts}): "
+                f"{type(e).__name__}: {e}; retrying in {delay:.0f}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(delay)
+    raise last
+
+
+def preflight() -> None:
+    """Compile+run a trivial program so relay failures surface early/cheaply."""
+    import jax
+    import jax.numpy as jnp
+
+    def tiny():
+        x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+        y = jax.jit(lambda a: (a @ a).sum())(x)
+        jax.block_until_ready(y)
+
+    _retry_transport(tiny, what="preflight jit", attempts=8, base_delay=5.0)
 
 
 def bench_train(model, tokens_per_step, seq_len, mb_tokens, warmup, iters):
@@ -140,15 +212,114 @@ def bench_decode(model, n_requests, prompt_len, new_tokens, max_running):
     )
 
 
+def _emit(metric: str, value: float, detail: dict) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 4),
+                "unit": "fraction_of_peak",
+                "vs_baseline": round(value / BASELINE_TRAINER_MFU, 3),
+                "detail": detail,
+            }
+        ),
+        flush=True,
+    )
+
+
+def _run_child(env_extra: dict, timeout: float) -> dict | None:
+    """Run this script as a child bench; return its parsed JSON line."""
+    env = dict(os.environ)
+    env["AREAL_BENCH_CHILD"] = "1"
+    env.update(env_extra)
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"__error__": f"bench child timed out after {timeout:.0f}s"}
+    except Exception as e:  # noqa: BLE001 — orchestrator must not die
+        return {"__error__": f"bench child failed to launch: {e!r}"}
+    sys.stderr.write(out.stderr[-4000:])
+    for ln in reversed(out.stdout.strip().splitlines()):
+        try:
+            return json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+    tail = (out.stderr or out.stdout or "")[-1500:]
+    return {"__error__": f"bench child rc={out.returncode}: {tail}"}
+
+
+def _orchestrate() -> None:
+    """Parent mode: accelerator attempt in a watchdogged subprocess, CPU
+    fallback if it hangs or dies. Exactly one JSON line, rc=0, always —
+    a relay that is down (or hangs jax backend init indefinitely, as
+    observed with the axon remote-compile service) costs the accel timeout,
+    not the whole bench."""
+    accel_timeout = float(os.environ.get("AREAL_BENCH_ACCEL_TIMEOUT", 2700))
+    rec = _run_child({}, accel_timeout)
+    if rec is not None and "__error__" not in rec:
+        print(json.dumps(rec), flush=True)
+        return
+    accel_error = (rec or {}).get("__error__", "unknown")
+    print(f"[bench] accelerator attempt failed: {accel_error}", file=sys.stderr)
+    rec = _run_child({"JAX_PLATFORMS": "cpu"}, 1800)
+    if rec is not None and "__error__" not in rec:
+        rec.setdefault("detail", {})["accelerator_error"] = accel_error[:2000]
+        print(json.dumps(rec), flush=True)
+        return
+    _emit(
+        "trainer_mfu_unavailable",
+        0.0,
+        {
+            "accelerator_error": accel_error[:2000],
+            "cpu_fallback_error": (rec or {}).get("__error__", "")[:1000],
+        },
+    )
+
+
+def _arm_backend_watchdog(seconds: float = 240.0):
+    """Kill the child if jax backend init hangs (relay down ≠ error: calls
+    block forever). Disarmed once devices enumerate."""
+    import threading
+
+    timer = threading.Timer(
+        seconds,
+        lambda: (
+            print(
+                f"[bench] jax backend init hung >{seconds:.0f}s; aborting child",
+                file=sys.stderr,
+                flush=True,
+            ),
+            os._exit(17),
+        ),
+    )
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def main() -> None:
+    from areal_tpu.platforms import honor_jax_platforms_env
+
+    honor_jax_platforms_env()  # the CPU-fallback child sets JAX_PLATFORMS=cpu
+
+    watchdog = _arm_backend_watchdog()
+
     import jax
 
     from areal_tpu.models.qwen2 import ModelConfig
 
     dev = jax.devices()[0]
+    watchdog.cancel()
     on_accel = dev.platform != "cpu"
 
     if on_accel:
+        preflight()
         model = ModelConfig(
             vocab_size=151936,
             hidden_size=896,
@@ -165,17 +336,27 @@ def main() -> None:
         # mb of 4096 tokens: the f32 [T, vocab] logits + their grad dominate
         # HBM (151936-wide vocab → ~2.5 GiB per 4k tokens); 16 grad-accum
         # micro-batches make up the 64k-token step.
-        train = bench_train(
-            model,
-            tokens_per_step=65536,
-            seq_len=1024,
-            mb_tokens=4096,
-            warmup=2,
-            iters=5,
+        train = _retry_transport(
+            lambda: bench_train(
+                model,
+                tokens_per_step=65536,
+                seq_len=1024,
+                mb_tokens=4096,
+                warmup=2,
+                iters=5,
+            ),
+            what="bench_train",
+            attempts=4,
+            base_delay=15.0,
         )
-        decode = bench_decode(
-            model, n_requests=128, prompt_len=128, new_tokens=256,
-            max_running=64,
+        decode = _retry_transport(
+            lambda: bench_decode(
+                model, n_requests=128, prompt_len=128, new_tokens=256,
+                max_running=64,
+            ),
+            what="bench_decode",
+            attempts=3,
+            base_delay=15.0,
         )
         metric = "trainer_mfu_qwen2.5-0.5b_bf16_packed_sft"
     else:  # CPU smoke fallback so the harness always emits a line
@@ -204,18 +385,13 @@ def main() -> None:
         **{k: round(v, 1) if isinstance(v, float) else v for k, v in decode.items()},
     }
     detail["step_time_s"] = round(train["step_time_s"], 3)
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(train["mfu"], 4),
-                "unit": "fraction_of_peak",
-                "vs_baseline": round(train["mfu"] / BASELINE_TRAINER_MFU, 3),
-                "detail": detail,
-            }
-        )
-    )
+    _emit(metric, train["mfu"], detail)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("AREAL_BENCH_CHILD"):
+        # child mode: one measurement attempt; the parent handles fallback
+        main()
+    else:
+        _orchestrate()
+        sys.exit(0)
