@@ -371,6 +371,11 @@ class AllocationMode:
 
     @classmethod
     def from_str(cls, allocation_mode: str) -> "AllocationMode":
+        if not (allocation_mode or "").strip():
+            # Empty mode = colocated single-program default: train strategy
+            # is decided by the engine (dp over all local devices), decode
+            # runs in-process on the same chips.
+            return cls(type_=AllocationType.COLOCATE, train=None)
         try:
             tree = _parser.parse(allocation_mode)
             node = _transformer.transform(tree)

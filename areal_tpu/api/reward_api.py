@@ -29,6 +29,24 @@ def _pool() -> ThreadPoolExecutor:
     return _DEFAULT_POOL
 
 
+# Positional parameters of the reward-fn contract
+# (prompt, completion, prompt_ids, completion_ids). Dataset items carrying
+# same-named keys (a "prompt" text field is common) must be filtered from the
+# **kwargs or the call raises TypeError("got multiple values") — which the
+# wrapper's failure path would silently turn into 0 reward.
+REWARD_POSITIONAL = (
+    "prompt",
+    "completion",
+    "completions",
+    "prompt_ids",
+    "completion_ids",
+)
+
+
+def reward_kwargs(data: dict) -> dict:
+    return {k: v for k, v in data.items() if k not in REWARD_POSITIONAL}
+
+
 class AsyncRewardWrapper:
     """Wrap a sync reward fn into an async callable with timeout.
 

@@ -137,6 +137,33 @@ class SimpleDataLoader:
         self.seed = state["seed"]
 
 
+@register_dataset("synthetic-arith")
+def _synthetic_arith(
+    path: str, split: str, type: str, tokenizer=None, max_length=None, **kw
+):
+    """Offline verifiable-math dataset (no hub download): integer arithmetic
+    in the RLVR schema with pre-tokenized prompts. See dataset/arith.py."""
+    from areal_tpu.dataset.arith import ArithTokenizer, make_arith_dataset
+
+    items = make_arith_dataset(
+        n_items=kw.get("n_items", 4096),
+        max_operand=kw.get("max_operand", 99),
+        seed=kw.get("seed", 0),
+        split=split,
+    )
+    if type == "sft":
+        tok = ArithTokenizer()
+        for x in items:
+            ids = tok.encode(x["prompt"] + x["answer"]) + [tok.eos_token_id]
+            n_prompt = len(x["input_ids"])
+            x["input_ids"] = ids[:max_length] if max_length else ids
+            # supervise only the answer tokens; mask length must track a
+            # possibly-truncated input_ids
+            n = len(x["input_ids"])
+            x["loss_mask"] = ([0] * n_prompt + [1] * max(0, n - n_prompt))[:n]
+    return items
+
+
 @register_dataset("hh-rlhf")
 def _hh_rlhf(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
     """Anthropic HH-RLHF pairwise preferences for reward-model training

@@ -28,11 +28,17 @@ class StatsLogger:
         self._init_backends()
 
     def _log_dir(self) -> str:
+        return self.get_log_path(self.config)
+
+    @staticmethod
+    def get_log_path(config: StatsLoggerConfig) -> str:
+        """Run log directory (parity: StatsLogger.get_log_path,
+        areal/utils/stats_logger.py)."""
         return os.path.join(
-            self.config.fileroot or "/tmp/areal_tpu",
+            config.fileroot or "/tmp/areal_tpu",
             "logs",
-            self.config.experiment_name,
-            self.config.trial_name,
+            config.experiment_name,
+            config.trial_name,
         )
 
     def _init_backends(self):
@@ -81,7 +87,19 @@ class StatsLogger:
     def commit(
         self, epoch: int, step: int, global_step: int, data: dict[str, Any]
     ) -> None:
-        """Log one training step's stats to all backends + console."""
+        """Log one training step's stats to all backends + console. `data`
+        may be one dict or a list of per-minibatch dicts (reference shape);
+        keys appearing in several minibatch dicts log their MEAN across the
+        step — last-write-wins would misreport e.g. `loss` as the final
+        minibatch's value."""
+        if isinstance(data, (list, tuple)):
+            sums: dict[str, float] = {}
+            counts: dict[str, int] = {}
+            for d in data:
+                for k, v in d.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+                    counts[k] = counts.get(k, 0) + 1
+            data = {k: sums[k] / counts[k] for k in sums}
         flat = {k: float(v) for k, v in data.items()}
         lines = [
             f"Epoch {epoch} step {step} (global step {global_step}):",

@@ -81,8 +81,14 @@ class MultiTurnWorkflow(RolloutWorkflow):
             versions += resp.output_versions
 
             completion_str = self.tokenizer.decode(resp.output_tokens)
+            from areal_tpu.workflow.rlvr import _reward_kwargs
+
             reward = await self.reward_fn(
-                None, completion_str, resp.input_tokens, resp.output_tokens, **data
+                None,
+                completion_str,
+                resp.input_tokens,
+                resp.output_tokens,
+                **_reward_kwargs(data),
             )
             if reward > 0 or turn == self.max_turns - 1:
                 break

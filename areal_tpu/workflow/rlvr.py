@@ -25,6 +25,8 @@ from areal_tpu.utils.data import pad_sequences_to_tensors
 
 logger = logging.getLogger("rlvr")
 
+from areal_tpu.api.reward_api import reward_kwargs as _reward_kwargs  # noqa: E402
+
 
 class RLVRWorkflow(RolloutWorkflow):
     def __init__(
@@ -93,7 +95,7 @@ class RLVRWorkflow(RolloutWorkflow):
                 completion_str,
                 resp.input_tokens,
                 resp.output_tokens,
-                **data,
+                **_reward_kwargs(data),
             )
             results.append(
                 dict(

@@ -126,3 +126,28 @@ def test_image_data_rides_generate_payload():
     assert "image_data" not in JaxDecodeBackend().build_generate_payload(
         ModelRequest(input_ids=[1])
     )
+
+
+def test_rlvr_reward_fn_survives_prompt_key_in_data():
+    """Dataset items carrying a 'prompt' text field (gsm8k, synthetic-arith)
+    must not shadow the reward fn's positional args — regression for the
+    TypeError('got multiple values') that silently zeroed every reward."""
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    def reward_fn(prompt, completion, prompt_ids, completion_ids, **kw):
+        assert kw.get("answer") == "4"
+        return 1.0
+
+    eng = ScriptedEngine([[42]])
+    wf = RLVRWorkflow(
+        reward_fn,
+        GenerationHyperparameters(n_samples=1, max_new_tokens=4),
+        FakeTokenizer(),
+    )
+    batch = asyncio.run(
+        wf.arun_episode(
+            eng,
+            {"input_ids": [1, 2], "prompt": "2+2=", "answer": "4"},
+        )
+    )
+    assert float(np.asarray(batch["rewards"])[0]) == 1.0
