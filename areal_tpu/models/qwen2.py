@@ -644,6 +644,105 @@ def forward(
     return out
 
 
+def forward_pipelined(
+    params: dict,
+    input_ids: jax.Array,
+    position_ids: jax.Array,
+    segment_ids: jax.Array,
+    cfg: ModelConfig,
+    mesh,
+    per_mb_fn,
+    mb_data: dict | None = None,
+    *,
+    with_aux: bool = False,
+):
+    """Pipelined packed forward over M stacked microbatches.
+
+    The pp>1 counterpart of `forward` (parity: the reference's pipelined
+    train/generation schedules, realhf .../static_schedule.py:159): the
+    decoder trunk runs through parallel/pipeline.py's GPipe shard_map with
+    the scanned layer stack sharded over the "pp" mesh axis; embedding runs
+    vectorized over all microbatches up front, and the lm_head + caller's
+    `per_mb_fn(logits_f32 [T, V], mb_slice)` run in a scan over
+    microbatches afterward so only one [T, V] logits buffer is ever live.
+
+    Args: input_ids/position_ids/segment_ids are [M, T]; `mb_data` is a
+    pytree of [M, ...] arrays whose m-th slice is handed to per_mb_fn.
+    Returns stacked per-mb outputs (and the summed MoE aux loss when
+    `with_aux`).
+    """
+    from areal_tpu.parallel import mesh as mesh_lib
+    from areal_tpu.parallel.pipeline import pipeline_trunk
+
+    compute_dtype = jnp.dtype(cfg.dtype)
+    assert cfg.scan_layers, "pipeline parallelism requires scan_layers=True"
+
+    table = _cstr(params["embed"]["embedding"], "vocab", None)
+    x = table[input_ids].astype(compute_dtype)  # [M, T, H]
+
+    layer_fn = decoder_layer
+    if cfg.remat:
+        layer_fn = jax.checkpoint(decoder_layer, static_argnums=(6,))
+
+    def stage_fn(layers_local, h, aux_t):
+        pos, seg = aux_t
+        cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta)
+
+        def body(carry, layer_p):
+            h, aux_sum = carry
+            h, aux = layer_fn(layer_p, h, cos, sin, seg, None, cfg)
+            return (h, aux_sum + aux), None
+
+        (h, aux_sum), _ = jax.lax.scan(
+            body, (h, jnp.float32(0.0)), layers_local
+        )
+        return h, aux_sum
+
+    # Trace the stage body WITHOUT the ambient mesh: (a) activation-layout
+    # constraints (`_cstr`) would name auto axes through a NamedSharding
+    # bound to the full mesh, which partial-manual shard_map rejects;
+    # (b) attention must not resolve to ring (its own shard_map does not
+    # nest inside the pp-manual region) — with no mesh it resolves to
+    # flash/dense, both GSPMD-partitionable along the auto axes.
+    prev_mesh = mesh_lib.current_mesh()
+    mesh_lib.set_current_mesh(None)
+    try:
+        ys, aux_total = pipeline_trunk(
+            mesh,
+            stage_fn,
+            params["layers"],
+            x,
+            (position_ids, segment_ids),
+        )
+    finally:
+        mesh_lib.set_current_mesh(prev_mesh)
+
+    def head_of(y):
+        h = rms_norm(y, params["final_norm"], cfg.rms_norm_eps)
+        if cfg.is_critic:
+            values = (
+                jnp.einsum("th,hk->tk", h, params["value_head"]["kernel"])
+                + params["value_head"]["bias"]
+            )
+            return values[:, 0].astype(jnp.float32)
+        if cfg.tie_word_embeddings:
+            return jnp.einsum(
+                "th,vh->tv", h, params["embed"]["embedding"].astype(compute_dtype)
+            ).astype(jnp.float32)
+        return jnp.einsum(
+            "th,hv->tv", h, params["lm_head"]["kernel"]
+        ).astype(jnp.float32)
+
+    def head_scan(_, inp):
+        y, mb_m = inp
+        return None, per_mb_fn(head_of(y), mb_m)
+
+    _, outs = jax.lax.scan(head_scan, None, (ys, mb_data))
+    if with_aux:
+        return outs, aux_total
+    return outs
+
+
 def segment_ids_from_cu_seqlens(cu_seqlens: np.ndarray, total: int) -> np.ndarray:
     """Host helper: cu_seqlens → per-token segment ids ([0..n-1]); the fake
     pad segment appended by pad_packed_tensor_dict keeps its own id, callers

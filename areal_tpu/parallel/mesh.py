@@ -103,7 +103,11 @@ def strategy_from_mesh(mesh: Mesh) -> ParallelStrategy:
 LogicalRules = tuple[tuple[str, str | tuple[str, ...] | None], ...]
 
 # fsdp=True: shard params' largest logical dims over the dp axis (ZeRO-3).
-def default_rules(fsdp: bool = True) -> LogicalRules:
+# pp=True: shard the scanned layer stack over the "pp" axis — each pipeline
+# stage holds L/pp layers; the engine routes compute through
+# parallel/pipeline.py's GPipe shard_map (forward_pipelined) so stages
+# execute their own layers instead of gathering the full stack.
+def default_rules(fsdp: bool = True, pp: bool = False) -> LogicalRules:
     fsdp_axis = AXIS_DP if fsdp else None
     return (
         # activations
@@ -123,7 +127,7 @@ def default_rules(fsdp: bool = True) -> LogicalRules:
         ("head_dim", None),
         ("mlp", AXIS_TP),
         ("experts", AXIS_DP),  # EP folds over dp ranks
-        ("layers", None),  # sharded over "pp" only in pipeline mode
+        ("layers", AXIS_PP if pp else None),
         ("norm", None),
     )
 

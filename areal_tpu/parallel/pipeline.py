@@ -1,0 +1,123 @@
+"""GPipe-style SPMD pipeline parallelism over the "pp" mesh axis.
+
+Parity target: the reference's native pipeline engine —
+realhf/impl/model/parallelism/pipeline_parallel/static_schedule.py:159
+(instruction schedules) + pipe_runner.py:778 (executors) and Megatron's
+forward_backward_func (areal/engine/megatron_engine.py:846). The TPU
+re-design replaces instruction lists + p2p send/recv with a single jitted
+program: a `jax.shard_map` manual over the "pp" axis (auto over dp/sp/tp,
+so GSPMD still handles FSDP/TP/SP inside each stage) where
+
+- the stacked layer parameters [L, ...] are sharded over pp on dim 0, so
+  each stage holds L/pp layers (the memory scaling PP exists for),
+- M microbatches stream through the stages: at step t, stage s runs
+  microbatch (t - s); activations hop stage→stage with one
+  `lax.ppermute` per step (the ICI analogue of Megatron's p2p),
+- the loop runs M + pp - 1 steps (fill + drain), outputs are collected on
+  the last stage and replicated with one masked psum.
+
+Autodiff runs straight through (ppermute transposes to the reverse
+permutation), which yields the backward pipeline automatically — no 1F1B
+instruction table. XLA overlaps the ppermute with the next step's compute
+where the schedule allows.
+
+Attention inside a stage must not itself shard tokens over (dp, sp) with a
+kernel that can't be partitioned (ring attention's own shard_map does not
+nest inside the pp-manual region); the model resolves attention to a
+pp-compatible impl while tracing the stage body (see forward_pipelined).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from areal_tpu.parallel import mesh as mesh_lib
+
+
+def pipeline_trunk(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array, Any], tuple[jax.Array, jax.Array]],
+    layers: Any,
+    xs: jax.Array,
+    aux_inputs: Any,
+) -> tuple[jax.Array, jax.Array]:
+    """Run `stage_fn` over pp stages for M microbatches.
+
+    Args:
+      mesh: the engine mesh; must contain a "pp" axis of size >= 2.
+      stage_fn: (layers_local, x, aux) -> (y, scalar_aux_loss); sees the
+        stage-local [L/pp, ...] layer stack and one microbatch activation.
+      layers: stacked [L, ...] pytree (sharded over pp on dim 0 by the
+        engine's param shardings).
+      xs: [M, T, H] stacked microbatch activations.
+      aux_inputs: pytree of [M, ...] per-microbatch side inputs (positions,
+        segment ids, ...) indexed — not circulated — per step.
+
+    Returns (ys [M, T, H], total_aux_loss), both replicated over pp.
+    """
+    pp = mesh.shape[mesh_lib.AXIS_PP]
+    M = xs.shape[0]
+    steps = M + pp - 1
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def staged(layers_local, xs, aux_inputs):
+        stage = jax.lax.axis_index(mesh_lib.AXIS_PP)
+
+        def step(carry, t):
+            state, outbuf, aux_sum = carry
+            # stage s works on microbatch m = t - s (valid when 0 <= m < M)
+            m = jnp.clip(t - stage, 0, M - 1)
+            fresh = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            x_in = jnp.where(stage == 0, fresh, state)
+            aux_t = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+                aux_inputs,
+            )
+            y, aux = stage_fn(layers_local, x_in, aux_t)
+            valid = (t - stage >= 0) & (t - stage < M)
+            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+            out_m = jnp.clip(t - (pp - 1), 0, M - 1)
+            is_out = (stage == pp - 1) & (t >= pp - 1)
+            prev_row = jax.lax.dynamic_index_in_dim(
+                outbuf, out_m, 0, keepdims=False
+            )
+            outbuf = jax.lax.dynamic_update_index_in_dim(
+                outbuf,
+                jnp.where(is_out, y, prev_row).astype(outbuf.dtype),
+                out_m,
+                0,
+            )
+            state = jax.lax.ppermute(y, mesh_lib.AXIS_PP, perm)
+            return (state, outbuf, aux_sum), None
+
+        init = (
+            jnp.zeros_like(xs[0]),
+            jnp.zeros_like(xs),
+            jnp.float32(0.0),
+        )
+        (_, outbuf, aux_sum), _ = jax.lax.scan(
+            step, init, jnp.arange(steps)
+        )
+        # Only the last stage's buffer holds real outputs; a masked psum
+        # replicates it across pp (one collective per step, not per token).
+        outbuf = jax.lax.psum(
+            jnp.where(stage == pp - 1, outbuf, jnp.zeros_like(outbuf)),
+            mesh_lib.AXIS_PP,
+        )
+        aux_sum = jax.lax.psum(aux_sum, mesh_lib.AXIS_PP)
+        return outbuf, aux_sum
+
+    return jax.shard_map(
+        staged,
+        mesh=mesh,
+        in_specs=(P(mesh_lib.AXIS_PP), P(), P()),
+        out_specs=(P(), P()),
+        axis_names=frozenset({mesh_lib.AXIS_PP}),
+        check_vma=False,
+    )(layers, xs, aux_inputs)

@@ -94,7 +94,9 @@ class RecoverHandler:
         os.makedirs(ckpt, exist_ok=True)
         engine.save(
             SaveLoadMeta(
-                path=ckpt, weight_format="hf", with_optim=True, tokenizer=tokenizer
+                # orbax: sharded save of params+optimizer, no host gather
+                path=ckpt, weight_format="orbax", with_optim=True,
+                tokenizer=tokenizer
             )
         )
         info = RecoverInfo(
@@ -138,7 +140,7 @@ class RecoverHandler:
         engine.load(
             SaveLoadMeta(
                 path=os.path.join(root, "checkpoint"),
-                weight_format="hf",
+                weight_format="orbax",
                 with_optim=True,
             )
         )

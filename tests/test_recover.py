@@ -169,3 +169,29 @@ def test_recover_roundtrip(tmp_path, cpu_devices):
 
     discard_recover_state(rcfg)
     assert not check_if_auto_recover(rcfg)
+
+
+def test_orbax_sharded_checkpoint_preserves_shardings(tmp_path, cpu_devices):
+    """The recover format is orbax: each restored leaf comes back already
+    laid out on the engine's NamedShardings (no host-gathered pickle)."""
+    from areal_tpu.api.io_struct import SaveLoadMeta
+
+    eng = _make_engine(cpu_devices)
+    eng.train_lm(_batch(0))
+    eng.set_version(5)
+    path = str(tmp_path / "orbax_ckpt")
+    eng.save(SaveLoadMeta(path=path, weight_format="orbax", with_optim=True))
+    assert os.path.isdir(os.path.join(path, "orbax_state"))
+
+    eng2 = _make_engine(cpu_devices)
+    eng2.load(SaveLoadMeta(path=path, weight_format="orbax", with_optim=True))
+    assert eng2.get_version() == 5
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(eng.params),
+        jax.tree_util.tree_leaves_with_path(eng2.params),
+    ):
+        assert pa == pb
+        assert a.sharding == b.sharding, f"sharding lost for {pa}"
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    eng.destroy()
+    eng2.destroy()
