@@ -133,6 +133,10 @@ class JaxDecodeEngine(InferenceEngine):
         self._chunk_fns: dict[bool, Callable] = {}
         self._prefill_fns: dict[int, Callable] = {}
         self._write_fns: dict[int, Callable] = {}
+        # GQA-under-tp: kv heads repeated _kv_repeat times at install
+        # (_maybe_repeat_kv_heads); original config kept for HF reloads.
+        self._kv_repeat = 1
+        self._orig_model_config: ModelConfig | None = None
 
     # -- lifecycle ------------------------------------------------------
     def set_model(self, params, model_config: ModelConfig) -> None:
@@ -161,6 +165,7 @@ class JaxDecodeEngine(InferenceEngine):
             )
             host = hf_io.load_hf_params(self.config.model_path, self.model_config)
             self.params = jax.tree.map(jnp.asarray, host)
+        self._maybe_repeat_kv_heads()
         cfg = self.model_config
         self._build_mesh()
         if self._param_shardings is not None:
@@ -210,6 +215,80 @@ class JaxDecodeEngine(InferenceEngine):
         self._k_cache = self._v_cache = None
 
     # -- jitted programs -----------------------------------------------
+    def _maybe_repeat_kv_heads(self):
+        """GQA under tensor parallelism: replicate KV heads up to tp.
+
+        When tp > num_key_value_heads (Qwen2.5-0.5B has nKV=2, 7B has 4),
+        the naive layout replicates the k/v projections AND the whole KV
+        cache on every chip — at exactly the scale where HBM is tightest
+        (round-2 verdict weakness #4). Instead, repeat each kv head
+        tp/nKV times (the vLLM/SGLang treatment): the cache becomes
+        [L, R, S, tp, hd] sharded tp-ways, so per-chip KV memory drops by
+        nKV× vs replication. Correct because the model's GQA mapping
+        (q head h -> kv head h // (nH/nKV)) composes exactly with
+        repeat-interleave when tp % nKV == 0 and nH % tp == 0.
+        """
+        tp = max(int(self.config.tensor_parallel_size), 1)
+        cfg = self.model_config
+        nKV, nH = cfg.num_key_value_heads, cfg.num_attention_heads
+        if tp <= 1 or nKV % tp == 0:
+            return
+        if tp % nKV != 0 or nH % tp != 0:
+            return  # fall back to replicated k/v (handled in _build_mesh)
+        self._kv_repeat = tp // nKV
+        self._orig_model_config = cfg
+        self.params = self._repeat_kv_tree(self.params)
+        self.model_config = dataclasses.replace(cfg, num_key_value_heads=tp)
+        logger.info(
+            f"GQA kv heads repeated {nKV} -> {tp} to shard the KV cache "
+            f"over tp={tp} (per-chip cache memory /{nKV})"
+        )
+
+    def _repeat_kv_tree(self, params: dict) -> dict:
+        """Apply the kv-head repeat to a FULL (unrepeated) param tree.
+
+        Every weight-ingest path must route incoming trainer/HF weights
+        through this, because the live config advertises the repeated nKV."""
+        r = self._kv_repeat
+        if r <= 1:
+            return params
+
+        def fix_attn(attn: dict) -> dict:
+            out = dict(attn)
+            for key in ("k_kernel", "v_kernel", "k_bias", "v_bias"):
+                if key in out:
+                    # kv-head dim is axis -2 in every layout (scan or not)
+                    out[key] = jnp.repeat(jnp.asarray(out[key]), r, axis=-2)
+            return out
+
+        params = dict(params)
+        if "layers" in params:
+            params["layers"] = {
+                **params["layers"],
+                "attn": fix_attn(params["layers"]["attn"]),
+            }
+        else:
+            for name in list(params):
+                if name.startswith("layers_"):
+                    params[name] = {
+                        **params[name],
+                        "attn": fix_attn(params[name]["attn"]),
+                    }
+        return params
+
+    def _repeat_kv_named(self, named: dict) -> dict:
+        """Same transform for the wire format: flat {path: array} dicts."""
+        r = self._kv_repeat
+        if r <= 1:
+            return named
+        out = {}
+        for path, arr in named.items():
+            leaf = path.rsplit("/", 1)[-1]
+            if leaf in ("k_kernel", "v_kernel", "k_bias", "v_bias"):
+                arr = np.repeat(np.asarray(arr), r, axis=-2)
+            out[path] = arr
+        return out
+
     def _build_mesh(self):
         """Decode mesh: [1, 1, 1, tp] over the first tp local devices.
 
@@ -410,7 +489,18 @@ class JaxDecodeEngine(InferenceEngine):
             return None
 
     def _admit(self) -> bool:
+        """Admit queued requests into free slots, prefilling their prompts.
+
+        Prefill work per scheduler pass is capped at
+        `config.max_prefill_tokens` (the chunked-prefill budget policy of
+        SGLang-grade continuous batching): a burst of long-prompt
+        admissions must not stall running slots for more than one budget's
+        worth of prefill before the next decode chunk runs. Requests over
+        budget stay queued, order preserved, and admit on later passes.
+        """
         admitted = False
+        prefill_budget = max(int(self.config.max_prefill_tokens), _PREFILL_BUCKET)
+        did_prefill = False
         while True:
             item = self._next_request()
             if item is None:
@@ -420,6 +510,17 @@ class JaxDecodeEngine(InferenceEngine):
             if P + item.gconfig.max_new_tokens > self.config.context_length:
                 self._complete(item, stop_reason="length")
                 continue
+            # bucket may not exceed the KV cache's sequence capacity —
+            # writing a [bucket]-row update into a shorter cache is malformed
+            needs_prefill_bucket = (
+                min(_next_bucket(P - 1), self.config.context_length)
+                if P > 1
+                else 0
+            )
+            if did_prefill and needs_prefill_bucket > prefill_budget:
+                # budget exhausted for this pass; run the decode chunk first
+                self._overflow.insert(0, item)
+                break
             # Resume check comes FIRST: after a flush-and-resume cycle every
             # slot may be parked, and evicting before matching would destroy
             # the very cache this request came back for.
@@ -439,7 +540,9 @@ class JaxDecodeEngine(InferenceEngine):
                 slot_idx = resumed
             if resumed is None and P > 1:
                 pre = P - 1
-                bucket = _next_bucket(min(pre, self.config.context_length))
+                bucket = min(_next_bucket(pre), self.config.context_length)
+                prefill_budget -= bucket
+                did_prefill = True
                 ids = np.zeros(bucket, dtype=np.int32)
                 ids[:pre] = prompt[:-1]
                 positions = np.arange(bucket, dtype=np.int32)
@@ -768,7 +871,9 @@ class JaxDecodeEngine(InferenceEngine):
             with self._weight_lock:
                 # copy — the trainer will donate these buffers next step;
                 # device_put also reshards from the trainer's (fsdp/tp)
-                # layout onto the decode mesh's layout.
+                # layout onto the decode mesh's layout. Trainer weights are
+                # UNREPEATED — re-apply the GQA kv-head repeat first.
+                params = self._repeat_kv_tree(params)
                 if self._param_shardings is not None:
                     self.params = jax.tree.map(
                         lambda x, s: jax.device_put(jnp.asarray(x), s),
@@ -786,6 +891,13 @@ class JaxDecodeEngine(InferenceEngine):
                         dtype=self.config.dtype,
                         param_dtype=self.config.dtype,
                     )
+                    if self._kv_repeat > 1:
+                        self._orig_model_config = decode_cfg
+                        decode_cfg = dataclasses.replace(
+                            decode_cfg,
+                            num_key_value_heads=decode_cfg.num_key_value_heads
+                            * self._kv_repeat,
+                        )
                     if self.model_config is not None and decode_cfg != self.model_config:
                         # cache shapes depend only on L/nKV/hd which cannot
                         # change for the same run
@@ -817,7 +929,10 @@ class JaxDecodeEngine(InferenceEngine):
                         arr = jax.device_put(arr, old.sharding)
                     return arr
 
-                self.params = set_named(self.params, named, cast=cast)
+                # wire tensors carry the trainer's (unrepeated) kv heads
+                self.params = set_named(
+                    self.params, self._repeat_kv_named(named), cast=cast
+                )
                 self._invalidate_parked()
                 if version is not None:
                     self._version = int(version)
@@ -835,7 +950,11 @@ class JaxDecodeEngine(InferenceEngine):
         self.pause_generation()
         try:
             with self._weight_lock:
-                host = hf_io.load_hf_params(meta.path, self.model_config)
+                # HF checkpoints carry the original (unrepeated) kv heads.
+                load_cfg = self._orig_model_config or self.model_config
+                host = self._repeat_kv_tree(
+                    hf_io.load_hf_params(meta.path, load_cfg)
+                )
                 if self._param_shardings is not None:
                     self.params = jax.tree.map(
                         lambda x, s: jax.device_put(jnp.asarray(x), s),
