@@ -115,6 +115,8 @@ class RemoteInfEngine(InferenceEngine):
         self.backend = backend or JaxDecodeBackend()
         self.tokenizer = tokenizer
         self.addresses: list[str] = []
+        self._router: str | None = None  # cached names.rollout_router lookup
+        self._router_next_lookup = 0.0  # negative-lookup cooldown clock
         self._server_idx = 0
         self._rid_to_addr: dict[str, str] = {}
         self._rid_lock = threading.Lock()
@@ -174,6 +176,62 @@ class RemoteInfEngine(InferenceEngine):
             self._executor = None
 
     # -- scheduling -----------------------------------------------------
+    def _router_addr(self) -> str | None:
+        """Fleet router address, if one registered (names.rollout_router).
+
+        With a router, per-request server choice is delegated to its
+        least-load scheduling + qid affinity (parity: GserverManager
+        /schedule_request, realhf/system/gserver_manager.py:352); without
+        one, the client falls back to local round-robin + rid affinity.
+        """
+        # positive lookups cache forever; negative ones re-check after a
+        # cooldown so a router that registers AFTER the first request still
+        # gets picked up (it is launched independently of the trainers)
+        if self._router:
+            return self._router
+        now = time.monotonic()
+        if now < self._router_next_lookup:
+            return None
+        self._router_next_lookup = now + 30.0
+        addr = ""
+        if self.config.experiment_name and self.config.trial_name:
+            try:
+                addr = name_resolve.get(
+                    names.rollout_router(
+                        self.config.experiment_name, self.config.trial_name
+                    )
+                )
+            except Exception:  # noqa: BLE001 — router is optional
+                addr = ""
+        self._router = addr
+        return addr or None
+
+    async def _schedule_via_router(self, req: ModelRequest) -> str | None:
+        router = self._router_addr()
+        if router is None:
+            return None
+        try:
+            out = await arequest_with_retry(
+                router,
+                "/schedule_request",
+                payload=dict(
+                    qid=req.rid,
+                    prompt_len=len(req.input_ids),
+                    group_size=req.gconfig.n_samples,
+                    new_token_budget=req.gconfig.max_new_tokens,
+                ),
+                max_retries=2,
+                timeout=30,
+            )
+            return out["url"]
+        except Exception as e:  # noqa: BLE001 — degrade to local policy
+            logger.warning(f"router schedule failed ({e!r}); using local policy")
+            # invalidate the cached address: a restarted router registers
+            # under a new port, the cooldown re-lookup will find it
+            self._router = ""
+            self._router_next_lookup = time.monotonic() + 30.0
+            return None
+
     def choose_server(self, rid: str | None = None) -> str:
         if rid is not None:
             with self._rid_lock:
@@ -195,45 +253,61 @@ class RemoteInfEngine(InferenceEngine):
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         """Generate with the interrupt-resume loop (reference :428-478)."""
         start = time.monotonic()
-        addr = self.choose_server(req.rid)
+        routed = await self._schedule_via_router(req)
+        addr = routed or self.choose_server(req.rid)
         prompt = list(req.input_ids)
         acc_tokens: list[int] = []
         acc_logprobs: list[float] = []
         acc_versions: list[int] = []
         stop_reason = "interrupt"
         ttft = float("inf")
-        while stop_reason == "interrupt":
-            work = req.copy()
-            work.input_ids = prompt + acc_tokens
-            work.gconfig = req.gconfig.new(
-                max_new_tokens=req.gconfig.max_new_tokens - len(acc_tokens),
-                min_new_tokens=max(
-                    0, req.gconfig.min_new_tokens - len(acc_tokens)
-                ),
-            )
-            data = await arequest_with_retry(
-                addr,
-                "/generate",
-                payload=self.backend.build_generate_payload(work),
-                max_retries=self.config.request_retries,
-                timeout=self.config.request_timeout,
-            )
-            out = self.backend.parse_generate_response(data)
-            acc_tokens.extend(out["output_tokens"])
-            acc_logprobs.extend(out["output_logprobs"])
-            versions = out["output_versions"] or [self._version] * len(
-                out["output_tokens"]
-            )
-            acc_versions.extend(versions)
-            if ttft == float("inf") and out["output_tokens"]:
-                ttft = time.monotonic() - start
-            stop_reason = out["stop_reason"]
-            if stop_reason == "interrupt" and not out["output_tokens"]:
-                # server flushed before producing anything; brief backoff so
-                # the weight swap can finish
-                await asyncio.sleep(ROLLOUT_POLL_WAIT_TIME)
-        with self._rid_lock:
-            self._rid_to_addr.pop(req.rid, None)
+        try:
+            while stop_reason == "interrupt":
+                work = req.copy()
+                work.input_ids = prompt + acc_tokens
+                work.gconfig = req.gconfig.new(
+                    max_new_tokens=req.gconfig.max_new_tokens - len(acc_tokens),
+                    min_new_tokens=max(
+                        0, req.gconfig.min_new_tokens - len(acc_tokens)
+                    ),
+                )
+                data = await arequest_with_retry(
+                    addr,
+                    "/generate",
+                    payload=self.backend.build_generate_payload(work),
+                    max_retries=self.config.request_retries,
+                    timeout=self.config.request_timeout,
+                )
+                out = self.backend.parse_generate_response(data)
+                acc_tokens.extend(out["output_tokens"])
+                acc_logprobs.extend(out["output_logprobs"])
+                versions = out["output_versions"] or [self._version] * len(
+                    out["output_tokens"]
+                )
+                acc_versions.extend(versions)
+                if ttft == float("inf") and out["output_tokens"]:
+                    ttft = time.monotonic() - start
+                stop_reason = out["stop_reason"]
+                if stop_reason == "interrupt" and not out["output_tokens"]:
+                    # server flushed before producing anything; brief backoff
+                    # so the weight swap can finish
+                    await asyncio.sleep(ROLLOUT_POLL_WAIT_TIME)
+        finally:
+            # release bookkeeping even when generation fails — a leaked
+            # router qid biases least-load scheduling forever
+            with self._rid_lock:
+                self._rid_to_addr.pop(req.rid, None)
+            if routed is not None:
+                try:
+                    await arequest_with_retry(
+                        self._router,
+                        "/finish_request",
+                        payload=dict(qid=req.rid),
+                        max_retries=1,
+                        timeout=10,
+                    )
+                except Exception:  # noqa: BLE001 — accounting is best-effort
+                    pass
         return ModelResponse(
             input_tokens=prompt,
             output_tokens=acc_tokens,

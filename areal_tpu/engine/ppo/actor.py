@@ -247,7 +247,27 @@ class PPOActor:
             stats_tracker.scalar(**train_stat)
             all_stats.append(stats_tracker.export_all())
         all_stats[0].update(global_stats)
+        self._publish_training_samples(len(reward_score))
         return all_stats
+
+    def _publish_training_samples(self, n_seqs: int) -> None:
+        """Publish the global consumed-sample counter that the fleet
+        router's server-side staleness gate reads (parity: the trainer
+        counter behind GserverManager.is_staled, gserver_manager.py:334)."""
+        cfg = self.engine.config
+        if not (cfg.experiment_name and cfg.trial_name):
+            return
+        self._samples_consumed = getattr(self, "_samples_consumed", 0) + n_seqs
+        try:
+            from areal_tpu.utils import name_resolve, names
+
+            name_resolve.add(
+                names.training_samples(cfg.experiment_name, cfg.trial_name),
+                str(self._samples_consumed),
+                replace=True,
+            )
+        except Exception:  # noqa: BLE001 — metrics publishing is best-effort
+            pass
 
 
 def _split_minibatches(

@@ -34,6 +34,7 @@ class EvalResult:
     pass_at_1: float
     pass_at_k: dict[int, float]
     mean_output_len: float
+    maj_at_n: float = 0.0
 
     def to_dict(self) -> dict[str, Any]:
         d = dataclasses.asdict(self)
@@ -62,6 +63,7 @@ def evaluate_offline(
     ks: tuple[int, ...] = (1, 4, 8),
     max_concurrency: int = 64,
     reward_timeout_seconds: float = 60.0,
+    dump_path: str | None = None,
 ) -> EvalResult:
     """Run the benchmark: for each item, sample `n_samples` completions and
     score each; aggregate."""
@@ -92,10 +94,16 @@ def evaluate_offline(
         completion = (
             tokenizer.decode(resp.output_tokens) if tokenizer is not None else None
         )
+        from areal_tpu.api.reward_api import reward_kwargs
+
         reward = await areward(
-            None, completion, resp.input_tokens, resp.output_tokens, **item
+            None,
+            completion,
+            resp.input_tokens,
+            resp.output_tokens,
+            **reward_kwargs(item),
         )
-        return float(reward), resp.output_len
+        return float(reward), resp.output_len, completion
 
     async def run():
         tasks = []
@@ -109,22 +117,67 @@ def evaluate_offline(
     per_problem = asyncio.run(run())
 
     rewards = np.array(
-        [[r for r, _ in samples] for samples in per_problem], dtype=np.float64
+        [[r for r, _, _ in samples] for samples in per_problem],
+        dtype=np.float64,
     )  # [P, n]
-    lens = np.array([[l for _, l in samples] for samples in per_problem])
+    lens = np.array([[l for _, l, _ in samples] for samples in per_problem])
     correct = (rewards > 0).sum(axis=1)  # [P]
     pass_k = {
         k: float(np.mean([pass_at_k_estimate(n, int(c), k) for c in correct]))
         for k in ks
         if k <= n
     }
+    # maj@n (parity: the reference's rm_maj_eval group_pred): plurality vote
+    # over extracted answers; a problem counts iff the plurality answer's
+    # samples were rewarded correct.
+    maj = []
+    for p_idx, samples in enumerate(per_problem):
+        votes: dict[str, list[float]] = {}
+        for r, _, completion in samples:
+            ans = _extracted_answer(completion)
+            votes.setdefault(ans, []).append(r)
+        if not votes:
+            maj.append(0.0)
+            continue
+        top = max(votes.values(), key=len)
+        maj.append(float(np.mean(top) > 0))
     res = EvalResult(
         n_problems=len(items),
         n_samples=n,
         mean_reward=float(rewards.mean()),
         pass_at_1=float((rewards > 0).mean()),
         pass_at_k=pass_k,
+        maj_at_n=float(np.mean(maj)),
         mean_output_len=float(lens.mean()),
     )
+    if dump_path is not None:
+        import json
+        import os
+
+        os.makedirs(os.path.dirname(dump_path) or ".", exist_ok=True)
+        with open(dump_path, "w") as f:
+            for item, samples in zip(items, per_problem):
+                f.write(
+                    json.dumps(
+                        dict(
+                            prompt=item.get("prompt"),
+                            answer=item.get("answer"),
+                            samples=[
+                                dict(reward=r, output_len=int(l),
+                                     completion=c)
+                                for r, l, c in samples
+                            ],
+                        )
+                    )
+                    + "\n"
+                )
     logger.info(f"offline eval: {res.to_dict()}")
     return res
+
+
+def _extracted_answer(completion: str | None) -> str:
+    from areal_tpu.reward.math_parser import extract_answer
+
+    if not completion:
+        return ""
+    return extract_answer(completion) or completion.strip()[-32:]

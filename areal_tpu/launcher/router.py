@@ -1,0 +1,332 @@
+"""Decode-fleet router: request scheduling + server-side staleness gate.
+
+Parity: realhf/system/gserver_manager.py:32 (GserverManager) — the service
+that turns N independent decode servers into one fleet:
+
+- **/schedule_request**: pick a server for a new generation request by
+  policy — `round_robin`, `least_requests`, or `least_token_usage` — with
+  qid affinity (all samples of one prompt group land on the same server, so
+  its prefix cache works; gserver_manager.py:371-390). A request that
+  resumes on the same weight version keeps its previous server (KV reuse).
+- **/allocate_rollout**: the server-side staleness gate
+  (gserver_manager.py:334 `is_staled`): expected_version =
+  (trainer-consumed samples + running rollouts) // train_batch_size must
+  not exceed current weight version + max_head_offpolicyness. The trainer
+  publishes its consumed-sample counter under names.training_samples.
+- **/finish_rollout**: decrement running, release load accounting.
+
+TPU-shape differences from the reference: weight versions come from the
+decode servers' /health (they learn versions via the DCN push path, not
+disk-reload polling), so the router polls health rather than orchestrating
+`/update_weights_from_disk`; and load metrics are the router's own
+accounting (our servers don't export Prometheus counters).
+
+Run: ``python -m areal_tpu.launcher.router --experiment-name e --trial-name t``
+(servers discovered via name_resolve) or ``--servers host:p1,host:p2``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import time
+from collections import defaultdict
+from typing import Any
+
+from aiohttp import web
+
+from areal_tpu.utils import logging, name_resolve, names
+from areal_tpu.utils.http import arequest_with_retry
+from areal_tpu.utils.network import find_free_ports, gethostip
+
+logger = logging.getLogger("rollout_router")
+
+
+class DecodeRouter:
+    def __init__(
+        self,
+        experiment_name: str = "",
+        trial_name: str = "",
+        servers: list[str] | None = None,
+        *,
+        schedule_policy: str = "least_requests",
+        max_concurrent_rollouts: int = 1024,
+        max_head_offpolicyness: int = 1_000_000_000,
+        train_batch_size: int = 1,
+        health_poll_interval: float = 5.0,
+    ):
+        self.experiment_name = experiment_name
+        self.trial_name = trial_name
+        self.schedule_policy = schedule_policy
+        self.max_concurrent_rollouts = max_concurrent_rollouts
+        self.max_head_offpolicyness = max_head_offpolicyness
+        self.train_batch_size = max(1, train_batch_size)
+        self.health_poll_interval = health_poll_interval
+
+        self._seed_servers: list[str] = list(servers or [])
+        self.servers: list[str] = list(self._seed_servers)
+        self._rr = 0
+        self._request_counts: dict[str, int] = defaultdict(int)
+        self._token_usage: dict[str, float] = defaultdict(float)
+        self._qid_to_server: dict[str, str] = {}
+        self._qid_cost: dict[str, float] = {}
+        # one qid may carry several in-flight requests (a GRPO group shares
+        # its prompt's rid); release accounting one unit per finish
+        self._qid_pending: dict[str, int] = {}
+        self._versions: dict[str, int] = {}
+        self._running = 0
+        self._submitted = 0
+        self._accepted = 0
+        self._lock = asyncio.Lock()
+        self._runner: web.AppRunner | None = None
+        self._poll_task: asyncio.Task | None = None
+        self.addr: str | None = None
+
+    # -- fleet state ----------------------------------------------------
+    def _discover(self) -> list[str]:
+        # seed list is immutable: a server dropped after a failed health
+        # poll re-enters the candidate set and returns once healthy again
+        found: list[str] = []
+        if self.experiment_name and self.trial_name:
+            try:
+                found = name_resolve.get_subtree(
+                    names.gen_servers(self.experiment_name, self.trial_name)
+                )
+            except Exception:  # noqa: BLE001 — discovery is best-effort
+                found = []
+        return sorted(set(self._seed_servers) | set(found))
+
+    async def _poll_loop(self) -> None:
+        while True:
+            try:
+                servers = self._discover()
+                versions = {}
+                for s in servers:
+                    try:
+                        data = await arequest_with_retry(
+                            s, "/health", method="GET", timeout=5,
+                            max_retries=1,
+                        )
+                        versions[s] = int(data.get("version", 0))
+                    except Exception:  # noqa: BLE001 — dead server drops out
+                        logger.warning(f"server {s} failed health poll")
+                async with self._lock:
+                    self.servers = [s for s in servers if s in versions]
+                    self._versions = versions
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                logger.warning(f"router poll loop error: {e!r}")
+            await asyncio.sleep(self.health_poll_interval)
+
+    @property
+    def fleet_version(self) -> int:
+        """Weight version of the fleet = min over servers (a conservative
+        gate while a push is mid-fleet)."""
+        return min(self._versions.values()) if self._versions else 0
+
+    def _training_sample_cnt(self) -> int:
+        try:
+            return int(
+                name_resolve.get(
+                    names.training_samples(self.experiment_name, self.trial_name)
+                )
+            )
+        except Exception:  # noqa: BLE001 — counter not published yet
+            return 0
+
+    def _is_staled(self) -> bool:
+        expected = (
+            self._training_sample_cnt() + self._running
+        ) // self.train_batch_size
+        return expected > self.max_head_offpolicyness + self.fleet_version
+
+    # -- scheduling -----------------------------------------------------
+    def _pick(self, req: dict[str, Any]) -> str:
+        if not self.servers:
+            raise web.HTTPServiceUnavailable(reason="no decode servers")
+        qid = req.get("qid")
+        prev_url = req.get("previous_server_url")
+        prev_version = req.get("previous_version")
+        if (
+            prev_url
+            and prev_url in self.servers
+            and prev_version == self.fleet_version
+        ):
+            return prev_url  # resume with live KV on the same weights
+        if qid and qid in self._qid_to_server:
+            cached = self._qid_to_server[qid]
+            if cached in self.servers:
+                return cached
+        if self.schedule_policy == "round_robin":
+            addr = self.servers[self._rr % len(self.servers)]
+            self._rr += 1
+        elif self.schedule_policy == "least_requests":
+            addr = min(self.servers, key=lambda s: self._request_counts[s])
+        elif self.schedule_policy == "least_token_usage":
+            addr = min(self.servers, key=lambda s: self._token_usage[s])
+        else:
+            raise web.HTTPBadRequest(
+                reason=f"unknown schedule policy {self.schedule_policy}"
+            )
+        return addr
+
+    # -- handlers -------------------------------------------------------
+    async def _schedule_request(self, request: web.Request) -> web.Response:
+        req = await request.json()
+        async with self._lock:
+            addr = self._pick(req)
+            qid = req.get("qid")
+            cost = float(req.get("prompt_len", 0)) + 0.4 * float(
+                req.get("new_token_budget", 0)
+            ) * float(req.get("group_size", 1))
+            self._request_counts[addr] += 1
+            self._token_usage[addr] += cost
+            if qid:
+                self._qid_to_server[qid] = addr
+                self._qid_cost[qid] = self._qid_cost.get(qid, 0.0) + cost
+                self._qid_pending[qid] = self._qid_pending.get(qid, 0) + 1
+            return web.json_response(
+                {"url": addr, "version": self.fleet_version}
+            )
+
+    async def _allocate_rollout(self, request: web.Request) -> web.Response:
+        req = await request.json()
+        async with self._lock:
+            has_capacity = self._running < self.max_concurrent_rollouts
+            staled = self._is_staled()
+            if has_capacity and not staled:
+                self._running += 1
+                self._submitted += 1
+                return web.json_response({"success": True, "reason": ""})
+            reason = []
+            if not has_capacity:
+                reason.append(
+                    f"capacity: {self._running} >= {self.max_concurrent_rollouts}"
+                )
+            if staled:
+                reason.append(
+                    f"staled: version {self.fleet_version} + offpolicyness "
+                    f"{self.max_head_offpolicyness} exceeded"
+                )
+            return web.json_response(
+                {"success": False, "reason": "; ".join(reason)}
+            )
+
+    def _release_qid(self, qid: str | None) -> None:
+        """Release ONE in-flight unit of a qid's load accounting."""
+        if not qid or qid not in self._qid_to_server:
+            return
+        addr = self._qid_to_server[qid]
+        pending = self._qid_pending.get(qid, 1)
+        unit_cost = self._qid_cost.get(qid, 0.0) / max(1, pending)
+        self._request_counts[addr] = max(0, self._request_counts[addr] - 1)
+        self._token_usage[addr] = max(
+            0.0, self._token_usage[addr] - unit_cost
+        )
+        if pending <= 1:
+            self._qid_to_server.pop(qid, None)
+            self._qid_cost.pop(qid, None)
+            self._qid_pending.pop(qid, None)
+        else:
+            self._qid_pending[qid] = pending - 1
+            self._qid_cost[qid] = self._qid_cost[qid] - unit_cost
+
+    async def _finish_rollout(self, request: web.Request) -> web.Response:
+        req = await request.json()
+        async with self._lock:
+            self._running = max(0, self._running - 1)
+            if req.get("accepted"):
+                self._accepted += 1
+            self._release_qid(req.get("qid"))
+            return web.json_response({"success": True})
+
+    async def _finish_request(self, request: web.Request) -> web.Response:
+        """Release a /schedule_request's load accounting WITHOUT touching
+        the rollout-lifecycle counters (clients that only use routing —
+        not /allocate_rollout — call this per completed generation)."""
+        req = await request.json()
+        async with self._lock:
+            self._release_qid(req.get("qid"))
+            return web.json_response({"success": True})
+
+    async def _health(self, request: web.Request) -> web.Response:
+        async with self._lock:
+            return web.json_response(
+                {
+                    "status": "ok",
+                    "servers": self.servers,
+                    "versions": self._versions,
+                    "running": self._running,
+                    "submitted": self._submitted,
+                    "accepted": self._accepted,
+                    "request_counts": dict(self._request_counts),
+                }
+            )
+
+    # -- lifecycle ------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_post("/schedule_request", self._schedule_request)
+        app.router.add_post("/allocate_rollout", self._allocate_rollout)
+        app.router.add_post("/finish_rollout", self._finish_rollout)
+        app.router.add_post("/finish_request", self._finish_request)
+        return app
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> str:
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual_port = self._runner.addresses[0][1]
+        report_host = gethostip() if host in ("0.0.0.0", "::") else host
+        self.addr = f"{report_host}:{actual_port}"
+        self._poll_task = asyncio.create_task(self._poll_loop())
+        if self.experiment_name and self.trial_name:
+            name_resolve.add(
+                names.rollout_router(self.experiment_name, self.trial_name),
+                self.addr,
+                replace=True,
+            )
+        logger.info(f"rollout router on {self.addr}")
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            self._poll_task = None
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--experiment-name", default="")
+    p.add_argument("--trial-name", default="")
+    p.add_argument("--servers", default="", help="comma-separated host:port")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--schedule-policy", default="least_requests")
+    p.add_argument("--max-concurrent-rollouts", type=int, default=1024)
+    p.add_argument("--max-head-offpolicyness", type=int, default=1_000_000_000)
+    p.add_argument("--train-batch-size", type=int, default=1)
+    args = p.parse_args(argv)
+
+    async def _serve():
+        router = DecodeRouter(
+            args.experiment_name,
+            args.trial_name,
+            [s for s in args.servers.split(",") if s],
+            schedule_policy=args.schedule_policy,
+            max_concurrent_rollouts=args.max_concurrent_rollouts,
+            max_head_offpolicyness=args.max_head_offpolicyness,
+            train_batch_size=args.train_batch_size,
+        )
+        await router.start(args.host, args.port)
+        await asyncio.Event().wait()
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Local Scheduler: worker subprocesses running the RPC server.
+
+Parity: the reference's controller mode pairs a Scheduler implementation
+with the RPC server/client (areal/api/scheduler_api.py:36 +
+areal/scheduler/rpc/). This is the single-host implementation: each worker
+is a subprocess running `python -m areal_tpu.scheduler.rpc.rpc_server` on a
+pre-allocated free port; `create_engine`/`call_engine` go through RPCClient.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import Any
+
+from areal_tpu.api.scheduler_api import Scheduler, SchedulingSpec, Worker
+from areal_tpu.scheduler.rpc.rpc_client import RPCClient
+from areal_tpu.utils import logging
+from areal_tpu.utils.network import find_free_ports, gethostip
+
+logger = logging.getLogger("local_scheduler")
+
+
+class LocalScheduler(Scheduler):
+    def __init__(self, startup_timeout: float = 60.0):
+        self.client = RPCClient()
+        self.startup_timeout = startup_timeout
+        self._workers: dict[str, list[tuple[Worker, subprocess.Popen]]] = {}
+
+    def create_workers(
+        self, role: str, spec: SchedulingSpec, count: int, **kwargs
+    ) -> list[str]:
+        import os
+
+        ports = find_free_ports(count * max(1, spec.port_count))
+        ids = []
+        procs = self._workers.setdefault(role, [])
+        for i in range(count):
+            wports = ports[
+                i * max(1, spec.port_count) : (i + 1) * max(1, spec.port_count)
+            ]
+            env = dict(os.environ)
+            env.update(spec.env_vars)
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "areal_tpu.scheduler.rpc.rpc_server",
+                    "--host",
+                    "0.0.0.0",
+                    "--port",
+                    str(wports[0]),
+                ],
+                env=env,
+                start_new_session=True,
+            )
+            worker = Worker(
+                id=f"{role}/{len(procs)}",
+                ip=gethostip(),
+                ports=[str(p) for p in wports],
+            )
+            procs.append((worker, proc))
+            ids.append(worker.id)
+            logger.info(f"spawned worker {worker.id} on {worker.rpc_addr}")
+        return ids
+
+    def get_workers(self, role: str, timeout: float | None = None) -> list[Worker]:
+        out = []
+        for worker, _proc in self._workers.get(role, []):
+            self.client.wait_healthy(
+                worker.rpc_addr, timeout=timeout or self.startup_timeout
+            )
+            out.append(worker)
+        return out
+
+    def delete_workers(self, role: str | None = None) -> None:
+        roles = [role] if role is not None else list(self._workers)
+        for r in roles:
+            for _worker, proc in self._workers.pop(r, []):
+                if proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+
+    def _find(self, worker_id: str) -> Worker:
+        role = worker_id.split("/")[0]
+        for worker, _proc in self._workers.get(role, []):
+            if worker.id == worker_id:
+                return worker
+        raise KeyError(f"unknown worker {worker_id}")
+
+    def create_engine(
+        self, worker_id: str, engine_type: str, *args, **kwargs
+    ) -> Any:
+        return self.client.create_engine(
+            self._find(worker_id).rpc_addr, engine_type, *args, **kwargs
+        )
+
+    def call_engine(self, worker_id: str, method: str, *args, **kwargs) -> Any:
+        return self.client.call_engine(
+            self._find(worker_id).rpc_addr, method, *args, **kwargs
+        )

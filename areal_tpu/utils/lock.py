@@ -1,0 +1,83 @@
+"""Distributed mutex over the name_resolve store.
+
+Parity: areal/utils/lock.py:9 DistributedLock — the reference mutexes over a
+torch TCPStore (counter+owner keys, backoff). The TPU build has no c10d
+store; the same semantics come from name_resolve's atomic create-if-absent
+(`add(replace=False)` — link(2) on the NFS backend, etcd txn on
+create_revision==0), with a keepalive TTL so a crashed holder's lock
+self-releases instead of deadlocking the fleet.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+from areal_tpu.utils import logging, name_resolve
+
+logger = logging.getLogger("lock")
+
+
+class DistributedLock:
+    def __init__(
+        self,
+        name: str,
+        repo: "name_resolve.NameRecordRepository | None" = None,
+        ttl: float = 30.0,
+        retry_interval: float = 0.1,
+    ):
+        self.key = f"locks/{name.strip('/')}"
+        self.repo = repo
+        self.ttl = ttl
+        self.retry_interval = retry_interval
+        self.holder_id = uuid.uuid4().hex
+        self._held = False
+
+    def _repo(self):
+        return self.repo if self.repo is not None else name_resolve.default_repo()
+
+    def acquire(self, timeout: float | None = None) -> bool:
+        """Block until acquired (or timeout); returns whether it was."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            try:
+                self._repo().add(
+                    self.key,
+                    self.holder_id,
+                    delete_on_exit=True,
+                    keepalive_ttl=self.ttl,
+                    replace=False,
+                )
+                self._held = True
+                return True
+            except name_resolve.NameEntryExistsError:
+                if deadline is not None and time.monotonic() > deadline:
+                    return False
+                time.sleep(self.retry_interval)
+
+    def release(self) -> None:
+        if not self._held:
+            return
+        self._held = False
+        try:
+            # best-effort holder check: never delete someone else's lock
+            # (ours may have TTL-lapsed and been re-acquired)
+            if self._repo().get(self.key) == self.holder_id:
+                self._repo().delete(self.key)
+        except name_resolve.NameEntryNotFoundError:
+            pass
+
+    def locked(self) -> bool:
+        try:
+            self._repo().get(self.key)
+            return True
+        except name_resolve.NameEntryNotFoundError:
+            return False
+
+    def __enter__(self) -> "DistributedLock":
+        if not self.acquire():
+            raise TimeoutError(f"could not acquire lock {self.key}")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

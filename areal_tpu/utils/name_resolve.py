@@ -203,12 +203,34 @@ class NfsNameRecordRepository(NameRecordRepository):
 
     def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
         p = self._path(name)
-        if p.exists() and not self._expired(p) and not replace:
-            raise NameEntryExistsError(name)
         p.parent.mkdir(parents=True, exist_ok=True)
         tmp = p.parent / f".tmp-{uuid.uuid4().hex}"
         tmp.write_text(str(value))
-        os.replace(tmp, p)
+        if replace:
+            os.replace(tmp, p)
+        else:
+            # ATOMIC create-if-absent via link(2) — DistributedLock's mutual
+            # exclusion rests on this; an exists()-then-rename check has a
+            # window where two hosts both pass and both "acquire".
+            try:
+                os.link(tmp, p)
+            except FileExistsError:
+                if self._expired(p):
+                    # stale TTL entry: remove and retry the atomic claim
+                    # (losers of the link race see FileExistsError again)
+                    try:
+                        p.unlink()
+                    except FileNotFoundError:
+                        pass
+                    try:
+                        os.link(tmp, p)
+                    except FileExistsError:
+                        tmp.unlink()
+                        raise NameEntryExistsError(name) from None
+                else:
+                    tmp.unlink()
+                    raise NameEntryExistsError(name) from None
+            tmp.unlink()
         ttl_file = Path(str(p) + self.TTL_SUFFIX)
         if keepalive_ttl is not None:
             ttl_file.write_text(str(float(keepalive_ttl)))
@@ -328,6 +350,226 @@ class NfsNameRecordRepository(NameRecordRepository):
         self._owned.clear()
 
 
+class Etcd3NameRecordRepository(NameRecordRepository):
+    """etcd v3 backend over the JSON gRPC-gateway (`/v3/...` HTTP API).
+
+    Parity: areal/utils/name_resolve.py:411 Etcd3NameRecordRepository —
+    same contract (TTL leases + keepalive thread, atomic create-if-absent)
+    but speaking the gateway's JSON/base64 protocol through stdlib urllib,
+    so no etcd3/grpc python packages are required. Works against any etcd
+    >= 3.3 with the gateway enabled (the default).
+    """
+
+    def __init__(self, addr: str = "localhost:2379", timeout: float = 10.0):
+        self.base = f"http://{addr}/v3"
+        self.timeout = timeout
+        self._owned: set[str] = set()
+        self._leases: dict[str, int] = {}  # name -> lease id
+        self._lease_ttls: dict[int, float] = {}  # lease id -> granted TTL
+        self._keepalive_stop = threading.Event()
+        self._keepalive_thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # -- gateway plumbing ----------------------------------------------
+    @staticmethod
+    def _b64(s: str) -> str:
+        import base64
+
+        return base64.b64encode(s.encode()).decode()
+
+    @staticmethod
+    def _unb64(s: str) -> str:
+        import base64
+
+        return base64.b64decode(s).decode()
+
+    def _call(self, endpoint: str, payload: dict) -> dict:
+        import json as _json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.base}{endpoint}",
+            data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return _json.loads(resp.read().decode() or "{}")
+
+    @staticmethod
+    def _range_end(prefix: str) -> str:
+        b = bytearray(prefix.encode())
+        for i in reversed(range(len(b))):
+            if b[i] < 0xFF:
+                b[i] += 1
+                del b[i + 1 :]
+                break
+        import base64
+
+        return base64.b64encode(bytes(b)).decode()
+
+    def _key(self, name: str) -> str:
+        return "/" + name.strip("/")
+
+    # -- api ------------------------------------------------------------
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        key = self._key(name)
+        lease = 0
+        if keepalive_ttl is not None:
+            out = self._call(
+                "/lease/grant", {"TTL": max(1, int(keepalive_ttl))}
+            )
+            lease = int(out["ID"])
+        if replace:
+            self._call(
+                "/kv/put",
+                {"key": self._b64(key), "value": self._b64(str(value)),
+                 "lease": lease},
+            )
+        else:
+            # atomic create-if-absent: txn on create_revision == 0
+            out = self._call(
+                "/kv/txn",
+                {
+                    "compare": [
+                        {
+                            "key": self._b64(key),
+                            "target": "CREATE",
+                            "create_revision": "0",
+                            "result": "EQUAL",
+                        }
+                    ],
+                    "success": [
+                        {
+                            "request_put": {
+                                "key": self._b64(key),
+                                "value": self._b64(str(value)),
+                                "lease": lease,
+                            }
+                        }
+                    ],
+                },
+            )
+            if not out.get("succeeded"):
+                if lease:
+                    # a failed claim must not leak its freshly granted
+                    # lease (contending lockers would accumulate thousands)
+                    try:
+                        self._call("/lease/revoke", {"ID": lease})
+                    except Exception:  # noqa: BLE001 — expires on its own
+                        pass
+                raise NameEntryExistsError(name)
+        with self._lock:
+            if lease:
+                self._leases[name] = lease
+                self._lease_ttls[lease] = float(keepalive_ttl)
+                self._ensure_keepalive_thread()
+            else:
+                self._leases.pop(name, None)
+            if delete_on_exit:
+                self._owned.add(name)
+
+    def _ensure_keepalive_thread(self):
+        if self._keepalive_thread is not None and self._keepalive_thread.is_alive():
+            return
+        self._keepalive_stop.clear()
+
+        def _loop():
+            while True:
+                with self._lock:
+                    leases = set(self._leases.values())
+                    ttls = [self._lease_ttls.get(l, 3.0) for l in leases]
+                # refresh well within the smallest TTL (etcd grants >= 1s)
+                interval = max(0.2, min(ttls) / 3.0) if ttls else 1.0
+                if self._keepalive_stop.wait(timeout=interval):
+                    return
+                for lease in leases:
+                    try:
+                        self._call("/lease/keepalive", {"ID": lease})
+                    except Exception:  # noqa: BLE001 — retried next tick
+                        pass
+
+        self._keepalive_thread = threading.Thread(target=_loop, daemon=True)
+        self._keepalive_thread.start()
+
+    def get(self, name):
+        out = self._call("/kv/range", {"key": self._b64(self._key(name))})
+        kvs = out.get("kvs") or []
+        if not kvs:
+            raise NameEntryNotFoundError(name)
+        return self._unb64(kvs[0]["value"])
+
+    def _range_prefix(self, name_root: str) -> list[tuple[str, str]]:
+        prefix = self._key(name_root)
+        out = self._call(
+            "/kv/range",
+            {"key": self._b64(prefix), "range_end": self._range_end(prefix)},
+        )
+        pairs = []
+        for kv in out.get("kvs") or []:
+            k = self._unb64(kv["key"])
+            # prefix-boundary guard: "/a/b" must not match "/a/bc"
+            if k == prefix or k.startswith(prefix + "/"):
+                pairs.append((k, self._unb64(kv["value"])))
+        return sorted(pairs)
+
+    def get_subtree(self, name_root):
+        return [v for _, v in self._range_prefix(name_root)]
+
+    def find_subtree(self, name_root):
+        return [k.lstrip("/") for k, _ in self._range_prefix(name_root)]
+
+    def delete(self, name):
+        out = self._call(
+            "/kv/deleterange", {"key": self._b64(self._key(name))}
+        )
+        if int(out.get("deleted", 0)) == 0:
+            raise NameEntryNotFoundError(name)
+        with self._lock:
+            self._owned.discard(name)
+            self._leases.pop(name, None)
+
+    def clear_subtree(self, name_root):
+        prefix = self._key(name_root)
+        # two deletes to respect the "/" boundary: the subtree and the root
+        self._call(
+            "/kv/deleterange",
+            {
+                "key": self._b64(prefix + "/"),
+                "range_end": self._range_end(prefix + "/"),
+            },
+        )
+        self._call("/kv/deleterange", {"key": self._b64(prefix)})
+        with self._lock:
+            self._owned = {
+                n
+                for n in self._owned
+                if self._key(n) != prefix
+                and not self._key(n).startswith(prefix + "/")
+            }
+
+    def reset(self):
+        self._keepalive_stop.set()
+        if self._keepalive_thread is not None:
+            self._keepalive_thread.join(timeout=2.0)
+            self._keepalive_thread = None
+        self._keepalive_stop.clear()
+        with self._lock:
+            leases = dict(self._leases)
+            self._leases.clear()
+        for lease in set(leases.values()):
+            try:
+                self._call("/lease/revoke", {"ID": lease})
+            except Exception:  # noqa: BLE001 — lease will expire anyway
+                pass
+        for name in list(self._owned):
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+        self._owned.clear()
+
+
 # Module-level default repository, reconfigurable like the reference.
 _default_repo: NameRecordRepository = MemoryNameRecordRepository()
 
@@ -338,10 +580,12 @@ def reconfigure(config: NameResolveConfig) -> None:
         _default_repo = MemoryNameRecordRepository()
     elif config.type == "nfs":
         _default_repo = NfsNameRecordRepository(config.nfs_record_root)
+    elif config.type == "etcd3":
+        _default_repo = Etcd3NameRecordRepository(config.etcd3_addr)
     else:
         raise NotImplementedError(
             f"name_resolve backend {config.type!r} not available in the TPU build "
-            "(supported: memory, nfs)"
+            "(supported: memory, nfs, etcd3)"
         )
 
 

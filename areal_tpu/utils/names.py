@@ -44,3 +44,14 @@ def distributed_barrier(experiment_name: str, trial_name: str, barrier: str) -> 
 
 def model_version(experiment_name: str, trial_name: str, role: str = "default") -> str:
     return f"{_base(experiment_name, trial_name)}/model_version/{role}"
+
+
+def training_samples(experiment_name: str, trial_name: str) -> str:
+    """Trainer-written global consumed-sample counter (the staleness gate's
+    numerator; parity: realhf names.training_samples)."""
+    return f"{_base(experiment_name, trial_name)}/training_samples"
+
+
+def rollout_router(experiment_name: str, trial_name: str) -> str:
+    """Address of the decode-fleet router service."""
+    return f"{_base(experiment_name, trial_name)}/rollout_router"

@@ -1,0 +1,89 @@
+"""DistributedLock over name_resolve (parity: areal/utils/lock.py +
+areal/tests/torchrun lock test — mutual exclusion under contention)."""
+
+import threading
+import time
+
+from areal_tpu.utils.lock import DistributedLock
+from areal_tpu.utils.name_resolve import (
+    MemoryNameRecordRepository,
+    NfsNameRecordRepository,
+)
+
+
+def test_mutual_exclusion_threads():
+    repo = MemoryNameRecordRepository()
+    counter = {"v": 0}
+
+    def worker():
+        for _ in range(20):
+            with DistributedLock("ctr", repo=repo, retry_interval=0.001):
+                v = counter["v"]
+                time.sleep(0.0005)  # widen the race window
+                counter["v"] = v + 1
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter["v"] == 80
+
+
+def test_acquire_timeout_and_release():
+    repo = MemoryNameRecordRepository()
+    a = DistributedLock("x", repo=repo)
+    b = DistributedLock("x", repo=repo, retry_interval=0.01)
+    assert a.acquire()
+    assert not b.acquire(timeout=0.1)
+    a.release()
+    assert b.acquire(timeout=1.0)
+    b.release()
+    assert not a.locked()
+
+
+def test_release_does_not_steal(tmp_path):
+    """If A's lock lapsed and B holds it, A.release must not delete B's."""
+    repo = NfsNameRecordRepository(str(tmp_path / "nr"))
+    a = DistributedLock("y", repo=repo)
+    b = DistributedLock("y", repo=repo)
+    assert a.acquire()
+    # simulate A's entry lapsing: forcibly delete, then B acquires
+    repo.delete(a.key)
+    assert b.acquire(timeout=1.0)
+    a.release()  # must NOT remove B's lock
+    assert b.locked()
+    b.release()
+
+
+def test_cross_process_nfs(tmp_path):
+    """Two processes contend via the NFS backend."""
+    import subprocess
+    import sys
+
+    root = str(tmp_path / "nr")
+    script = f"""
+import sys, time
+sys.path.insert(0, {repr('/root/repo')})
+from areal_tpu.utils.lock import DistributedLock
+from areal_tpu.utils.name_resolve import NfsNameRecordRepository
+repo = NfsNameRecordRepository({root!r})
+with DistributedLock("p", repo=repo, retry_interval=0.01):
+    time.sleep(0.4)
+print("done")
+"""
+    p1 = subprocess.Popen([sys.executable, "-c", script],
+                          stdout=subprocess.PIPE)
+    repo = NfsNameRecordRepository(root)
+    # wait until the child actually holds the lock before contending
+    deadline = time.monotonic() + 15.0
+    lock = DistributedLock("p", repo=repo, retry_interval=0.02)
+    while not lock.locked():
+        assert time.monotonic() < deadline, "child never acquired"
+        time.sleep(0.02)
+    t0 = time.monotonic()
+    assert lock.acquire(timeout=10.0)
+    waited = time.monotonic() - t0
+    lock.release()
+    assert p1.wait(10) == 0
+    assert waited > 0.15, f"should have waited for the child, waited {waited}"

@@ -1,9 +1,12 @@
+import asyncio
+import base64
 import threading
 import time
 
 import pytest
 
 from areal_tpu.utils.name_resolve import (
+    Etcd3NameRecordRepository,
     MemoryNameRecordRepository,
     NameEntryExistsError,
     NameEntryNotFoundError,
@@ -11,11 +14,164 @@ from areal_tpu.utils.name_resolve import (
 )
 
 
-@pytest.fixture(params=["memory", "nfs"])
+class FakeEtcdGateway:
+    """In-memory etcd v3 JSON gRPC-gateway: kv put/range/deleterange/txn +
+    lease grant/keepalive/revoke with TTL expiry. Runs aiohttp on a thread."""
+
+    def __init__(self):
+        self.kv: dict[bytes, tuple[bytes, int]] = {}  # key -> (value, lease)
+        self.created: dict[bytes, int] = {}
+        self.leases: dict[int, float] = {}  # id -> deadline
+        self.ttls: dict[int, float] = {}
+        self._rev = 0
+        self._next_lease = 1000
+        self._lock = threading.Lock()
+        self.addr = None
+        self._loop = None
+        self._runner = None
+
+    def _expire(self):
+        now = time.monotonic()
+        dead = {lid for lid, dl in self.leases.items() if dl < now}
+        for lid in dead:
+            self.leases.pop(lid, None)
+            self.ttls.pop(lid, None)
+        if dead:
+            for k in [k for k, (_, l) in self.kv.items() if l in dead]:
+                self.kv.pop(k, None)
+                self.created.pop(k, None)
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        ep = request.path
+        with self._lock:
+            self._expire()
+            if ep == "/v3/lease/grant":
+                lid = self._next_lease = self._next_lease + 1
+                ttl = float(body["TTL"])
+                self.leases[lid] = time.monotonic() + ttl
+                self.ttls[lid] = ttl
+                return web.json_response({"ID": str(lid), "TTL": str(int(ttl))})
+            if ep == "/v3/lease/keepalive":
+                lid = int(body["ID"])
+                if lid in self.leases:
+                    self.leases[lid] = time.monotonic() + self.ttls[lid]
+                return web.json_response({"result": {"ID": str(lid)}})
+            if ep == "/v3/lease/revoke":
+                lid = int(body["ID"])
+                self.leases[lid] = -1.0
+                self._expire()
+                return web.json_response({})
+            key = base64.b64decode(body.get("key", ""))
+            if ep == "/v3/kv/put":
+                self._put(key, base64.b64decode(body.get("value", "")),
+                          int(body.get("lease", 0) or 0))
+                return web.json_response({})
+            if ep == "/v3/kv/range":
+                kvs = self._range(key, body.get("range_end"))
+                return web.json_response(
+                    {
+                        "kvs": [
+                            {
+                                "key": base64.b64encode(k).decode(),
+                                "value": base64.b64encode(v).decode(),
+                            }
+                            for k, (v, _) in kvs
+                        ],
+                        "count": str(len(kvs)),
+                    }
+                )
+            if ep == "/v3/kv/deleterange":
+                kvs = self._range(key, body.get("range_end"))
+                for k, _ in kvs:
+                    self.kv.pop(k, None)
+                    self.created.pop(k, None)
+                return web.json_response({"deleted": str(len(kvs))})
+            if ep == "/v3/kv/txn":
+                cmp = body["compare"][0]
+                ckey = base64.b64decode(cmp["key"])
+                exists = ckey in self.kv
+                # only CREATE == 0 comparisons are modeled
+                succeeded = not exists
+                if succeeded:
+                    put = body["success"][0]["request_put"]
+                    self._put(
+                        base64.b64decode(put["key"]),
+                        base64.b64decode(put.get("value", "")),
+                        int(put.get("lease", 0) or 0),
+                    )
+                return web.json_response({"succeeded": succeeded})
+        return web.json_response({}, status=404)
+
+    def _put(self, key, value, lease):
+        self._rev += 1
+        if key not in self.kv:
+            self.created[key] = self._rev
+        self.kv[key] = (value, lease)
+
+    def _range(self, key, range_end_b64):
+        if not range_end_b64:
+            return [(key, self.kv[key])] if key in self.kv else []
+        end = base64.b64decode(range_end_b64)
+        return sorted(
+            (k, v) for k, v in self.kv.items() if key <= k < end
+        )
+
+    def start(self):
+        from aiohttp import web
+
+        started = threading.Event()
+
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def go():
+                app = web.Application()
+                app.router.add_post("/v3/{tail:.*}", self._handle)
+                self._runner = web.AppRunner(app)
+                await self._runner.setup()
+                site = web.TCPSite(self._runner, "127.0.0.1", 0)
+                await site.start()
+                self.addr = f"127.0.0.1:{self._runner.addresses[0][1]}"
+                started.set()
+
+            self._loop.run_until_complete(go())
+            self._loop.run_forever()
+
+        threading.Thread(target=run, daemon=True).start()
+        assert started.wait(10)
+        return self.addr
+
+    def stop(self):
+        if self._loop is not None:
+            asyncio.run_coroutine_threadsafe(
+                self._runner.cleanup(), self._loop
+            ).result(5)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+@pytest.fixture(scope="module")
+def etcd_gateway():
+    gw = FakeEtcdGateway()
+    gw.start()
+    yield gw
+    gw.stop()
+
+
+@pytest.fixture(params=["memory", "nfs", "etcd3"])
 def repo(request, tmp_path):
     if request.param == "memory":
-        return MemoryNameRecordRepository()
-    return NfsNameRecordRepository(str(tmp_path / "nr"))
+        yield MemoryNameRecordRepository()
+    elif request.param == "etcd3":
+        gw = FakeEtcdGateway()
+        gw.start()
+        yield Etcd3NameRecordRepository(gw.addr)
+        gw.stop()
+    else:
+        yield NfsNameRecordRepository(str(tmp_path / "nr"))
 
 
 def test_add_get_delete(repo):
@@ -122,3 +278,26 @@ def test_nfs_replace_without_ttl_stops_keepalive(tmp_path):
     repo.add("k", "2", replace=True)  # now permanent
     assert not repo._keepalive_entries, "keepalive entry leaked after replace"
     assert repo.get("k") == "2"
+
+
+def test_etcd3_ttl_expiry_and_keepalive(etcd_gateway):
+    """A TTL entry expires when its owner stops refreshing; the keepalive
+    thread keeps it alive while the repo lives."""
+    repo = Etcd3NameRecordRepository(etcd_gateway.addr)
+    repo.add("svc/one", "v", keepalive_ttl=1.0)
+    time.sleep(2.0)  # > TTL: keepalive thread must have refreshed the lease
+    assert repo.get("svc/one") == "v"
+    repo.reset()  # revokes the lease
+    with pytest.raises(NameEntryNotFoundError):
+        repo.get("svc/one")
+
+
+def test_etcd3_prefix_boundary(etcd_gateway):
+    repo = Etcd3NameRecordRepository(etcd_gateway.addr)
+    repo.add("pb/a", "1")
+    repo.add("pb/ab", "2")
+    repo.add("pb/a/c", "3")
+    assert repo.find_subtree("pb/a") == ["pb/a", "pb/a/c"]
+    repo.clear_subtree("pb/a")
+    assert repo.get("pb/ab") == "2"
+    repo.reset()

@@ -1,0 +1,174 @@
+"""Decode-fleet router (parity: realhf/tests/system/test_gserver_manager.py —
+routing policies, qid affinity, staleness gate, rollout accounting)."""
+
+import asyncio
+import threading
+
+import pytest
+from aiohttp import web
+
+from areal_tpu.launcher.router import DecodeRouter
+from areal_tpu.utils import name_resolve, names
+from areal_tpu.utils.http import arequest_with_retry, close_current_session
+
+
+class FakeServer:
+    """Minimal decode-server stand-in: /health with a version."""
+
+    def __init__(self, version=0):
+        self.version = version
+        self._runner = None
+        self.addr = None
+
+    async def _health(self, request):
+        return web.json_response({"status": "ok", "version": self.version})
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = self._runner.addresses[0][1]
+        self.addr = f"127.0.0.1:{port}"
+        return self.addr
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+
+
+def _run_async(coro, timeout=60):
+    """Run a coroutine on a dedicated loop thread."""
+    result = {}
+
+    def go():
+        result["v"] = asyncio.run(coro)
+
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), "async scenario timed out"
+    return result.get("v")
+
+
+async def _scenario_routing():
+    s1, s2 = FakeServer(version=3), FakeServer(version=3)
+    a1, a2 = await s1.start(), await s2.start()
+    router = DecodeRouter(
+        servers=[a1, a2],
+        schedule_policy="least_requests",
+        max_concurrent_rollouts=2,
+        max_head_offpolicyness=1000,
+        train_batch_size=4,
+        health_poll_interval=0.2,
+    )
+    addr = await router.start("127.0.0.1", 0)
+    try:
+        await asyncio.sleep(0.5)  # let the poll loop see both servers
+
+        # least-requests spreads; qid affinity sticks
+        r1 = await arequest_with_retry(
+            addr, "/schedule_request",
+            payload=dict(qid="q1", prompt_len=10, group_size=4,
+                         new_token_budget=16),
+        )
+        r2 = await arequest_with_retry(
+            addr, "/schedule_request",
+            payload=dict(qid="q2", prompt_len=10, group_size=4,
+                         new_token_budget=16),
+        )
+        assert {r1["url"], r2["url"]} == {a1, a2}, "load not spread"
+        assert r1["version"] == 3
+        r1b = await arequest_with_retry(
+            addr, "/schedule_request",
+            payload=dict(qid="q1", prompt_len=10, group_size=4,
+                         new_token_budget=16),
+        )
+        assert r1b["url"] == r1["url"], "qid affinity broken"
+
+        # rollout accounting: capacity gate at 2 concurrent
+        ok1 = await arequest_with_retry(
+            addr, "/allocate_rollout", payload=dict(qid="q1")
+        )
+        ok2 = await arequest_with_retry(
+            addr, "/allocate_rollout", payload=dict(qid="q2")
+        )
+        full = await arequest_with_retry(
+            addr, "/allocate_rollout", payload=dict(qid="q3")
+        )
+        assert ok1["success"] and ok2["success"]
+        assert not full["success"] and "capacity" in full["reason"]
+        await arequest_with_retry(
+            addr, "/finish_rollout", payload=dict(qid="q1", accepted=True)
+        )
+        again = await arequest_with_retry(
+            addr, "/allocate_rollout", payload=dict(qid="q3")
+        )
+        assert again["success"]
+
+        health = await arequest_with_retry(addr, "/health", method="GET")
+        assert set(health["servers"]) == {a1, a2}
+        return True
+    finally:
+        await close_current_session()
+        await router.stop()
+        await s1.stop()
+        await s2.stop()
+
+
+def test_router_routing_affinity_capacity():
+    assert _run_async(_scenario_routing())
+
+
+async def _scenario_staleness(tmp_root):
+    name_resolve.reconfigure(
+        name_resolve.NameResolveConfig(type="memory")
+    )
+    s1 = FakeServer(version=0)
+    a1 = await s1.start()
+    router = DecodeRouter(
+        experiment_name="rexp",
+        trial_name="rt",
+        servers=[a1],
+        max_head_offpolicyness=1,
+        train_batch_size=4,
+        health_poll_interval=0.2,
+    )
+    addr = await router.start("127.0.0.1", 0)
+    try:
+        await asyncio.sleep(0.4)
+        # no samples consumed yet: not staled
+        out = await arequest_with_retry(
+            addr, "/allocate_rollout", payload=dict(qid="a")
+        )
+        assert out["success"]
+        await arequest_with_retry(
+            addr, "/finish_rollout", payload=dict(qid="a", accepted=True)
+        )
+        # trainer consumed 12 samples at batch 4 -> expected version 3 >
+        # fleet version 0 + offpolicyness 1 -> gate closes
+        name_resolve.add(
+            names.training_samples("rexp", "rt"), "12", replace=True
+        )
+        out = await arequest_with_retry(
+            addr, "/allocate_rollout", payload=dict(qid="b")
+        )
+        assert not out["success"] and "staled" in out["reason"]
+        # weight push bumps the fleet version -> gate reopens
+        s1.version = 3
+        await asyncio.sleep(0.6)
+        out = await arequest_with_retry(
+            addr, "/allocate_rollout", payload=dict(qid="c")
+        )
+        assert out["success"]
+        return True
+    finally:
+        await close_current_session()
+        await router.stop()
+        await s1.stop()
+
+
+def test_router_staleness_gate(tmp_path):
+    assert _run_async(_scenario_staleness(tmp_path))
