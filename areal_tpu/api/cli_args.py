@@ -160,6 +160,14 @@ class TrainEngineConfig:
     disable_dropout: bool = True
     gradient_checkpointing: bool = True
     dtype: str = "bfloat16"
+    # dtype of the cross-micro-batch gradient accumulator. It is SHARDED
+    # like the parameters (fsdp over dp), so its per-chip HBM cost is
+    # params_per_chip * 4 bytes at fp32 — e.g. 7B over 8 chips ≈ 3.5 GB/chip
+    # fp32, halved by "bfloat16" at the cost of accumulation precision
+    # across micro-batches (the within-backward matmul accumulation stays
+    # fp32 either way). The reference's Megatron fuses accumulation into
+    # backward buffers; GSPMD's equivalent lever is this dtype knob.
+    # Irrelevant under pp>1 (one backward, no explicit accumulator).
     grad_reduce_dtype: str = "float32"
     optimizer: OptimizerConfig | None = None
     weight_update_mode: str = "memory"  # "memory" (device_put) | "disk"

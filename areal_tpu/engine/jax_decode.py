@@ -75,6 +75,7 @@ class _Slot:
     gconfig: GenerationHyperparameters
     future: "asyncio.Future | None"
     loop: Any
+    image_data: list | None = None
     tokens: list[int] = field(default_factory=list)
     logprobs: list[float] = field(default_factory=list)
     versions: list[int] = field(default_factory=list)
@@ -137,6 +138,15 @@ class JaxDecodeEngine(InferenceEngine):
         # (_maybe_repeat_kv_heads); original config kept for HF reloads.
         self._kv_repeat = 1
         self._orig_model_config: ModelConfig | None = None
+        # Vision tower (VLM serving): installed via set_vision_model or
+        # loaded from an HF checkpoint whose config has "vision_config".
+        self._vision_params = None
+        self._vision_config = None
+        self._image_token_id: int | None = None
+        self._mrope_sections: tuple[int, ...] | None = None
+        self._vision_fns: dict[int, Callable] = {}
+        self._embed_prefill_fns: dict[tuple[int, int], Callable] = {}
+        self._slot_rope_delta = None  # np [R]: mrope position offsets
 
     # -- lifecycle ------------------------------------------------------
     def set_model(self, params, model_config: ModelConfig) -> None:
@@ -165,6 +175,7 @@ class JaxDecodeEngine(InferenceEngine):
             )
             host = hf_io.load_hf_params(self.config.model_path, self.model_config)
             self.params = jax.tree.map(jnp.asarray, host)
+            self._maybe_load_vision_tower(self.config.model_path)
         self._maybe_repeat_kv_heads()
         cfg = self.model_config
         self._build_mesh()
@@ -190,6 +201,7 @@ class JaxDecodeEngine(InferenceEngine):
             self._k_cache = jax.device_put(self._k_cache, self._cache_sharding)
             self._v_cache = jax.device_put(self._v_cache, self._cache_sharding)
         self._slot_lengths = np.zeros(R, dtype=np.int32)
+        self._slot_rope_delta = np.zeros(R, dtype=np.int32)
         self._slots = [None] * R
         self._rng = jax.random.PRNGKey(self.config.random_seed)
 
@@ -213,6 +225,215 @@ class JaxDecodeEngine(InferenceEngine):
             self._executor.destroy()
         self.params = None
         self._k_cache = self._v_cache = None
+        # vision tower + compiled-fn caches hold device buffers too
+        self._vision_params = None
+        self._vision_fns.clear()
+        self._embed_prefill_fns.clear()
+        self._chunk_fns.clear()
+        self._prefill_fns.clear()
+
+    def _maybe_load_vision_tower(self, model_path: str) -> None:
+        """VLM checkpoints (config.json carries "vision_config") also load
+        their `visual.*` tower so image requests serve out of the box."""
+        import json
+        import os
+
+        cfg_path = os.path.join(model_path, "config.json")
+        if not os.path.exists(cfg_path):
+            return
+        with open(cfg_path) as f:
+            raw = json.load(f)
+        if "vision_config" not in raw:
+            return
+        from areal_tpu.models.qwen2_vl import VisionConfig
+
+        vcfg = VisionConfig.from_hf_dict(
+            {**raw["vision_config"], "hidden_size": raw["hidden_size"]}
+        )
+        rope_scaling = raw.get("rope_scaling") or {}
+        mrope = (
+            tuple(rope_scaling["mrope_section"])
+            if rope_scaling.get("type") in ("mrope", "default")
+            and "mrope_section" in rope_scaling
+            else None
+        )
+        self.set_vision_model(
+            hf_io.load_hf_vision_params(model_path, vcfg),
+            vcfg,
+            raw.get("image_token_id", 151655),
+            mrope_sections=mrope,
+        )
+        logger.info(
+            f"vision tower loaded: depth={vcfg.depth} embed={vcfg.embed_dim}"
+        )
+
+    def set_vision_model(
+        self,
+        vision_params,
+        vision_config,
+        image_token_id: int,
+        mrope_sections: tuple[int, ...] | None = None,
+    ) -> None:
+        """Install a vision tower (models/qwen2_vl.py) so requests carrying
+        `image_data` serve instead of raising. `image_data` entries are
+        preprocessed patch dicts in the HF AutoProcessor's output format:
+        {"pixel_values": [N, patch_dim] WINDOW-MAJOR rows,
+        "image_grid_thw": [n, 3]}. `mrope_sections` enables Qwen2-VL m-rope
+        position assignment (rope_scaling.mrope_section)."""
+        params = jax.tree.map(lambda x: jnp.asarray(x), vision_params)
+        if self.mesh is not None:
+            # shard the tower like the decoder (heads/mlp over tp)
+            from areal_tpu.models.qwen2_vl import vision_param_logical_axes
+            from areal_tpu.parallel import mesh as mesh_lib
+
+            rules = mesh_lib.default_rules(fsdp=False)
+            axes = vision_param_logical_axes(vision_config)
+            params = jax.tree.map(
+                lambda x, a: jax.device_put(
+                    x, mesh_lib.named_sharding(self.mesh, a, rules)
+                ),
+                params,
+                axes,
+                is_leaf=lambda x: isinstance(x, tuple),
+            )
+        self._vision_params = params
+        self._vision_config = vision_config
+        self._image_token_id = int(image_token_id)
+        self._mrope_sections = (
+            tuple(int(s) for s in mrope_sections) if mrope_sections else None
+        )
+
+    def _get_vision_fn(self, n_rows: int):
+        if n_rows not in self._vision_fns:
+            from areal_tpu.models.qwen2_vl import forward_vision
+
+            vcfg = self._vision_config
+
+            def encode(vparams, pixels, coords, valid):
+                return forward_vision(vparams, pixels, coords, vcfg, valid=valid)
+
+            self._vision_fns[n_rows] = jax.jit(encode)
+        return self._vision_fns[n_rows]
+
+    def _encode_images(self, image_data: list) -> jax.Array:
+        """HF-format patch dicts -> [K_bucket, hidden] language-space
+        embeddings. pixel_values rows are already window-major (the HF
+        processor emits them that way — no reordering here); 2D-rope coords
+        come from the same window-major permutation. Patch rows bucket to
+        multiples of merge^2*16 and the merged output pads to a multiple of
+        64, so both jit caches stay small across image sizes."""
+        from areal_tpu.models.qwen2_vl import patch_grid_coords
+
+        vcfg = self._vision_config
+        pv = np.concatenate(
+            [np.asarray(d["pixel_values"], dtype=np.float32) for d in image_data]
+        )
+        thw = np.concatenate(
+            [np.asarray(d["image_grid_thw"]).reshape(-1, 3) for d in image_data]
+        )
+        coords = patch_grid_coords(thw, vcfg.spatial_merge_size)
+        n = pv.shape[0]
+        m2 = vcfg.spatial_merge_size**2
+        bucket = -(-n // (m2 * 16)) * (m2 * 16)
+        valid = np.zeros(bucket, dtype=bool)
+        valid[:n] = True
+        pv_p = np.zeros((bucket, pv.shape[1]), dtype=np.float32)
+        pv_p[:n] = pv
+        co_p = np.zeros((bucket, 2), dtype=np.int32)
+        co_p[:n] = coords
+        embeds = self._get_vision_fn(bucket)(
+            self._vision_params,
+            jnp.asarray(pv_p, dtype=jnp.dtype(self.config.dtype)),
+            jnp.asarray(co_p),
+            jnp.asarray(valid),
+        )
+        k = n // m2
+        k_bucket = -(-k // 64) * 64
+        # pad the embed count too: the splice ignores rows past the true
+        # image-token count, and a fixed K keyset avoids one prefill
+        # compile per image size
+        out = jnp.zeros((k_bucket, embeds.shape[1]), embeds.dtype)
+        return jax.lax.dynamic_update_slice(out, embeds[:k], (0, 0))
+
+    def _image_rope_tables(self, prompt: list[int], image_data: list, bucket: int):
+        """(cos, sin) [bucket, hd/2] + rope delta for a multimodal prompt.
+
+        With mrope_sections: HF get_rope_index semantics (image spans get
+        3-D grid positions, text resumes at span-max + 1; models/qwen2_vl.
+        mrope_positions). Without: standard 1-D positions."""
+        from areal_tpu.models.qwen2_vl import mrope_positions, mrope_table
+
+        cfg = self.model_config
+        if self._mrope_sections is None:
+            pos3 = np.broadcast_to(
+                np.arange(bucket, dtype=np.int32), (3, bucket)
+            )
+            delta = 0
+        else:
+            thw = np.concatenate(
+                [
+                    np.asarray(d["image_grid_thw"]).reshape(-1, 3)
+                    for d in image_data
+                ]
+            )
+            pos, delta = mrope_positions(
+                np.asarray(prompt, dtype=np.int64),
+                thw,
+                self._image_token_id,
+                self._vision_config.spatial_merge_size,
+            )
+            pos3 = np.zeros((3, bucket), dtype=np.int32)
+            n = min(pos.shape[1], bucket)
+            pos3[:, :n] = pos[:, :n]
+            if bucket > n:  # pad tail: continue scalar positions (masked)
+                cont = pos[:, n - 1].max() + 1 + np.arange(bucket - n)
+                pos3[:, n:] = cont[None, :]
+        sections = self._mrope_sections or (cfg.head_dim_ // 2,)
+        cos, sin = mrope_table(pos3, cfg.head_dim_, cfg.rope_theta, sections)
+        return cos, sin, int(delta)
+
+    def _get_embed_prefill_fn(self, bucket: int, k_img: int):
+        """Prefill from embeddings with vision vectors spliced over the
+        image-pad positions and host-provided (m-)rope tables."""
+        key = (bucket, k_img)
+        if key not in self._embed_prefill_fns:
+            from areal_tpu.models.qwen2_vl import splice_image_embeds
+
+            cfg = self.model_config
+            img_tok = self._image_token_id
+
+            def prefill_and_write(
+                params, kc, vc, ids, positions, slot, true_len, img_embeds,
+                cos, sin,
+            ):
+                valid = jnp.arange(ids.shape[0]) < true_len
+                embeds = params["embed"]["embedding"][ids].astype(
+                    jnp.dtype(cfg.dtype)
+                )
+                embeds = splice_image_embeds(embeds, ids, img_embeds, img_tok)
+                _, k, v = prefill(
+                    params,
+                    ids,
+                    positions,
+                    cfg,
+                    valid=valid,
+                    with_logits=False,
+                    input_embeds=embeds,
+                    rope_cos=cos,
+                    rope_sin=sin,
+                )
+                kc = jax.lax.dynamic_update_slice(
+                    kc, k[:, None].astype(kc.dtype), (0, slot, 0, 0, 0)
+                )
+                vc = jax.lax.dynamic_update_slice(
+                    vc, v[:, None].astype(vc.dtype), (0, slot, 0, 0, 0)
+                )
+                return kc, vc
+
+            self._embed_prefill_fns[key] = jax.jit(
+                prefill_and_write, donate_argnums=(1, 2)
+            )
+        return self._embed_prefill_fns[key]
 
     # -- jitted programs -----------------------------------------------
     def _maybe_repeat_kv_heads(self):
@@ -382,11 +603,12 @@ class JaxDecodeEngine(InferenceEngine):
             logp = jnp.take_along_axis(logprobs_all, tok[:, None], axis=-1)[:, 0]
             return tok, logp, key
 
-        def chunk(params, kc, vc, last_tokens, lengths, active, key, temps, top_ps, greedy):
+        def chunk(params, kc, vc, last_tokens, lengths, active, key, temps, top_ps, greedy, rope_delta):
             def step(carry, _):
                 tokens, lengths, kc, vc, key = carry
                 logits, kc, vc = decode_step(
-                    params, tokens, lengths, kc, vc, cfg, active=active
+                    params, tokens, lengths, kc, vc, cfg, active=active,
+                    rope_offset=rope_delta,
                 )
                 tok, logp, key = sample(logits, key, temps, top_ps, greedy)
                 tok = jnp.where(active, tok, tokens)
@@ -538,6 +760,8 @@ class JaxDecodeEngine(InferenceEngine):
                 slot_idx = free[0]
             else:
                 slot_idx = resumed
+            if resumed is None:
+                self._slot_rope_delta[slot_idx] = 0  # vision prefill resets it
             if resumed is None and P > 1:
                 pre = P - 1
                 bucket = min(_next_bucket(pre), self.config.context_length)
@@ -546,17 +770,40 @@ class JaxDecodeEngine(InferenceEngine):
                 ids = np.zeros(bucket, dtype=np.int32)
                 ids[:pre] = prompt[:-1]
                 positions = np.arange(bucket, dtype=np.int32)
-                fn = self._get_prefill_fn(bucket)
-                with self._weight_lock:
-                    self._k_cache, self._v_cache = fn(
-                        self.params,
-                        self._k_cache,
-                        self._v_cache,
-                        jnp.asarray(ids),
-                        jnp.asarray(positions),
-                        slot_idx,
-                        pre,
+                if item.image_data:
+                    img_embeds = self._encode_images(item.image_data)
+                    cos, sin, delta = self._image_rope_tables(
+                        prompt, item.image_data, bucket
                     )
+                    self._slot_rope_delta[slot_idx] = delta
+                    fn = self._get_embed_prefill_fn(
+                        bucket, int(img_embeds.shape[0])
+                    )
+                    with self._weight_lock:
+                        self._k_cache, self._v_cache = fn(
+                            self.params,
+                            self._k_cache,
+                            self._v_cache,
+                            jnp.asarray(ids),
+                            jnp.asarray(positions),
+                            slot_idx,
+                            pre,
+                            img_embeds,
+                            cos,
+                            sin,
+                        )
+                else:
+                    fn = self._get_prefill_fn(bucket)
+                    with self._weight_lock:
+                        self._k_cache, self._v_cache = fn(
+                            self.params,
+                            self._k_cache,
+                            self._v_cache,
+                            jnp.asarray(ids),
+                            jnp.asarray(positions),
+                            slot_idx,
+                            pre,
+                        )
             self._slots[slot_idx] = item
             self._slot_lengths[slot_idx] = P - 1
             admitted = True
@@ -707,6 +954,7 @@ class JaxDecodeEngine(InferenceEngine):
                 jnp.asarray(temps),
                 jnp.asarray(top_ps),
                 jnp.asarray(greedy),
+                jnp.asarray(self._slot_rope_delta),
             )
         toks = np.asarray(toks)  # [n_chunk, R]
         logps = np.asarray(logps)
@@ -732,13 +980,14 @@ class JaxDecodeEngine(InferenceEngine):
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         if self._thread_exc is not None:
             raise RuntimeError("decode engine crashed") from self._thread_exc
-        if req.image_data:
+        if req.image_data and self._vision_params is None:
             # Explicit failure beats silently generating image-blind text:
-            # this engine decodes the text families (qwen2/qwen3/llama); VLM
-            # decode needs a vision-tower model family.
+            # vision requests need a tower installed via set_vision_model
+            # (or an HF checkpoint with a vision_config).
             raise NotImplementedError(
-                "JaxDecodeEngine does not decode image inputs yet; route "
-                "vision requests to a VLM-capable backend"
+                "JaxDecodeEngine has no vision tower installed; call "
+                "set_vision_model() (models/qwen2_vl.py) to serve image "
+                "inputs"
             )
         loop = asyncio.get_running_loop()
         future: asyncio.Future = loop.create_future()
@@ -748,6 +997,7 @@ class JaxDecodeEngine(InferenceEngine):
             gconfig=req.gconfig,
             future=future,
             loop=loop,
+            image_data=req.image_data,
         )
         self._request_q.put(item)
         return await future

@@ -330,3 +330,117 @@ def save_hf_params(params: dict, cfg: ModelConfig, out_dir: str) -> str:
         tensors[hf_name] = np.ascontiguousarray(arr)
     save_file(tensors, os.path.join(out_dir, "model.safetensors"))
     return out_dir
+
+
+# ---------------------------------------------------------------------------
+# Vision tower (Qwen2-VL / Qwen2.5-VL) weight loading: HF `visual.*` names →
+# areal_tpu/models/qwen2_vl.py param tree. Strict: any `visual.*` tensor the
+# mapping does not recognize raises — silently dropping weights (LayerNorm
+# biases, SwiGLU up_proj) would produce a wrong architecture that loads
+# "successfully".
+# ---------------------------------------------------------------------------
+
+
+def load_hf_vision_params(model_dir: str, vcfg) -> dict:
+    """Load `visual.*` tensors from an HF checkpoint dir into the vision
+    param tree (see qwen2_vl.vision_param_shapes)."""
+    import re
+
+    D = vcfg.embed_dim
+    nH, hd = vcfg.num_heads, vcfg.head_dim
+    L = vcfg.depth
+    blocks: dict = {}
+    out: dict = {"patch_embed": {}, "merger": {}}
+    stacks: dict[tuple[str, ...], list] = {}
+    unmatched: list[str] = []
+
+    def stash(path, i, w):
+        stacks.setdefault(path, [None] * L)[i] = w
+
+    top = {
+        "visual.patch_embed.proj.weight": (
+            # conv (D, C, t, p, p) -> matmul kernel [C*t*p*p, D]
+            lambda w: out["patch_embed"].__setitem__("kernel", w.reshape(D, -1).T)
+        ),
+        "visual.merger.ln_q.weight": (
+            lambda w: out["merger"].setdefault("ln_q", {}).__setitem__("scale", w)
+        ),
+        "visual.merger.ln_q.bias": (
+            lambda w: out["merger"].setdefault("ln_q", {}).__setitem__("bias", w)
+        ),
+        "visual.merger.mlp.0.weight": (
+            lambda w: out["merger"].__setitem__("fc1_kernel", w.T)
+        ),
+        "visual.merger.mlp.0.bias": (
+            lambda w: out["merger"].__setitem__("fc1_bias", w)
+        ),
+        "visual.merger.mlp.2.weight": (
+            lambda w: out["merger"].__setitem__("fc2_kernel", w.T)
+        ),
+        "visual.merger.mlp.2.bias": (
+            lambda w: out["merger"].__setitem__("fc2_bias", w)
+        ),
+    }
+    block_map = {
+        "norm1.weight": (("norm1", "scale"), lambda w: w),
+        "norm1.bias": (("norm1", "bias"), lambda w: w),
+        "norm2.weight": (("norm2", "scale"), lambda w: w),
+        "norm2.bias": (("norm2", "bias"), lambda w: w),
+        "attn.qkv.weight": (
+            ("attn", "qkv_kernel"),
+            lambda w: w.reshape(3, nH, hd, D).transpose(3, 0, 1, 2),
+        ),
+        "attn.qkv.bias": (
+            ("attn", "qkv_bias"),
+            lambda w: w.reshape(3, nH, hd),
+        ),
+        "attn.proj.weight": (
+            ("attn", "proj_kernel"),
+            lambda w: w.T.reshape(nH, hd, D),
+        ),
+        "attn.proj.bias": (("attn", "proj_bias"), lambda w: w),
+        # Qwen2-VL gelu MLP
+        "mlp.fc1.weight": (("mlp", "fc1_kernel"), lambda w: w.T),
+        "mlp.fc1.bias": (("mlp", "fc1_bias"), lambda w: w),
+        "mlp.fc2.weight": (("mlp", "fc2_kernel"), lambda w: w.T),
+        "mlp.fc2.bias": (("mlp", "fc2_bias"), lambda w: w),
+        # Qwen2.5-VL SwiGLU MLP
+        "mlp.gate_proj.weight": (("mlp", "gate_kernel"), lambda w: w.T),
+        "mlp.gate_proj.bias": (("mlp", "gate_bias"), lambda w: w),
+        "mlp.up_proj.weight": (("mlp", "up_kernel"), lambda w: w.T),
+        "mlp.up_proj.bias": (("mlp", "up_bias"), lambda w: w),
+        "mlp.down_proj.weight": (("mlp", "down_kernel"), lambda w: w.T),
+        "mlp.down_proj.bias": (("mlp", "down_bias"), lambda w: w),
+    }
+
+    for name, w in _iter_hf_tensors(model_dir):
+        if not name.startswith("visual."):
+            continue
+        w = np.asarray(w)
+        if name in top:
+            top[name](w)
+            continue
+        m = re.match(r"visual\.blocks\.(\d+)\.(.+)", name)
+        if m and m.group(2) in block_map:
+            path, conv = block_map[m.group(2)]
+            stash(path, int(m.group(1)), conv(w))
+            continue
+        unmatched.append(name)
+
+    if unmatched:
+        raise ValueError(
+            "unrecognized visual.* tensors (vision architecture not "
+            f"supported by this loader): {sorted(unmatched)[:8]}..."
+        )
+    for path, ws in stacks.items():
+        missing = [i for i, x in enumerate(ws) if x is None]
+        if missing:
+            raise ValueError(
+                f"vision blocks missing layer(s) {missing} for {path}"
+            )
+        node = blocks
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = np.stack(ws)
+    out["blocks"] = blocks
+    return out

@@ -796,6 +796,9 @@ def prefill(
     cfg: ModelConfig,
     valid: jax.Array | None = None,
     with_logits: bool = True,
+    input_embeds: jax.Array | None = None,
+    rope_cos: jax.Array | None = None,
+    rope_sin: jax.Array | None = None,
 ) -> tuple[jax.Array | None, jax.Array, jax.Array]:
     """Causal forward over ONE sequence [T], returning (logits [T, V],
     k_cache [L, T, nKV, hd], v_cache [L, T, nKV, hd]).
@@ -807,10 +810,22 @@ def prefill(
     `with_logits=False` skips the lm_head projection and returns None
     logits — the cache-warm path: the decode engine samples every token
     (including the first) inside its chunked decode loop, so prefill only
-    needs to write KV."""
+    needs to write KV.
+
+    `input_embeds` [T, H] overrides the token-embedding lookup — the
+    multimodal path: the decode engine splices vision-tower outputs over
+    image-pad positions (models/qwen2_vl.splice_image_embeds) and
+    prefills from embeddings. `rope_cos/rope_sin` [T, hd/2] override the
+    1-D rope tables (Qwen2-VL m-rope, models/qwen2_vl.mrope_table)."""
     compute_dtype = jnp.dtype(cfg.dtype)
-    x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
-    cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta)
+    if input_embeds is not None:
+        x = input_embeds.astype(compute_dtype)
+    else:
+        x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
+    if rope_cos is not None:
+        cos, sin = rope_cos, rope_sin
+    else:
+        cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta)
     T = input_ids.shape[0]
     causal = jnp.tril(jnp.ones((T, T), dtype=bool))
     nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -866,6 +881,7 @@ def decode_step(
     v_cache: jax.Array,  # [L, R, S, nKV, hd]
     cfg: ModelConfig,
     active: jax.Array | None = None,  # [R] bool: slot holds a live request
+    rope_offset: jax.Array | None = None,  # [R] added to rope pos only
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One batched decode step over R slots.
 
@@ -873,6 +889,13 @@ def decode_step(
     per slot. Returns (logits [R, V], k_cache, v_cache). `active` keeps
     MoE routing of dead slots from claiming expert capacity shared with
     live ones.
+
+    `rope_offset` shifts the ROTARY position only (cache index unchanged):
+    Qwen2-VL m-rope compresses an image's positions to max(t, h, w) per
+    span, so a VLM slot's text position = cache_len + per-request delta.
+    Text tokens under m-rope use one scalar for all three sections, which
+    reduces exactly to standard 1-D rope at that scalar — so the shared
+    decode step stays mrope-correct with just this offset.
     """
     compute_dtype = jnp.dtype(cfg.dtype)
     R = tokens.shape[0]
@@ -880,7 +903,8 @@ def decode_step(
     nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
     group = nH // nKV
     x = params["embed"]["embedding"][tokens].astype(compute_dtype)  # [R, H]
-    cos, sin = rope_table(positions, cfg.head_dim_, cfg.rope_theta)  # [R, hd/2]
+    rope_pos = positions if rope_offset is None else positions + rope_offset
+    cos, sin = rope_table(rope_pos, cfg.head_dim_, cfg.rope_theta)  # [R, hd/2]
     valid = jnp.arange(S)[None, :] <= positions[:, None]  # [R, S]
 
     def write(cache_l, new):  # [R, S, nKV, hd] <- [R, nKV, hd]
