@@ -42,8 +42,6 @@ class VisionRLVRWorkflow(RLVRWorkflow):
         self.processor = processor
 
     def _encode_prompt(self, data: dict[str, Any]) -> list[int]:
-        if "input_ids" in data:
-            return list(np.asarray(data["input_ids"]).reshape(-1))
         if self.processor is not None and "messages" in data:
             text = self.processor.apply_chat_template(
                 data["messages"],
@@ -54,17 +52,38 @@ class VisionRLVRWorkflow(RLVRWorkflow):
             enc = self.processor(
                 text=[text], images=data.get("images"), return_tensors="np"
             )
+            # Stash the processed patches so _build_request ships them in
+            # the format the decode engine's vision tower consumes
+            # (JaxDecodeEngine.set_vision_model docstring): window-major
+            # pixel rows + grid_thw.
+            if "pixel_values" in enc:
+                self._last_pixels = dict(
+                    pixel_values=np.asarray(enc["pixel_values"]),
+                    image_grid_thw=np.asarray(enc["image_grid_thw"]),
+                )
+            else:
+                self._last_pixels = None
             return list(np.asarray(enc["input_ids"]).reshape(-1))
+        self._last_pixels = None
+        if "input_ids" in data:
+            return list(np.asarray(data["input_ids"]).reshape(-1))
         return super()._encode_prompt(data)
 
     def _build_request(
         self, data: dict[str, Any], prompt_ids: list[int]
     ) -> ModelRequest:
-        images = data.get("images")
+        pixels = getattr(self, "_last_pixels", None)
+        if pixels is None and data.get("images") is not None:
+            # no processor: pass through whatever the dataset supplies
+            # (already-processed patch dicts, or raw images for an HTTP
+            # backend whose server owns the processor)
+            image_data = list(data["images"])
+        else:
+            image_data = [pixels] if pixels is not None else None
         return ModelRequest(
             rid=str(uuid.uuid4()),
             input_ids=prompt_ids,
             gconfig=self.gconfig.new(n_samples=1),
             tokenizer=self.tokenizer,
-            image_data=list(images) if images is not None else None,
+            image_data=image_data,
         )
