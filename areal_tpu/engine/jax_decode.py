@@ -76,6 +76,7 @@ class _Slot:
     future: "asyncio.Future | None"
     loop: Any
     image_data: list | None = None
+    stop_checked: int = 0  # tokens already scanned for stop strings
     tokens: list[int] = field(default_factory=list)
     logprobs: list[float] = field(default_factory=list)
     versions: list[int] = field(default_factory=list)
@@ -147,6 +148,7 @@ class JaxDecodeEngine(InferenceEngine):
         self._vision_fns: dict[int, Callable] = {}
         self._embed_prefill_fns: dict[tuple[int, int], Callable] = {}
         self._slot_rope_delta = None  # np [R]: mrope position offsets
+        self._freq_counts = None  # jnp [R, V]: frequency-penalty counts
 
     # -- lifecycle ------------------------------------------------------
     def set_model(self, params, model_config: ModelConfig) -> None:
@@ -202,6 +204,7 @@ class JaxDecodeEngine(InferenceEngine):
             self._v_cache = jax.device_put(self._v_cache, self._cache_sharding)
         self._slot_lengths = np.zeros(R, dtype=np.int32)
         self._slot_rope_delta = np.zeros(R, dtype=np.int32)
+        self._slot_used_freq = np.zeros(R, dtype=bool)
         self._slots = [None] * R
         self._rng = jax.random.PRNGKey(self.config.random_seed)
 
@@ -227,6 +230,7 @@ class JaxDecodeEngine(InferenceEngine):
         self._k_cache = self._v_cache = None
         # vision tower + compiled-fn caches hold device buffers too
         self._vision_params = None
+        self._freq_counts = None
         self._vision_fns.clear()
         self._embed_prefill_fns.clear()
         self._chunk_fns.clear()
@@ -560,8 +564,8 @@ class JaxDecodeEngine(InferenceEngine):
             self.mesh, P(None, None, None, kv_axis, None)
         )
 
-    def _get_chunk_fn(self, use_topp: bool):
-        """Chunked decode loop; two static sampler variants.
+    def _get_chunk_fn(self, use_topp: bool, use_freq: bool = False):
+        """Chunked decode loop; static sampler variants.
 
         `use_topp=False` (the common RL rollout setting, top_p == 1):
         plain categorical over temperature-scaled logits. `use_topp=True`:
@@ -570,9 +574,14 @@ class JaxDecodeEngine(InferenceEngine):
         was the round-1 decode bottleneck; the tail mass beyond the top 64
         of a trained LM at top_p < 1 is negligible. Reported logprobs are
         always exact log-softmax over the FULL vocab for the chosen token.
+
+        `use_freq`: frequency penalty (OpenAI semantics — logits minus
+        penalty * per-token generation counts); the [R, V] count buffer
+        only exists for batches where some slot requested it.
         """
-        if use_topp in self._chunk_fns:
-            return self._chunk_fns[use_topp]
+        key_ = (use_topp, use_freq)
+        if key_ in self._chunk_fns:
+            return self._chunk_fns[key_]
         cfg = self.model_config
         n_chunk = self.config.new_tokens_per_chunk
 
@@ -603,28 +612,50 @@ class JaxDecodeEngine(InferenceEngine):
             logp = jnp.take_along_axis(logprobs_all, tok[:, None], axis=-1)[:, 0]
             return tok, logp, key
 
-        def chunk(params, kc, vc, last_tokens, lengths, active, key, temps, top_ps, greedy, rope_delta):
-            def step(carry, _):
-                tokens, lengths, kc, vc, key = carry
-                logits, kc, vc = decode_step(
-                    params, tokens, lengths, kc, vc, cfg, active=active,
-                    rope_offset=rope_delta,
+        # ONE step body for both variants: use_freq is python-static, so the
+        # counts carry and the penalty lines only trace when requested —
+        # shared decode logic cannot diverge between the two compiled fns.
+        def make_chunk(freq: bool):
+            def chunk(params, kc, vc, last_tokens, lengths, active, key,
+                      temps, top_ps, greedy, rope_delta, *freq_args):
+                freq_pens, counts0 = freq_args if freq else (None, None)
+
+                def step(carry, _):
+                    tokens, lengths, kc, vc, key, counts = carry
+                    logits, kc, vc = decode_step(
+                        params, tokens, lengths, kc, vc, cfg, active=active,
+                        rope_offset=rope_delta,
+                    )
+                    if freq:
+                        logits = logits - freq_pens[:, None] * counts
+                    tok, logp, key = sample(logits, key, temps, top_ps, greedy)
+                    tok = jnp.where(active, tok, tokens)
+                    if freq:
+                        counts = counts + jax.nn.one_hot(
+                            tok, counts.shape[-1], dtype=counts.dtype
+                        ) * active[:, None].astype(counts.dtype)
+                    lengths = lengths + active.astype(lengths.dtype)
+                    return (tok, lengths, kc, vc, key, counts), (tok, logp)
+
+                init = (
+                    last_tokens, lengths, kc, vc, key,
+                    counts0 if freq else jnp.zeros((), jnp.float32),
                 )
-                tok, logp, key = sample(logits, key, temps, top_ps, greedy)
-                tok = jnp.where(active, tok, tokens)
-                lengths = lengths + active.astype(lengths.dtype)
-                return (tok, lengths, kc, vc, key), (tok, logp)
+                (last, lengths, kc, vc, key, counts), (toks, logps) = (
+                    jax.lax.scan(step, init, None, length=n_chunk)
+                )
+                if freq:
+                    return kc, vc, last, lengths, key, toks, logps, counts
+                return kc, vc, last, lengths, key, toks, logps
 
-            (last, lengths, kc, vc, key), (toks, logps) = jax.lax.scan(
-                step,
-                (last_tokens, lengths, kc, vc, key),
-                None,
-                length=n_chunk,
-            )
-            return kc, vc, last, lengths, key, toks, logps
+            return chunk
 
-        self._chunk_fns[use_topp] = jax.jit(chunk, donate_argnums=(1, 2))
-        return self._chunk_fns[use_topp]
+        fn = jax.jit(
+            make_chunk(use_freq),
+            donate_argnums=(1, 2, 12) if use_freq else (1, 2),
+        )
+        self._chunk_fns[key_] = fn
+        return fn
 
     def _get_prefill_fn(self, bucket: int):
         """Cache-warm only: writes the prompt's KV rows at a slot offset.
@@ -762,6 +793,13 @@ class JaxDecodeEngine(InferenceEngine):
                 slot_idx = resumed
             if resumed is None:
                 self._slot_rope_delta[slot_idx] = 0  # vision prefill resets it
+                if self._freq_counts is not None and self._slot_used_freq[slot_idx]:
+                    # slot reuse must not inherit the previous request's
+                    # frequency-penalty counts (reset only slots that
+                    # actually accumulated counts — the .at[].set is a
+                    # full-buffer copy on device)
+                    self._freq_counts = self._freq_counts.at[slot_idx].set(0.0)
+                    self._slot_used_freq[slot_idx] = False
             if resumed is None and P > 1:
                 pre = P - 1
                 bucket = min(_next_bucket(pre), self.config.context_length)
@@ -823,19 +861,51 @@ class JaxDecodeEngine(InferenceEngine):
             return True
         return False
 
+    def _stop_string_boundary(self, item: _Slot) -> int | None:
+        """Earliest token count whose decoded prefix contains a stop string.
+
+        Incremental: only the tail since `item.stop_checked` (with a small
+        token overlap for strings spanning the chunk boundary) is decoded,
+        so the scheduler thread does O(chunk) host work per chunk instead
+        of O(total) (reviewed hot-loop cost)."""
+        g = item.gconfig
+        if not g.stop or self.tokenizer is None or not item.tokens:
+            return None
+        overlap = 16  # tokens; covers realistic stop-string lengths
+        window_start = max(0, item.stop_checked - overlap)
+        tail = self.tokenizer.decode(item.tokens[window_start:])
+        item.stop_checked = len(item.tokens)
+        if not any(s in tail for s in g.stop):
+            return None
+        lo = max(window_start, g.min_new_tokens - 1)
+        for i in range(lo, len(item.tokens)):
+            prefix = self.tokenizer.decode(item.tokens[window_start : i + 1])
+            if any(s in prefix for s in g.stop):
+                return i + 1
+        return None
+
     def _truncate_at_stop(self, item: _Slot) -> None:
-        """Trim tokens generated past the first stop token inside a chunk."""
+        """Trim tokens generated past the first stop criterion inside a
+        chunk — stop token ids AND stop strings both checked, the EARLIER
+        boundary wins (a late eos must not preempt an early stop string)."""
         g = item.gconfig
         stop_ids = set(g.stop_token_ids or [])
         if self.tokenizer is not None and getattr(self.tokenizer, "eos_token_id", None) is not None:
             stop_ids.add(self.tokenizer.eos_token_id)
+        tok_cut = None
         for i, t in enumerate(item.tokens):
             if t in stop_ids and (i + 1) >= g.min_new_tokens:
-                del item.tokens[i + 1 :]
-                del item.logprobs[i + 1 :]
-                del item.versions[i + 1 :]
-                item.stop_reason = "stop"
-                return
+                tok_cut = i + 1
+                break
+        str_cut = self._stop_string_boundary(item)
+        cuts = [c for c in (tok_cut, str_cut) if c is not None]
+        if cuts:
+            cut = min(cuts)
+            del item.tokens[cut:]
+            del item.logprobs[cut:]
+            del item.versions[cut:]
+            item.stop_reason = "stop"
+            return
         if len(item.tokens) >= g.max_new_tokens:
             del item.tokens[g.max_new_tokens :]
             del item.logprobs[g.max_new_tokens :]
@@ -931,19 +1001,17 @@ class JaxDecodeEngine(InferenceEngine):
                 for s in self._slots
             )
         )
-        chunk_fn = self._get_chunk_fn(use_topp)
+        use_freq = bool(
+            any(
+                s is not None and s.gconfig.frequency_penalty != 0.0
+                for s in self._slots
+            )
+        )
+        chunk_fn = self._get_chunk_fn(use_topp, use_freq)
         version_at_chunk = self._version
         with self._weight_lock:
             self._rng, sub = jax.random.split(self._rng)
-            (
-                self._k_cache,
-                self._v_cache,
-                _,
-                lengths_out,
-                _,
-                toks,
-                logps,
-            ) = chunk_fn(
+            args = [
                 self.params,
                 self._k_cache,
                 self._v_cache,
@@ -955,7 +1023,41 @@ class JaxDecodeEngine(InferenceEngine):
                 jnp.asarray(top_ps),
                 jnp.asarray(greedy),
                 jnp.asarray(self._slot_rope_delta),
-            )
+            ]
+            if use_freq:
+                freq_pens = np.zeros(R, dtype=np.float32)
+                for i, s in enumerate(self._slots):
+                    if s is not None:
+                        freq_pens[i] = s.gconfig.frequency_penalty
+                        if s.gconfig.frequency_penalty != 0.0:
+                            self._slot_used_freq[i] = True
+                if self._freq_counts is None:
+                    self._freq_counts = jnp.zeros(
+                        (R, self.model_config.vocab_size), jnp.float32
+                    )
+                out = chunk_fn(
+                    *args, jnp.asarray(freq_pens), self._freq_counts
+                )
+                (
+                    self._k_cache,
+                    self._v_cache,
+                    _,
+                    lengths_out,
+                    _,
+                    toks,
+                    logps,
+                    self._freq_counts,
+                ) = out
+            else:
+                (
+                    self._k_cache,
+                    self._v_cache,
+                    _,
+                    lengths_out,
+                    _,
+                    toks,
+                    logps,
+                ) = chunk_fn(*args)
         toks = np.asarray(toks)  # [n_chunk, R]
         logps = np.asarray(logps)
         self._slot_lengths = np.asarray(lengths_out).copy()
@@ -980,6 +1082,11 @@ class JaxDecodeEngine(InferenceEngine):
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         if self._thread_exc is not None:
             raise RuntimeError("decode engine crashed") from self._thread_exc
+        if req.gconfig.stop and self.tokenizer is None:
+            raise ValueError(
+                "gconfig.stop (stop strings) requires the engine to be "
+                "constructed with a tokenizer; use stop_token_ids otherwise"
+            )
         if req.image_data and self._vision_params is None:
             # Explicit failure beats silently generating image-blind text:
             # vision requests need a tower installed via set_vision_model

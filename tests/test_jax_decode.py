@@ -367,3 +367,84 @@ def test_prefill_budget_bounds_admission(cpu_devices):
             _time.sleep(0.05)
     finally:
         eng.destroy()
+
+
+def test_stop_strings(cpu_devices):
+    """Stop STRINGS (gconfig.stop) truncate generation at the earliest
+    token boundary whose decoded prefix contains the string."""
+
+    class DigitTok:
+        eos_token_id = None
+
+        def decode(self, ids):
+            return "".join(str(i % 10) for i in ids)
+
+    cfg = JaxDecodeConfig(
+        context_length=64,
+        max_running_requests=2,
+        new_tokens_per_chunk=4,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig(), tokenizer=DigitTok())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    try:
+        prompt = [1, 5, 9, 13, 2]
+        full = greedy_reference(eng.params, prompt, 8)
+        text = "".join(str(t % 10) for t in full)
+        stop_s = text[2:4]  # a substring that first completes at token 4
+        # precondition: the substring must not occur earlier, or the
+        # expected boundary below is wrong (guards against TINY changes)
+        assert stop_s not in text[:3]
+        resp = eng.generate(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(
+                    greedy=True, max_new_tokens=8, stop=[stop_s]
+                ),
+            ),
+            timeout=600,
+        )
+        assert resp.stop_reason == "stop"
+        assert resp.output_tokens == full[:4]
+    finally:
+        eng.destroy()
+
+
+def test_frequency_penalty_reduces_repeats(cpu_devices):
+    """A strong frequency penalty must strictly reduce token repetition vs
+    the unpenalized run (same seed)."""
+    def run(freq):
+        cfg = JaxDecodeConfig(
+            context_length=96,
+            max_running_requests=1,
+            new_tokens_per_chunk=8,
+            dtype="float32",
+            kv_cache_dtype="float32",
+            random_seed=11,
+        )
+        eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+        eng.set_model(init_params(TINY, jax.random.PRNGKey(2)), TINY)
+        eng.initialize()
+        try:
+            resp = eng.generate(
+                ModelRequest(
+                    input_ids=[3, 7, 11],
+                    gconfig=GenerationHyperparameters(
+                        max_new_tokens=48,
+                        temperature=0.3,  # peaked -> repetitive baseline
+                        frequency_penalty=freq,
+                    ),
+                ),
+                timeout=600,
+            )
+            return resp.output_tokens
+        finally:
+            eng.destroy()
+
+    base = run(0.0)
+    pen = run(8.0)  # forceful penalty on a 64-token vocab
+    uniq_base = len(set(base)) / len(base)
+    uniq_pen = len(set(pen)) / len(pen)
+    assert uniq_pen > uniq_base, (uniq_base, uniq_pen)
