@@ -63,13 +63,16 @@ class DistributedBatchMemory:
 
     # -- splitting ------------------------------------------------------
     def chunk(self, n: int) -> list["DistributedBatchMemory"]:
-        """Even split into n contiguous chunks (batch must divide by n)."""
+        """Split into n contiguous chunks; remainder rows spread over the
+        leading chunks (np.array_split semantics — the reference's
+        DistributedBatch chunks unevenly rather than asserting)."""
         B = self.batch_size
-        assert B % n == 0, (B, n)
-        step = B // n
+        bounds = np.cumsum(
+            [0] + [B // n + (1 if i < B % n else 0) for i in range(n)]
+        )
         return [
             DistributedBatchMemory(
-                {k: v[i * step : (i + 1) * step] for k, v in self.data.items()}
+                {k: v[bounds[i] : bounds[i + 1]] for k, v in self.data.items()}
             )
             for i in range(n)
         ]

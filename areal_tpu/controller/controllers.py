@@ -1,0 +1,249 @@
+"""Single-controller mode: Train/Rollout controllers over RPC workers.
+
+Parity: areal/api/controller_api.py:206 (TrainController) and :454
+(RolloutController) — the experimental non-SPMD mode where a controller
+process owns the loop and engines live in scheduler-spawned workers,
+reached through the RPC pair (areal_tpu/scheduler/rpc/). The controllers
+mirror the TrainEngine / InferenceEngine surfaces so algorithm code (e.g.
+PPOActor) runs unchanged against a worker fleet:
+
+    sched = LocalScheduler()
+    ctl = TrainController(sched, "areal_tpu.engine.sft.lm_engine:JaxLMEngine",
+                          config)
+    ctl.create_workers(n_workers=2)
+    ctl.initialize(None, ft_spec)
+    stats = ctl.train_batch(batch, ...)   # DistributedBatchMemory chunks
+                                          # fan out per DP worker
+
+Fan-out is CONCURRENT (one thread per worker): collective-entering methods
+like create_process_group block inside each worker until all processes
+join — sequential dispatch would deadlock a multi-host fleet, and even
+compute fan-out must overlap or N workers take N x wall-clock.
+
+TPU shape notes: each worker is ONE process driving its own chips under
+GSPMD, so the controller's DP fan-out is across workers (the reference
+fans out across GPU ranks). Results reduce on the controller
+(token-weighted means for train/eval)."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.scheduler_api import Scheduler, SchedulingSpec
+from areal_tpu.controller.batch import DistributedBatchMemory
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("controller")
+
+
+class _WorkerFleet:
+    """Shared fleet lifecycle + concurrent dispatch for both controllers."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        engine_type: str,
+        engine_config: Any,
+        role: str,
+        spec: SchedulingSpec | None,
+    ):
+        self.scheduler = scheduler
+        self.engine_type = engine_type
+        self.engine_config = engine_config
+        self.role = role
+        self.spec = spec or SchedulingSpec()
+        self.worker_ids: list[str] = []
+        self._pool: ThreadPoolExecutor | None = None
+
+    def create_workers(self, n_workers: int, timeout: float = 120.0) -> None:
+        self.worker_ids = self.scheduler.create_workers(
+            self.role, self.spec, n_workers
+        )
+        self.scheduler.get_workers(self.role, timeout=timeout)
+        self._pool = ThreadPoolExecutor(
+            max_workers=n_workers, thread_name_prefix=f"{self.role}-rpc"
+        )
+        self._scatter(
+            lambda wid: self.scheduler.create_engine(
+                wid, self.engine_type, self.engine_config
+            )
+        )
+
+    def destroy(self) -> None:
+        self.scheduler.delete_workers(self.role)
+        self.worker_ids = []
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def _scatter(self, fn: Callable[[str], Any]) -> list[Any]:
+        """fn(worker_id) on EVERY worker concurrently; results in worker
+        order; first exception re-raised."""
+        assert self.worker_ids, "create_workers first"
+        futures = [self._pool.submit(fn, wid) for wid in self.worker_ids]
+        return [f.result() for f in futures]
+
+    def _all(self, method: str, *args, **kwargs) -> list[Any]:
+        return self._scatter(
+            lambda wid: self.scheduler.call_engine(wid, method, *args, **kwargs)
+        )
+
+    def _one(self, method: str, *args, **kwargs) -> Any:
+        return self.scheduler.call_engine(
+            self.worker_ids[0], method, *args, **kwargs
+        )
+
+
+class TrainController(_WorkerFleet):
+    """Controller-side TrainEngine facade over N RPC workers."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        engine_type: str,
+        engine_config: Any,
+        *,
+        role: str = "trainer",
+        spec: SchedulingSpec | None = None,
+    ):
+        super().__init__(scheduler, engine_type, engine_config, role, spec)
+
+    # -- TrainEngine surface -------------------------------------------
+    def create_process_group(self, parallel_strategy=None) -> None:
+        self._all("create_process_group", parallel_strategy)
+
+    def initialize(self, addr=None, ft_spec=None) -> None:
+        self._all("initialize", addr, ft_spec)
+
+    def train(self, mode: bool = True):
+        self._all("train", mode)
+        return self
+
+    def set_version(self, version: int) -> None:
+        self._all("set_version", version)
+
+    def get_version(self) -> int:
+        return self._one("get_version")
+
+    def save(self, meta) -> None:
+        self._one("save", meta)  # sharded saves are worker-internal
+
+    def load(self, meta) -> None:
+        self._all("load", meta)
+
+    def update_weights(self, meta=None) -> None:
+        self._all("update_weights", meta)
+
+    def train_batch(
+        self,
+        batch: "DistributedBatchMemory | dict",
+        loss_fn: Callable | None = None,
+        loss_weight_fn: Callable | None = None,
+        *,
+        method: str = "train_batch",
+    ) -> dict[str, float]:
+        """Chunk the batch over DP workers, run their steps CONCURRENTLY,
+        reduce stats by token weight. Callables must be module-level
+        (picklable)."""
+        if not isinstance(batch, DistributedBatchMemory):
+            batch = DistributedBatchMemory.from_dict(batch)
+        chunks = batch.chunk(len(self.worker_ids))
+        extra = [] if loss_fn is None else [loss_fn, loss_weight_fn]
+        pairs = dict(zip(self.worker_ids, chunks))
+        results = self._scatter(
+            lambda wid: (
+                self.scheduler.call_engine(
+                    wid, method, pairs[wid].to_dict(), *extra
+                )
+                if len(pairs[wid]) > 0
+                else None
+            )
+        )
+        results = [r for r in results if r is not None]
+        out: dict[str, float] = {}
+        weights = [max(r.get("n_tokens", 1.0), 1.0) for r in results]
+        total = sum(weights)
+        for r, w in zip(results, weights):
+            for k, v in r.items():
+                if isinstance(v, (int, float)):
+                    out[k] = out.get(k, 0.0) + float(v) * w / total
+        return out
+
+    def eval_batch(self, batch, *args, **kwargs):
+        return self.train_batch(batch, *args, method="eval_batch", **kwargs)
+
+
+class RolloutController(_WorkerFleet):
+    """Controller-side InferenceEngine facade over N RPC decode workers."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        engine_type: str,
+        engine_config: Any,
+        *,
+        role: str = "rollout",
+        spec: SchedulingSpec | None = None,
+    ):
+        super().__init__(scheduler, engine_type, engine_config, role, spec)
+        self._rr = 0
+
+    def initialize(self, *args, **kwargs) -> None:
+        self._all("initialize", *args, **kwargs)
+
+    def generate(self, req, timeout: float | None = None):
+        """Round-robin a generation to one worker (sync; the controller
+        mode's data plane is coarse-grained by design)."""
+        wid = self.worker_ids[self._rr % len(self.worker_ids)]
+        self._rr += 1
+        return self.scheduler.call_engine(wid, "generate", req, timeout)
+
+    def rollout_batch(self, data: list, workflow=None, **kwargs):
+        """Contiguous shards per worker, rolled out concurrently; merged
+        rows keep the INPUT order (interleaved sharding would permute
+        results against their prompts)."""
+        from areal_tpu.utils.data import concat_padded_tensors
+
+        n = len(self.worker_ids)
+        bounds = np.cumsum(
+            [0] + [len(data) // n + (1 if i < len(data) % n else 0)
+                   for i in range(n)]
+        )
+        shards = {
+            wid: data[bounds[i] : bounds[i + 1]]
+            for i, wid in enumerate(self.worker_ids)
+        }
+        outs = self._scatter(
+            lambda wid: (
+                self.scheduler.call_engine(
+                    wid, "rollout_batch", shards[wid], workflow, **kwargs
+                )
+                if shards[wid]
+                else None
+            )
+        )
+        return concat_padded_tensors([o for o in outs if o is not None])
+
+    def pause(self) -> None:
+        self._all("pause")
+
+    def resume(self) -> None:
+        self._all("resume")
+
+    def pause_generation(self) -> None:
+        self._all("pause_generation")
+
+    def continue_generation(self) -> None:
+        self._all("continue_generation")
+
+    def set_version(self, version: int) -> None:
+        self._all("set_version", version)
+
+    def get_version(self) -> int:
+        return self._one("get_version")
+
+    def update_weights_from_disk(self, meta) -> None:
+        self._all("update_weights_from_disk", meta)

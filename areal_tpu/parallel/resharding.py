@@ -1,0 +1,91 @@
+"""General parameter resharding between parallel topologies.
+
+Parity: the reference's param-realloc subsystem — live re-sharding of
+weights between disjoint train/gen topologies (realhf/impl/model/comm/
+param_realloc.py:157,351: pairwise rank comm plans of Reparallelize
+Sender/ReceiverSteps executed as NCCL broadcasts, plus the flat-buffer
+interval copy kernels in csrc/interval_op). On TPU the ENTIRE subsystem
+collapses into `jax.device_put` with the target NamedShardings: XLA's
+runtime computes the minimal device-to-device transfer plan (the comm plan
+derivation, the interval math, and the collectives are all the compiler/
+runtime's job). This module is the explicit utility + the η-mixing the
+legacy hook applied (dfg.py:29: target = η·src + (1-η)·target).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from areal_tpu.api.alloc_mode import ParallelStrategy
+from areal_tpu.parallel import mesh as mesh_lib
+
+
+def shardings_for(
+    strategy: ParallelStrategy,
+    model_config,
+    *,
+    devices: list | None = None,
+    fsdp: bool = True,
+):
+    """(mesh, param shardings) for a strategy — the target topology."""
+    from areal_tpu.models.qwen2 import param_logical_axes
+
+    mesh = mesh_lib.build_mesh(strategy, devices)
+    pp = strategy.pp_size > 1
+    rules = mesh_lib.default_rules(fsdp=fsdp, pp=pp)
+    axes = param_logical_axes(model_config)
+    shardings = jax.tree.map(
+        lambda a: mesh_lib.named_sharding(mesh, a, rules),
+        axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+    return mesh, shardings
+
+
+def reshard(params: Any, target_shardings: Any) -> Any:
+    """Move a param tree onto new shardings (possibly a different mesh /
+    device set). One call = the whole legacy comm plan."""
+    return jax.tree.map(jax.device_put, params, target_shardings)
+
+
+def reshard_to_strategy(
+    params: Any,
+    strategy: ParallelStrategy,
+    model_config,
+    *,
+    devices: list | None = None,
+    fsdp: bool = True,
+):
+    """Reshard onto a strategy's canonical layout; returns
+    (params, mesh, shardings)."""
+    mesh, shardings = shardings_for(
+        strategy, model_config, devices=devices, fsdp=fsdp
+    )
+    return reshard(params, shardings), mesh, shardings
+
+
+@jax.jit
+def _mix(t: Any, s: Any, eta: jax.Array) -> Any:
+    # module-level jit: the per-weight-push mixing hook must hit the
+    # compile cache, not re-trace a fresh closure every update
+    return jax.tree.map(
+        lambda a, b: (eta * b.astype(a.dtype) + (1.0 - eta) * a).astype(
+            a.dtype
+        ),
+        t,
+        s,
+    )
+
+
+def eta_mix(target: Any, src: Any, eta: float) -> Any:
+    """target <- eta * src + (1 - eta) * target (the legacy realloc hook's
+    mixing rule, realhf/api/core/dfg.py:29), computed on the TARGET's
+    layout — src reshards onto it first."""
+    src_on_target = reshard(src, jax.tree.map(lambda x: x.sharding, target))
+    if eta >= 1.0:
+        return src_on_target
+    import jax.numpy as jnp
+
+    return _mix(target, src_on_target, jnp.float32(eta))

@@ -570,6 +570,137 @@ class Etcd3NameRecordRepository(NameRecordRepository):
         self._owned.clear()
 
 
+class RayNameRecordRepository(NameRecordRepository):
+    """Ray-actor backend (parity: the reference's RayNameResolveRepository,
+    areal/utils/name_resolve.py) — a detached named actor holding the dict;
+    every method proxies through ray.get. Gated: requires a live ray
+    runtime (not in this image; the ray launcher supplies one)."""
+
+    def __init__(self, actor_name: str = "name_resolve"):
+        import ray  # gated import — raises cleanly when unavailable
+
+        self._ray = ray
+
+        @ray.remote
+        class _Store:
+            def __init__(self):
+                self.repo = MemoryNameRecordRepository()
+                self.expiry: dict[str, float] = {}
+
+            def _expire(self):
+                now = time.time()
+                for k, dl in list(self.expiry.items()):
+                    if dl < now:
+                        self.expiry.pop(k, None)
+                        try:
+                            self.repo.delete(k)
+                        except NameEntryNotFoundError:
+                            pass
+
+            def call(self, method, *args, **kwargs):
+                self._expire()
+                ttl = kwargs.pop("_ttl", None)
+                out = getattr(self.repo, method)(*args, **kwargs)
+                if method == "add" and args:
+                    name = args[0].rstrip("/")
+                    if ttl is not None:
+                        self.expiry[name] = time.time() + ttl
+                    else:
+                        self.expiry.pop(name, None)
+                return out
+
+            def touch(self, names, ttl):
+                self._expire()
+                for name in names:
+                    if name in self.expiry:
+                        self.expiry[name] = time.time() + ttl
+
+        # atomic named creation (two workers may bootstrap concurrently)
+        self._actor = _Store.options(
+            name=actor_name, lifetime="detached", get_if_exists=True
+        ).remote()
+        self._owned: set[str] = set()
+        self._ttl_entries: dict[str, float] = {}
+        self._keepalive_stop = threading.Event()
+        self._keepalive_thread: threading.Thread | None = None
+
+    def _call(self, method, *args, **kwargs):
+        return self._ray.get(self._actor.call.remote(method, *args, **kwargs))
+
+    def _ensure_keepalive(self):
+        if self._keepalive_thread is not None and self._keepalive_thread.is_alive():
+            return
+        self._keepalive_stop.clear()
+
+        def _loop():
+            while True:
+                entries = dict(self._ttl_entries)
+                interval = (
+                    max(0.2, min(entries.values()) / 3.0) if entries else 1.0
+                )
+                if self._keepalive_stop.wait(timeout=interval):
+                    return
+                by_ttl: dict[float, list[str]] = {}
+                for name, ttl in entries.items():
+                    by_ttl.setdefault(ttl, []).append(name)
+                for ttl, names_ in by_ttl.items():
+                    try:
+                        self._ray.get(self._actor.touch.remote(names_, ttl))
+                    except Exception:  # noqa: BLE001 — retried next tick
+                        pass
+
+        self._keepalive_thread = threading.Thread(target=_loop, daemon=True)
+        self._keepalive_thread.start()
+
+    def add(self, name, value, delete_on_exit=True, keepalive_ttl=None, replace=False):
+        # TTL entries expire actor-side unless this client's keepalive
+        # thread refreshes them — crashed owners release their names (the
+        # watch_names failure-detection contract the other backends honor).
+        self._call(
+            "add", name, value, delete_on_exit=False, replace=replace,
+            _ttl=keepalive_ttl,
+        )
+        name_n = name.rstrip("/")
+        if keepalive_ttl is not None:
+            self._ttl_entries[name_n] = float(keepalive_ttl)
+            self._ensure_keepalive()
+        else:
+            self._ttl_entries.pop(name_n, None)
+        if delete_on_exit:
+            self._owned.add(name)
+
+    def get(self, name):
+        return self._call("get", name)
+
+    def get_subtree(self, name_root):
+        return self._call("get_subtree", name_root)
+
+    def find_subtree(self, name_root):
+        return self._call("find_subtree", name_root)
+
+    def delete(self, name):
+        self._call("delete", name)
+        self._owned.discard(name)
+        self._ttl_entries.pop(name.rstrip("/"), None)
+
+    def clear_subtree(self, name_root):
+        self._call("clear_subtree", name_root)
+
+    def reset(self):
+        self._keepalive_stop.set()
+        if self._keepalive_thread is not None:
+            self._keepalive_thread.join(timeout=2.0)
+            self._keepalive_thread = None
+        self._keepalive_stop.clear()
+        self._ttl_entries.clear()
+        for name in list(self._owned):
+            try:
+                self.delete(name)
+            except NameEntryNotFoundError:
+                pass
+        self._owned.clear()
+
+
 # Module-level default repository, reconfigurable like the reference.
 _default_repo: NameRecordRepository = MemoryNameRecordRepository()
 
@@ -582,10 +713,12 @@ def reconfigure(config: NameResolveConfig) -> None:
         _default_repo = NfsNameRecordRepository(config.nfs_record_root)
     elif config.type == "etcd3":
         _default_repo = Etcd3NameRecordRepository(config.etcd3_addr)
+    elif config.type == "ray":
+        _default_repo = RayNameRecordRepository(config.ray_actor_name)
     else:
         raise NotImplementedError(
             f"name_resolve backend {config.type!r} not available in the TPU build "
-            "(supported: memory, nfs, etcd3)"
+            "(supported: memory, nfs, etcd3, ray)"
         )
 
 

@@ -1,0 +1,100 @@
+"""Param realloc as GSPMD resharding (parity: realhf param_realloc.py's
+train->gen topology moves + the eta-mixing hook, re-expressed as
+device_put; SURVEY.md §2.3 notes interval_op is subsumed this way)."""
+
+import numpy as np
+
+import jax
+
+from areal_tpu.api.alloc_mode import ParallelStrategy
+from areal_tpu.models.qwen2 import ModelConfig, init_params
+from areal_tpu.parallel.resharding import (
+    eta_mix,
+    reshard_to_strategy,
+    shardings_for,
+)
+
+TINY = ModelConfig(
+    vocab_size=64,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    dtype="float32",
+    param_dtype="float32",
+)
+
+
+def test_reshard_train_to_gen_topology(cpu_devices):
+    """The reference's flagship realloc shape: train d4t2 -> a smaller
+    gen topology on a device subset (disjoint layouts). Values must be
+    bit-identical; layouts must match the target."""
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    train_params, _, train_sh = reshard_to_strategy(
+        params,
+        ParallelStrategy(data_parallel_size=4, tensor_parallel_size=2),
+        TINY,
+    )
+    gen_devices = cpu_devices[:2]
+    gen_params, gen_mesh, gen_sh = reshard_to_strategy(
+        train_params,
+        ParallelStrategy(tensor_parallel_size=2),
+        TINY,
+        devices=gen_devices,
+        fsdp=False,
+    )
+    # target layout applied...
+    q = gen_params["layers"]["attn"]["q_kernel"]
+    assert q.sharding == gen_sh["layers"]["attn"]["q_kernel"]
+    assert set(q.sharding.device_set) <= set(gen_devices)
+    # ...and values survived the topology change exactly
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(gen_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_reshard_into_pp_layout(cpu_devices):
+    """Resharding into a pp=2 strategy lands the scanned layer stack on the
+    pp axis (the pipeline engine's expected layout)."""
+    params = init_params(TINY, jax.random.PRNGKey(1))
+    out, mesh, sh = reshard_to_strategy(
+        params,
+        ParallelStrategy(
+            pipeline_parallel_size=2,
+            data_parallel_size=2,
+            tensor_parallel_size=2,
+        ),
+        TINY,
+    )
+    spec = out["layers"]["attn"]["q_kernel"].sharding.spec
+    assert spec[0] == "pp", spec
+
+
+def test_eta_mix(cpu_devices):
+    """target <- eta*src + (1-eta)*target across different layouts."""
+    a = init_params(TINY, jax.random.PRNGKey(2))
+    b = init_params(TINY, jax.random.PRNGKey(3))
+    ta, _, _ = reshard_to_strategy(
+        a, ParallelStrategy(data_parallel_size=4, tensor_parallel_size=2), TINY
+    )
+    tb, _, _ = reshard_to_strategy(
+        b, ParallelStrategy(data_parallel_size=4, tensor_parallel_size=2),
+        TINY, fsdp=False
+    )
+    mixed = eta_mix(ta, tb, eta=0.25)
+    la, lb, lm = (
+        jax.tree.leaves(a),
+        jax.tree.leaves(b),
+        jax.tree.leaves(mixed),
+    )
+    for x, y, m in zip(la, lb, lm):
+        np.testing.assert_allclose(
+            np.asarray(m),
+            0.25 * np.asarray(y) + 0.75 * np.asarray(x),
+            rtol=1e-6,
+            atol=1e-7,
+        )
+    # eta=1 is a pure reshard of src onto target's layout
+    full = eta_mix(ta, tb, eta=1.0)
+    for y, m in zip(lb, jax.tree.leaves(full)):
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(m))
