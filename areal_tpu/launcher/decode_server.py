@@ -313,7 +313,7 @@ async def _serve(args: argparse.Namespace) -> None:
         tensor_parallel_size=args.tp_size,
     )
     tokenizer = None
-    if args.model_path and not args.skip_tokenizer_init:
+    if args.model_path and not args.skip_tokenizer_init and not args.scratch_model:
         try:
             from transformers import AutoTokenizer
 
@@ -321,6 +321,24 @@ async def _serve(args: argparse.Namespace) -> None:
         except Exception as e:  # noqa: BLE001
             logger.warning(f"tokenizer load failed ({e}); stop-on-eos disabled")
     server = DecodeServer(config, tokenizer=tokenizer)
+    if args.scratch_model:
+        # Offline smoke mode: serve a from-scratch tiny model described by a
+        # JSON ModelConfig dict — lets launcher E2E tests (and air-gapped
+        # demo runs) exercise the full DECOUPLED path without HF downloads.
+        import json as _json
+
+        import jax as _jax
+
+        from areal_tpu.models.qwen2 import ModelConfig, init_params
+
+        mc = ModelConfig(
+            **{
+                **_json.loads(args.scratch_model),
+                "dtype": args.dtype,
+                "param_dtype": args.dtype,
+            }
+        )
+        server.engine.set_model(init_params(mc, _jax.random.PRNGKey(args.seed)), mc)
     await server.start(args.host, args.port)
     if args.experiment_name and args.trial_name:
         server.register(
@@ -353,7 +371,15 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--trial-name", default=os.environ.get("AREAL_TRIAL_NAME", ""))
     p.add_argument("--server-id", default="")
     p.add_argument("--skip-tokenizer-init", action="store_true")
+    p.add_argument(
+        "--scratch-model",
+        default="",
+        help="JSON ModelConfig dict: serve a from-scratch tiny model "
+             "(offline smoke / launcher E2E) instead of loading --model-path",
+    )
     args = p.parse_args(argv)
+    # join the experiment's shared discovery store (launcher-provided env)
+    name_resolve.reconfigure_from_env()
     asyncio.run(_serve(args))
 
 

@@ -88,7 +88,16 @@ class LocalLauncher:
         env: dict[str, str] | None = None,
     ) -> JobInfo:
         port = port or find_free_ports(1)[0]
+        # Lower CPU priority: the decode engine's continuous-batching loop
+        # saturates whatever cores it gets (by design); when servers and the
+        # trainer share a host's CPUs (colocated smoke / CI), the trainer's
+        # XLA compiles must win or the first training step starves behind
+        # rollout decode. On real deployments each side owns its chips and
+        # nice is a no-op.
         cmd = [
+            "nice",
+            "-n",
+            "10",
             sys.executable,
             "-m",
             "areal_tpu.launcher.decode_server",
@@ -203,6 +212,21 @@ def run_experiment(
     """Launch servers+trainers per the allocation mode; auto-restart the
     whole experiment on recoverable failure (parity: local.py recover loop)."""
     alloc = AllocationMode.from_str(config.allocation_mode)
+    # One shared discovery store for launcher + servers + trainers: the
+    # launcher applies the experiment's name_resolve config and ships it to
+    # every subprocess via env (each process's module default is otherwise
+    # an in-process memory store that nobody else can see).
+    if (
+        alloc.type_ == AllocationType.DECOUPLED_TRAIN
+        and config.cluster.name_resolve.type == "memory"
+    ):
+        raise ValueError(
+            "decoupled allocation needs a CROSS-PROCESS name_resolve backend "
+            "(nfs/etcd3/ray); type='memory' is per-process and the trainer "
+            "could never discover the decode servers"
+        )
+    name_resolve.reconfigure(config.cluster.name_resolve)
+    nr_env = name_resolve.to_env(config.cluster.name_resolve)
     launcher = LocalLauncher(
         config.experiment_name, config.trial_name, config.cluster.fileroot
     )
@@ -210,6 +234,15 @@ def run_experiment(
     attempt = 0
     while True:
         try:
+            # Stale registrations from a previous (crashed) attempt would
+            # satisfy wait_decode_servers with dead ip:port records —
+            # clear the subtree so only THIS attempt's servers count.
+            try:
+                name_resolve.clear_subtree(
+                    names.gen_servers(config.experiment_name, config.trial_name)
+                )
+            except Exception:  # noqa: BLE001 — nothing registered yet
+                pass
             n_servers = (
                 alloc.gen.data_parallel_size
                 if alloc.type_ in (AllocationType.DECOUPLED_TRAIN,)
@@ -228,17 +261,39 @@ def run_experiment(
                     )
                     env["TPU_VISIBLE_CHIPS"] = chips
                     env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+                extra = ["--tp-size", str(gen_tp)] if gen_tp > 1 else []
+                # forward the experiment's decode config — without these the
+                # server silently runs its DEFAULTS (32k context, 64 slots,
+                # 128-token chunks), which on small smoke runs means orders-
+                # of-magnitude more compute per chunk than configured
+                dec = config.decode
+                extra += [
+                    "--context-length", str(dec.context_length),
+                    "--max-running-requests", str(dec.max_running_requests),
+                    "--new-tokens-per-chunk", str(dec.new_tokens_per_chunk),
+                    "--dtype", dec.dtype,
+                    "--seed", str(dec.random_seed),
+                ]
+                from areal_tpu.models.smoke import OFFLINE_SENTINELS
+
+                if model_path in OFFLINE_SENTINELS:
+                    # offline smoke: serve the canonical from-scratch tiny
+                    # model so the DECOUPLED path runs with no HF access
+                    import json as _json
+
+                    from areal_tpu.models.smoke import SMOKE_MODEL_DICT
+
+                    extra += ["--scratch-model", _json.dumps(SMOKE_MODEL_DICT)]
+                env.update(nr_env)
                 launcher.submit_decode_server(
                     i,
                     model_path,
-                    extra_args=(
-                        ["--tp-size", str(gen_tp)] if gen_tp > 1 else []
-                    ),
-                    env=env or None,
+                    extra_args=extra,
+                    env=env,
                 )
             if n_servers:
                 launcher.wait_decode_servers(n_servers)
-            launcher.submit_trainers(entrypoint, n_procs=1)
+            launcher.submit_trainers(entrypoint, n_procs=1, env=nr_env)
             launcher.wait()
             launcher.stop_all()  # trainers done: tear down decode servers
             return

@@ -1,0 +1,35 @@
+"""Canonical from-scratch smoke model: ONE tiny geometry shared by the
+example entry points, the decode server's --scratch-model mode, and the
+launcher E2E tests — trainer and decode server must agree on shapes for
+the DCN weight push to apply."""
+
+from __future__ import annotations
+
+from areal_tpu.models.qwen2 import ModelConfig
+
+# model/tokenizer paths that mean "offline smoke" (no HF access)
+OFFLINE_SENTINELS = ("", "synthetic-arith", "arith")
+
+SMOKE_MODEL_DICT = dict(
+    vocab_size=32,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+)
+
+
+def smoke_model_config(dtype: str = "float32", vocab_size: int | None = None) -> ModelConfig:
+    """The FIXED smoke geometry. `vocab_size` is validated, never enlarged:
+    trainer and decode server must agree bit-for-bit on shapes for the DCN
+    weight push, so the vocab cannot silently follow a tokenizer."""
+    d = dict(SMOKE_MODEL_DICT)
+    if vocab_size is not None and vocab_size > d["vocab_size"]:
+        raise ValueError(
+            f"smoke model vocab is fixed at {d['vocab_size']} but the "
+            f"tokenizer has {vocab_size} tokens — offline smoke mode only "
+            "supports the built-in character tokenizer; point actor.path / "
+            "decode.model_path at a real checkpoint instead"
+        )
+    return ModelConfig(**d, dtype=dtype, param_dtype=dtype)

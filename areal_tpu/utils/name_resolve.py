@@ -722,6 +722,41 @@ def reconfigure(config: NameResolveConfig) -> None:
         )
 
 
+def to_env(config: NameResolveConfig) -> dict[str, str]:
+    """Env vars that ship a NameResolveConfig to subprocesses (decode
+    servers, trainer ranks) so every process of an experiment resolves
+    names against the SAME store."""
+    return {
+        "AREAL_NAME_RESOLVE_TYPE": config.type,
+        "AREAL_NAME_RESOLVE_NFS_ROOT": config.nfs_record_root,
+        "AREAL_NAME_RESOLVE_ETCD_ADDR": config.etcd3_addr,
+        "AREAL_NAME_RESOLVE_RAY_ACTOR": config.ray_actor_name,
+    }
+
+
+def reconfigure_from_env() -> bool:
+    """Apply AREAL_NAME_RESOLVE_* env (set by launchers); returns whether
+    anything was configured."""
+    t = os.environ.get("AREAL_NAME_RESOLVE_TYPE")
+    if not t:
+        return False
+    reconfigure(
+        NameResolveConfig(
+            type=t,
+            nfs_record_root=os.environ.get(
+                "AREAL_NAME_RESOLVE_NFS_ROOT", "/tmp/areal_tpu/name_resolve"
+            ),
+            etcd3_addr=os.environ.get(
+                "AREAL_NAME_RESOLVE_ETCD_ADDR", "localhost:2379"
+            ),
+            ray_actor_name=os.environ.get(
+                "AREAL_NAME_RESOLVE_RAY_ACTOR", "name_resolve"
+            ),
+        )
+    )
+    return True
+
+
 def default_repo() -> NameRecordRepository:
     return _default_repo
 

@@ -53,7 +53,9 @@ def gsm8k_reward_fn(prompt, completion, prompt_ids, completion_ids, **data):
 
 def load_tokenizer(path: str):
     """HF tokenizer, or the built-in character tokenizer for offline runs."""
-    if path in ("", "synthetic-arith", "arith"):
+    from areal_tpu.models.smoke import OFFLINE_SENTINELS
+
+    if path in OFFLINE_SENTINELS:
         from areal_tpu.dataset.arith import ArithTokenizer
 
         return ArithTokenizer()
@@ -104,23 +106,21 @@ def main(args):
     seeding.set_random_seed(config.seed, key=f"trainer{rank}")
     tokenizer = load_tokenizer(config.tokenizer_path)
 
+    from areal_tpu.utils import name_resolve
+
+    name_resolve.reconfigure(config.cluster.name_resolve)
     alloc = AllocationMode.from_str(config.allocation_mode)
 
     actor = JaxPPOActor(config.actor)
     if not config.actor.path:
-        # Offline smoke mode: no HF checkpoint — train a tiny from-scratch
-        # decoder sized to the built-in character tokenizer.
-        from areal_tpu.models.qwen2 import ModelConfig
+        # Offline smoke mode: no HF checkpoint — train the canonical tiny
+        # from-scratch decoder (shared with the decode server's
+        # --scratch-model mode so decoupled smoke runs line up).
+        from areal_tpu.models.smoke import smoke_model_config
 
-        actor.model_config = ModelConfig(
-            vocab_size=max(32, getattr(tokenizer, "vocab_size", 32)),
-            hidden_size=64,
-            intermediate_size=128,
-            num_hidden_layers=2,
-            num_attention_heads=4,
-            num_key_value_heads=2,
+        actor.model_config = smoke_model_config(
             dtype=config.actor.dtype,
-            param_dtype=config.actor.dtype,
+            vocab_size=getattr(tokenizer, "vocab_size", None),
         )
     actor.create_process_group(alloc.train)
 

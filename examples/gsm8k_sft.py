@@ -38,7 +38,9 @@ from areal_tpu.utils.stats_logger import StatsLogger
 
 
 def load_tokenizer(path: str):
-    if path in ("", "synthetic-arith", "arith"):
+    from areal_tpu.models.smoke import OFFLINE_SENTINELS
+
+    if path in OFFLINE_SENTINELS:
         from areal_tpu.dataset.arith import ArithTokenizer
 
         return ArithTokenizer()
@@ -65,21 +67,19 @@ def main(args):
     rank = int(os.getenv("AREAL_TPU_PROCESS_ID", "0"))
     seeding.set_random_seed(config.seed, key=f"trainer{rank}")
     tokenizer = load_tokenizer(config.tokenizer_path)
+
+    from areal_tpu.utils import name_resolve
+
+    name_resolve.reconfigure(config.cluster.name_resolve)
     alloc = AllocationMode.from_str(config.allocation_mode)
 
     engine = JaxLMEngine(config.model)
     if not config.model.path:
-        from areal_tpu.models.qwen2 import ModelConfig
+        from areal_tpu.models.smoke import smoke_model_config
 
-        engine.model_config = ModelConfig(
-            vocab_size=max(32, getattr(tokenizer, "vocab_size", 32)),
-            hidden_size=64,
-            intermediate_size=128,
-            num_hidden_layers=2,
-            num_attention_heads=4,
-            num_key_value_heads=2,
+        engine.model_config = smoke_model_config(
             dtype=config.model.dtype,
-            param_dtype=config.model.dtype,
+            vocab_size=getattr(tokenizer, "vocab_size", None),
         )
     engine.create_process_group(alloc.train)
 

@@ -160,3 +160,52 @@ def test_wait_no_matching_jobs_returns(launcher):
     t0 = time.monotonic()
     launcher.wait(check_interval=0.1)  # no trainer jobs: return, don't spin
     assert time.monotonic() - t0 < 5
+
+
+@pytest.mark.slow
+def test_decoupled_e2e_smoke(tmp_path):
+    """Full DECOUPLED-mode E2E, fully offline: run_experiment spawns a
+    from-scratch decode server (+ name_resolve registration), then the GRPO
+    example as the trainer subprocess, which discovers the server over
+    HTTP, rolls out, trains, and pushes weights back over the DCN staging
+    path. Two steps must complete and tear down cleanly."""
+    import os
+    import sys
+    import uuid
+
+    from areal_tpu.api.cli_args import GRPOConfig, load_expr_config
+    from areal_tpu.launcher.local import run_experiment
+
+    trial = uuid.uuid4().hex[:8]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    overrides = [
+        "--config",
+        os.path.join(repo, "examples/configs/arith_grpo_smoke.yaml"),
+        f"trial_name={trial}",
+        f"cluster.fileroot={tmp_path}",
+        f"cluster.name_resolve.nfs_record_root={tmp_path}/nr",
+        "allocation_mode=jax:d1+d8",
+        # minimal workload: the decode server's continuous-batching loop
+        # saturates the single CI core, so every extra episode directly
+        # starves the trainer's compiles (observed: 16-episode batches push
+        # the E2E past 20 min; 4-episode batches finish in ~6)
+        "total_train_steps=2",
+        "train_dataset.batch_size=2",
+        "gconfig.n_samples=2",
+        "rollout.consumer_batch_size=4",
+        "rollout.max_concurrent_rollouts=8",
+        "evaluator.freq_steps=1000",
+    ]
+    config, _ = load_expr_config(overrides, GRPOConfig)
+    entry = [
+        sys.executable,
+        os.path.join(repo, "examples/gsm8k_grpo.py"),
+    ] + overrides
+    run_experiment(config, entry, max_restarts=0)
+    # the trainer's stats log proves steps ran
+    log_dir = os.path.join(str(tmp_path), "logs", config.experiment_name, trial)
+    trainer_log = os.path.join(log_dir, "trainer_0.log")
+    with open(trainer_log) as f:
+        text = f.read()
+    assert "global step 1" in text, text[-2000:]
+    assert "Traceback" not in text, text[-3000:]
