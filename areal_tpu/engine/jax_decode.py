@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import os
 import queue
 import threading
 import time
@@ -57,6 +58,7 @@ from areal_tpu.api.io_struct import (
 )
 from areal_tpu.models import hf_io
 from areal_tpu.models.qwen2 import ModelConfig, decode_step, prefill
+from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.utils import logging
 
 logger = logging.getLogger("jax_decode")
@@ -213,6 +215,9 @@ class JaxDecodeEngine(InferenceEngine):
         self._executor = WorkflowExecutor(self.inference_config, self)
         self._executor.initialize(train_data_parallel_size)
 
+        # a re-initialize after a scheduler crash starts clean — stale
+        # _thread_exc would fail every agenerate forever
+        self._thread_exc = None
         self._thread = threading.Thread(
             target=self._scheduler_loop, daemon=True, name="jax-decode-scheduler"
         )
@@ -944,10 +949,26 @@ class JaxDecodeEngine(InferenceEngine):
             item.loop.call_soon_threadsafe(item.future.set_result, resp)
 
     def _scheduler_loop(self):
+        debug = bool(os.environ.get("AREAL_DECODE_DEBUG"))
+        last_dbg = time.monotonic()
         R = self.config.max_running_requests
         try:
             while not self._shutdown.is_set():
-                with self._sched_lock:
+                if debug and time.monotonic() - last_dbg > 5.0:
+                    last_dbg = time.monotonic()
+                    logger.info(
+                        f"[sched {id(self):#x}] qsize={self._request_q.qsize()} "
+                        f"overflow={len(self._overflow)} "
+                        f"active={int(self._active_mask().sum())} "
+                        f"paused={self._gen_paused.is_set()}"
+                    )
+                # Bind THIS engine's mesh (or explicit no-mesh) for every
+                # trace on this thread: in COLOCATE mode the process-global
+                # ambient mesh is the train engine's, and a prefill/chunk
+                # trace constraining onto that topology is a compile error.
+                # Re-bound per pass because set_model can install a sharded
+                # mesh after the thread starts.
+                with mesh_lib.mesh_scope(self.mesh), self._sched_lock:
                     if self._gen_paused.is_set():
                         paused, worked = True, False
                     else:
@@ -972,6 +993,10 @@ class JaxDecodeEngine(InferenceEngine):
                 if s is not None and s.future is not None and not s.future.done():
                     s.loop.call_soon_threadsafe(s.future.set_exception, e)
                 self._slots[i] = None
+            for item in self._overflow:
+                if item.future is not None and not item.future.done():
+                    item.loop.call_soon_threadsafe(item.future.set_exception, e)
+            self._overflow.clear()
             while True:
                 try:
                     item = self._request_q.get_nowait()
@@ -1106,7 +1131,17 @@ class JaxDecodeEngine(InferenceEngine):
             loop=loop,
             image_data=req.image_data,
         )
+        if os.environ.get("AREAL_DECODE_DEBUG"):
+            logger.info(f"[agen {id(self):#x}] enqueue rid={item.rid}")
         self._request_q.put(item)
+        # The death handler sets _thread_exc BEFORE draining the queue once,
+        # so a put that races past the drain is always caught here — without
+        # this, such a request would wait forever on a future nobody
+        # resolves.
+        if self._thread_exc is not None:
+            raise RuntimeError(
+                "decode scheduler is dead; engine must be re-initialized"
+            ) from self._thread_exc
         return await future
 
     def generate(self, req: ModelRequest, timeout: float | None = None) -> ModelResponse:

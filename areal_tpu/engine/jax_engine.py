@@ -286,6 +286,12 @@ class JaxTrainEngine(TrainEngine):
         self._apply_update_fn = None
         self._zero_grads_fn = None
         self._push_cast_fn = None
+        # A dead engine must not leave its topology as the process-global
+        # ambient mesh: later traces (a differently-sharded decode engine,
+        # plain eval forwards) would constrain onto devices their operands
+        # don't live on.
+        if self.mesh is not None:
+            mesh_lib.clear_current_mesh_if(self.mesh)
 
     # -- topology -------------------------------------------------------
     # `data_parallel_rank/world_size` follow the reference's *usage* (which

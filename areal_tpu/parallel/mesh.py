@@ -26,6 +26,9 @@ areal/api/alloc_mode.py expert_data_parallel_size).
 
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -43,14 +46,48 @@ MESH_AXES = (AXIS_PP, AXIS_DP, AXIS_SP, AXIS_TP)
 # Mesh through every pure function signature.
 _CURRENT_MESH: Mesh | None = None
 
+# Per-thread override. Two engines with DIFFERENT topologies can share a
+# process (COLOCATE: the train engine's 8-chip mesh + a tp-sharded decode
+# engine over a subset), each running compute on its own thread. A traced
+# `constrain` must resolve the mesh of the engine whose thread is tracing,
+# never the other engine's — a constraint naming devices the operand doesn't
+# live on is a compile error. An entry may be None: that is an explicit
+# "trace with no ambient mesh" binding (unsharded decode engine), distinct
+# from an empty stack (fall through to the process-global).
+_TLS = threading.local()
+
 
 def set_current_mesh(mesh: Mesh | None) -> None:
     global _CURRENT_MESH
     _CURRENT_MESH = mesh
 
 
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh | None):
+    """Bind the ambient mesh for the current thread (None = no mesh)."""
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    stack.append(mesh)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
 def current_mesh() -> Mesh | None:
+    stack = getattr(_TLS, "stack", None)
+    if stack:
+        return stack[-1]
     return _CURRENT_MESH
+
+
+def clear_current_mesh_if(mesh: Mesh) -> None:
+    """Unset the process-global ambient mesh iff it is `mesh` (engine
+    teardown hygiene — never clobbers a mesh some other engine installed)."""
+    global _CURRENT_MESH
+    if _CURRENT_MESH is mesh:
+        _CURRENT_MESH = None
 
 
 def build_mesh(

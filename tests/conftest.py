@@ -39,3 +39,15 @@ def cpu_devices():
     devices = jax.devices()
     assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
     return devices
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _reset_global_mesh():
+    """Cross-module isolation: a test module must not inherit another
+    module's process-global ambient mesh (engines that were never
+    destroyed leave theirs installed, and a later module's differently-
+    placed arrays would be constrained onto the wrong devices)."""
+    yield
+    from areal_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.set_current_mesh(None)

@@ -105,6 +105,7 @@ def pipeline(cpu_devices):
     actor.connect_engine(rollout, WeightUpdateMeta.from_memory())
     yield actor, rollout
     rollout.destroy()
+    actor.destroy()
 
 
 @pytest.mark.slow

@@ -448,3 +448,49 @@ def test_frequency_penalty_reduces_repeats(cpu_devices):
     uniq_base = len(set(base)) / len(base)
     uniq_pen = len(set(pen)) / len(pen)
     assert uniq_pen > uniq_base, (uniq_base, uniq_pen)
+
+
+def test_decode_under_foreign_global_mesh(cpu_devices):
+    """Regression: a decode engine must trace against ITS OWN mesh even when
+    another engine (the COLOCATE train engine) has installed a different
+    process-global ambient mesh. Before the thread-local `mesh_scope`
+    binding, `constrain` inside the prefill trace resolved the foreign
+    8-device mesh while the decode params lived on 2 devices — the
+    scheduler thread died on an incompatible-devices compile error and
+    every subsequent request hung forever. An UNSHARDED engine (params on
+    one device) under a foreign 8-device mesh triggers the same mismatch
+    and compiles in seconds, so this guard runs in the default suite."""
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.parallel import mesh as mesh_lib
+
+    foreign = mesh_lib.build_mesh(
+        ParallelStrategy(data_parallel_size=4, tensor_parallel_size=2)
+    )
+    eng = None
+    mesh_lib.set_current_mesh(foreign)
+    try:
+        cfg = JaxDecodeConfig(
+            context_length=64,
+            max_running_requests=2,
+            new_tokens_per_chunk=4,
+            dtype="float32",
+            kv_cache_dtype="float32",
+        )
+        eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+        eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+        eng.initialize()
+        prompt = [1, 5, 9, 13, 2]
+        resp = eng.generate(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(greedy=True, max_new_tokens=5),
+            ),
+            timeout=2400,
+        )
+        assert resp.output_len == 5
+        expected = greedy_reference(eng.params, prompt, 5)
+        assert resp.output_tokens == expected
+    finally:
+        if eng is not None:
+            eng.destroy()
+        mesh_lib.set_current_mesh(None)
