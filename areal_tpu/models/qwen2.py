@@ -58,6 +58,14 @@ class ModelConfig:
     num_key_value_heads: int = 16
     head_dim: int | None = None
     rope_theta: float = 10000.0
+    # RoPE frequency scaling (Llama-3.x "llama3" NTK-by-parts, or "linear"
+    # position-interpolation). Scalar fields, not a dict, so the frozen
+    # config stays hashable for jit static args.
+    rope_scaling_type: str | None = None
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     max_position_embeddings: int = 32768
@@ -110,6 +118,35 @@ class ModelConfig:
         else:
             hf = dict(path_or_dict)
         model_type = hf.get("model_type", "qwen2")
+        # Llama/Mistral-family checkpoints share the qwen2 decoder layout
+        # and tensor names exactly (RMSNorm + SwiGLU + RoPE GQA, biasless
+        # qkv); what distinguishes Llama-3.x is its RoPE frequency scaling,
+        # parsed below. Parity: the reference's per-family from_hf registry
+        # (realhf/api/from_hf/{llama,qwen2}.py) collapses to one config here.
+        rope_kw: dict = {}
+        rs = hf.get("rope_scaling") or {}
+        rs_type = rs.get("rope_type", rs.get("type"))
+        if rs_type in ("llama3",):
+            rope_kw = dict(
+                rope_scaling_type="llama3",
+                rope_scaling_factor=rs.get("factor", 8.0),
+                rope_low_freq_factor=rs.get("low_freq_factor", 1.0),
+                rope_high_freq_factor=rs.get("high_freq_factor", 4.0),
+                rope_original_max_position=rs.get(
+                    "original_max_position_embeddings", 8192
+                ),
+            )
+        elif rs_type == "linear":
+            rope_kw = dict(
+                rope_scaling_type="linear",
+                rope_scaling_factor=rs.get("factor", 1.0),
+            )
+        elif rs_type not in (None, "default", "mrope"):
+            # yarn/dynamic etc.: loading would silently misplace positions
+            raise NotImplementedError(
+                f"rope_scaling type {rs_type!r} not implemented "
+                "(supported: llama3, linear)"
+            )
         kw = dict(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -126,6 +163,7 @@ class ModelConfig:
             max_position_embeddings=hf.get("max_position_embeddings", 32768),
             qkv_bias=model_type in ("qwen2",),
             qk_norm=model_type in ("qwen3", "qwen3_moe"),
+            **rope_kw,
         )
         if model_type == "qwen3_moe":
             kw.update(
@@ -148,6 +186,21 @@ class ModelConfig:
     @property
     def moe_intermediate_size_(self) -> int:
         return self.moe_intermediate_size or self.intermediate_size
+
+    @property
+    def rope_scaling_(self) -> tuple | None:
+        """Hashable scaling spec for `rope_table`, or None when unscaled."""
+        if self.rope_scaling_type == "llama3":
+            return (
+                "llama3",
+                self.rope_scaling_factor,
+                self.rope_low_freq_factor,
+                self.rope_high_freq_factor,
+                self.rope_original_max_position,
+            )
+        if self.rope_scaling_type == "linear":
+            return ("linear", self.rope_scaling_factor)
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -333,11 +386,43 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (x * weight.astype(jnp.float32)).astype(dtype)
 
 
-def rope_table(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
-    """(cos, sin) tables [T, head_dim/2], float32."""
+def rope_table(
+    positions: jax.Array,
+    head_dim: int,
+    theta: float,
+    scaling: tuple | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables [T, head_dim/2], float32.
+
+    `scaling` (from ModelConfig.rope_scaling_) applies HF-compatible RoPE
+    frequency scaling: ("linear", factor) divides every frequency
+    (position interpolation), ("llama3", factor, low, high, orig_max) is
+    Llama-3.x NTK-by-parts — low frequencies divided by `factor`, high
+    frequencies untouched, a smooth ramp between (the math of HF
+    `_compute_llama3_parameters`, transformers modeling_rope_utils)."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling is not None and scaling[0] == "linear":
+        inv_freq = inv_freq / scaling[1]
+    elif scaling is not None and scaling[0] == "llama3":
+        _, factor, low_f, high_f, orig_max = scaling
+        low_wl = orig_max / low_f
+        high_wl = orig_max / high_f
+        wavelen = 2.0 * jnp.pi / inv_freq
+        # ramp: 0 at high-freq boundary (keep), 1 at low-freq boundary (scale)
+        smooth = (orig_max / wavelen - low_f) / (high_f - low_f)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = jnp.where(
+            wavelen > low_wl,
+            inv_freq / factor,
+            jnp.where(
+                wavelen < high_wl,
+                inv_freq,
+                (1.0 - smooth) * inv_freq / factor + smooth * inv_freq,
+            ),
+        )
+        inv_freq = scaled
     angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
     return jnp.cos(angles), jnp.sin(angles)
 
@@ -592,7 +677,7 @@ def forward(
         "tokens",
         "act_embed",
     )
-    cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta)
+    cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)
     # Dense path: build the [T,T] mask ONCE here (outside the per-layer remat
     # region); flash/ring never materialise it.
     mask = (
@@ -686,7 +771,7 @@ def forward_pipelined(
 
     def stage_fn(layers_local, h, aux_t):
         pos, seg = aux_t
-        cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta)
+        cos, sin = rope_table(pos, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)
 
         def body(carry, layer_p):
             h, aux_sum = carry
@@ -704,9 +789,7 @@ def forward_pipelined(
     # (b) attention must not resolve to ring (its own shard_map does not
     # nest inside the pp-manual region) — with no mesh it resolves to
     # flash/dense, both GSPMD-partitionable along the auto axes.
-    prev_mesh = mesh_lib.current_mesh()
-    mesh_lib.set_current_mesh(None)
-    try:
+    with mesh_lib.mesh_scope(None):
         ys, aux_total = pipeline_trunk(
             mesh,
             stage_fn,
@@ -714,8 +797,6 @@ def forward_pipelined(
             x,
             (position_ids, segment_ids),
         )
-    finally:
-        mesh_lib.set_current_mesh(prev_mesh)
 
     def head_of(y):
         h = rms_norm(y, params["final_norm"], cfg.rms_norm_eps)
@@ -825,7 +906,7 @@ def prefill(
     if rope_cos is not None:
         cos, sin = rope_cos, rope_sin
     else:
-        cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta)
+        cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)
     T = input_ids.shape[0]
     causal = jnp.tril(jnp.ones((T, T), dtype=bool))
     nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -904,7 +985,7 @@ def decode_step(
     group = nH // nKV
     x = params["embed"]["embedding"][tokens].astype(compute_dtype)  # [R, H]
     rope_pos = positions if rope_offset is None else positions + rope_offset
-    cos, sin = rope_table(rope_pos, cfg.head_dim_, cfg.rope_theta)  # [R, hd/2]
+    cos, sin = rope_table(rope_pos, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)  # [R, hd/2]
     valid = jnp.arange(S)[None, :] <= positions[:, None]  # [R, S]
 
     def write(cache_l, new):  # [R, S, nKV, hd] <- [R, nKV, hd]

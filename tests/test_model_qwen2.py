@@ -193,3 +193,64 @@ def test_hf_save_load_roundtrip(tiny_cfg, tiny_params, tmp_path):
         tiny_params,
         reloaded,
     )
+
+
+def test_llama_config_and_rope_scaling():
+    """Llama-3.x checkpoints load through the same decoder: biasless qkv,
+    no qk-norm, and the "llama3" NTK-by-parts RoPE scaling must match the
+    HF reference formula (transformers modeling_rope_utils
+    _compute_llama3_parameters)."""
+    from areal_tpu.models.qwen2 import ModelConfig, rope_table
+
+    hf_cfg = dict(
+        model_type="llama",
+        vocab_size=128256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rope_theta=500000.0,
+        rope_scaling=dict(
+            rope_type="llama3",
+            factor=8.0,
+            low_freq_factor=1.0,
+            high_freq_factor=4.0,
+            original_max_position_embeddings=8192,
+        ),
+    )
+    cfg = ModelConfig.from_hf_config(hf_cfg)
+    assert not cfg.qkv_bias and not cfg.qk_norm
+    assert cfg.rope_scaling_ == ("llama3", 8.0, 1.0, 4.0, 8192)
+
+    # numpy transcription of the HF formula
+    hd, theta = cfg.head_dim_, cfg.rope_theta
+    inv_freq = 1.0 / (theta ** (np.arange(0, hd, 2, dtype=np.float64) / hd))
+    factor, low_f, high_f, orig = 8.0, 1.0, 4.0, 8192
+    wavelen = 2 * np.pi / inv_freq
+    ref = np.where(wavelen > orig / low_f, inv_freq / factor, inv_freq)
+    smooth = (orig / wavelen - low_f) / (high_f - low_f)
+    smoothed = (1 - smooth) / factor * inv_freq + smooth * inv_freq
+    medium = ~(wavelen < orig / high_f) & ~(wavelen > orig / low_f)
+    ref = np.where(medium, smoothed, ref)
+
+    pos = np.arange(7, dtype=np.int32)
+    cos, sin = rope_table(
+        jnp.asarray(pos), hd, theta, cfg.rope_scaling_
+    )
+    np.testing.assert_allclose(
+        np.asarray(cos), np.cos(pos[:, None] * ref[None, :]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(sin), np.sin(pos[:, None] * ref[None, :]), rtol=1e-5
+    )
+
+    # linear scaling = position interpolation: scaled positions 2k land
+    # where unscaled positions k do
+    lin = ModelConfig.from_hf_config(
+        {**hf_cfg, "rope_scaling": {"type": "linear", "factor": 2.0}}
+    )
+    assert lin.rope_scaling_ == ("linear", 2.0)
+    c2, _ = rope_table(jnp.asarray(pos * 2), hd, theta, lin.rope_scaling_)
+    c1, _ = rope_table(jnp.asarray(pos), hd, theta, None)
+    np.testing.assert_allclose(np.asarray(c2), np.asarray(c1), rtol=1e-5)
