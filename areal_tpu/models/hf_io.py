@@ -7,8 +7,10 @@ HF stores linear weights as [out, in] (torch convention); our kernels are
 [in, out]-shaped einsum operands with heads split out, so loading is a
 transpose + reshape per tensor.
 
-Supports Qwen2/2.5 (qkv bias), Qwen3 (qk norm), and Llama-family layouts.
-Files: model.safetensors or sharded model-*-of-*.safetensors with index.
+Supports Qwen2/2.5 (qkv bias), Qwen3 (qk norm), Llama/Mistral, Gemma,
+Qwen3-MoE / Qwen2-MoE (shared expert) and Mixtral (block_sparse_moe.*)
+layouts. Files: model.safetensors or sharded model-*-of-*.safetensors with
+index.
 """
 
 from __future__ import annotations
@@ -79,6 +81,13 @@ def hf_name_to_ours(name: str) -> tuple[str, ...] | None:
             "mlp.up_proj.weight": ("mlp", "up_kernel"),
             "mlp.down_proj.weight": ("mlp", "down_kernel"),
             "mlp.gate.weight": ("mlp", "router_kernel"),  # MoE router
+            # Qwen2-MoE shared expert (sigmoid-gated dense MLP)
+            "mlp.shared_expert.gate_proj.weight": ("mlp", "shared_gate_kernel"),
+            "mlp.shared_expert.up_proj.weight": ("mlp", "shared_up_kernel"),
+            "mlp.shared_expert.down_proj.weight": ("mlp", "shared_down_kernel"),
+            "mlp.shared_expert_gate.weight": ("mlp", "shared_router_kernel"),
+            # Mixtral router
+            "block_sparse_moe.gate.weight": ("mlp", "router_kernel"),
             "input_layernorm.weight": ("input_norm",),
             "post_attention_layernorm.weight": ("post_attn_norm",),
         }
@@ -92,6 +101,15 @@ def hf_name_to_ours(name: str) -> tuple[str, ...] | None:
             proj = sub[3]  # gate_proj | up_proj | down_proj
             leaf = {"gate_proj": "gate_kernel", "up_proj": "up_kernel",
                     "down_proj": "down_kernel"}.get(proj)
+            if leaf and sub[4] == "weight":
+                return (f"layers_{i}", "mlp", f"expert_{m}", leaf)
+        # Mixtral experts: block_sparse_moe.experts.{m}.w{1,2,3}.weight
+        # (w1 = gate, w3 = up, w2 = down — HF MixtralBlockSparseTop2MLP)
+        if rest.startswith("block_sparse_moe.experts."):
+            sub = rest.split(".")
+            m = int(sub[2])
+            leaf = {"w1": "gate_kernel", "w3": "up_kernel",
+                    "w2": "down_kernel"}.get(sub[3])
             if leaf and sub[4] == "weight":
                 return (f"layers_{i}", "mlp", f"expert_{m}", leaf)
     return None
@@ -112,7 +130,8 @@ def _convert_tensor(path: tuple[str, ...], w: np.ndarray, cfg: ModelConfig) -> n
     if leaf in ("k_bias", "v_bias"):
         return w.reshape(nKV, hd)
     if leaf in ("gate_kernel", "up_kernel", "down_kernel", "kernel",
-                "router_kernel"):
+                "router_kernel", "shared_gate_kernel", "shared_up_kernel",
+                "shared_down_kernel", "shared_router_kernel"):
         return np.ascontiguousarray(w.T)
     return w  # norms, embedding
 
@@ -128,7 +147,8 @@ def _unconvert_tensor(path: tuple[str, ...], w: np.ndarray, cfg: ModelConfig) ->
     if leaf in ("q_bias", "k_bias", "v_bias"):
         return w.reshape(-1)
     if leaf in ("gate_kernel", "up_kernel", "down_kernel", "kernel",
-                "router_kernel"):
+                "router_kernel", "shared_gate_kernel", "shared_up_kernel",
+                "shared_down_kernel", "shared_router_kernel"):
         return np.ascontiguousarray(w.T)
     return w
 
@@ -272,7 +292,10 @@ def flatten_params(params: dict, cfg: ModelConfig) -> dict[tuple[str, ...], np.n
     return flat
 
 
-def ours_name_to_hf(path: tuple[str, ...]) -> str:
+def ours_name_to_hf(path: tuple[str, ...], model_type: str = "qwen2") -> str:
+    """Our param path → the HF tensor name for `model_type`'s layout.
+    Only MoE naming differs by family (mixtral's block_sparse_moe.* vs the
+    qwen mlp.* names); everything else is the shared llama-style schema."""
     leaf_table = {
         ("attn", "q_kernel"): "self_attn.q_proj.weight",
         ("attn", "k_kernel"): "self_attn.k_proj.weight",
@@ -287,9 +310,15 @@ def ours_name_to_hf(path: tuple[str, ...]) -> str:
         ("mlp", "up_kernel"): "mlp.up_proj.weight",
         ("mlp", "down_kernel"): "mlp.down_proj.weight",
         ("mlp", "router_kernel"): "mlp.gate.weight",
+        ("mlp", "shared_gate_kernel"): "mlp.shared_expert.gate_proj.weight",
+        ("mlp", "shared_up_kernel"): "mlp.shared_expert.up_proj.weight",
+        ("mlp", "shared_down_kernel"): "mlp.shared_expert.down_proj.weight",
+        ("mlp", "shared_router_kernel"): "mlp.shared_expert_gate.weight",
         ("input_norm",): "input_layernorm.weight",
         ("post_attn_norm",): "post_attention_layernorm.weight",
     }
+    if model_type == "mixtral":
+        leaf_table[("mlp", "router_kernel")] = "block_sparse_moe.gate.weight"
     if path == ("embed", "embedding"):
         return "model.embed_tokens.weight"
     if path == ("final_norm",):
@@ -304,6 +333,15 @@ def ours_name_to_hf(path: tuple[str, ...]) -> str:
         i = int(path[0].split("_")[1])
         if len(path) == 4 and path[2].startswith("expert_"):
             m = int(path[2].split("_")[1])
+            if model_type == "mixtral":
+                w = {
+                    "gate_kernel": "w1",
+                    "up_kernel": "w3",
+                    "down_kernel": "w2",
+                }[path[3]]
+                return (
+                    f"model.layers.{i}.block_sparse_moe.experts.{m}.{w}.weight"
+                )
             proj = {
                 "gate_kernel": "gate_proj",
                 "up_kernel": "up_proj",
@@ -322,7 +360,7 @@ def save_hf_params(params: dict, cfg: ModelConfig, out_dir: str) -> str:
     flat = flatten_params(params, cfg)
     tensors = {}
     for path, w in flat.items():
-        hf_name = ours_name_to_hf(path)
+        hf_name = ours_name_to_hf(path, cfg.model_type)
         arr = _unconvert_tensor(path, np.asarray(w), cfg)
         # numpy safetensors cannot store bfloat16; upcast for the disk copy
         if arr.dtype == jnp.bfloat16:

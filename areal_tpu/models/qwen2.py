@@ -1,4 +1,4 @@
-"""Qwen2/2.5/3- and Llama-class decoder, TPU-first.
+"""Qwen2/2.5/3-, Llama/Mistral-, Gemma- and MoE-class decoder, TPU-first.
 
 Replaces the reference's HF-model-plus-patches approach (areal/engine/
 base_hf_engine.py loads transformers models; realhf/impl/model/nn/
@@ -21,8 +21,12 @@ pytree:
   compile time in depth, and the stacked axis is what pipeline parallelism
   shards.
 
-Covers the reference's model families of record (Qwen2.5 / Qwen3 dense incl.
-QK-norm, Llama via flags — realhf/api/from_hf/ registry).
+Covers the reference's model families of record (realhf/api/from_hf/
+registry: qwen2, qwen3, llama, mistral, gemma, mixtral, qwen2_moe/qwen3_moe)
+— one decoder parameterized by flags rather than one module per family:
+activation (`hidden_act`), Gemma's zero-centered RMSNorm + sqrt(H)
+embedding scaling, Mixtral/Qwen2-MoE routing conventions and the Qwen2-MoE
+shared expert.
 """
 
 from __future__ import annotations
@@ -69,10 +73,20 @@ class ModelConfig:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     max_position_embeddings: int = 32768
+    # HF family tag of the source checkpoint; drives the save-side name
+    # mapping (hf_io) — the forward path keys off the feature flags below.
+    model_type: str = "qwen2"
     # Qwen2/2.5: bias on qkv projections; Llama: none.
     qkv_bias: bool = True
     # Qwen3: per-head RMSNorm on q and k.
     qk_norm: bool = False
+    # MLP activation: "silu" (SwiGLU families) | "gelu_pytorch_tanh" /
+    # "gelu" (Gemma's GeGLU).
+    hidden_act: str = "silu"
+    # Gemma conventions: RMSNorm scale stored zero-centered (effective
+    # scale = 1 + weight), and embeddings multiplied by sqrt(hidden_size).
+    norm_zero_centered: bool = False
+    normalize_embed: bool = False
     # compute/storage dtypes
     dtype: str = "bfloat16"
     param_dtype: str = "bfloat16"
@@ -97,6 +111,9 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 8
     moe_intermediate_size: int | None = None
+    # Qwen2-MoE: an always-on dense expert beside the routed ones, mixed in
+    # through a sigmoid gate (0 = no shared expert).
+    shared_expert_intermediate_size: int = 0
     norm_topk_prob: bool = True
     capacity_factor: float = 1.25
     router_aux_loss_coef: float = 0.0
@@ -161,8 +178,13 @@ class ModelConfig:
             rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
             tie_word_embeddings=hf.get("tie_word_embeddings", False),
             max_position_embeddings=hf.get("max_position_embeddings", 32768),
-            qkv_bias=model_type in ("qwen2",),
+            model_type=model_type,
+            qkv_bias=model_type in ("qwen2", "qwen2_moe"),
             qk_norm=model_type in ("qwen3", "qwen3_moe"),
+            # act_fn raises on anything unsupported, so an exotic
+            # hidden_act fails loudly at trace time instead of silently
+            # running silu.
+            hidden_act=hf.get("hidden_act", "silu"),
             **rope_kw,
         )
         if model_type == "qwen3_moe":
@@ -173,12 +195,52 @@ class ModelConfig:
                 norm_topk_prob=hf.get("norm_topk_prob", True),
                 router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.0),
             )
-        elif model_type in ("qwen2_moe", "mixtral"):
-            # Loading these would silently drop shared-expert weights
-            # (qwen2_moe) or miss the block_sparse_moe.* names (mixtral).
+        elif model_type == "qwen2_moe":
+            # Qwen1.5/2-MoE: routed experts + a sigmoid-gated shared expert.
+            # Only the homogeneous all-sparse stack is supported — a
+            # dense/sparse layer mix (mlp_only_layers / decoder_sparse_step)
+            # would break scan-over-layers' uniform per-layer pytree.
+            if hf.get("mlp_only_layers") or hf.get("decoder_sparse_step", 1) != 1:
+                raise NotImplementedError(
+                    "qwen2_moe with mlp_only_layers/decoder_sparse_step != 1 "
+                    "(heterogeneous dense/sparse layers) is not supported"
+                )
+            kw.update(
+                num_experts=hf.get("num_experts", 60),
+                num_experts_per_tok=hf.get("num_experts_per_tok", 4),
+                moe_intermediate_size=hf.get("moe_intermediate_size"),
+                shared_expert_intermediate_size=hf.get(
+                    "shared_expert_intermediate_size", 0
+                ),
+                norm_topk_prob=hf.get("norm_topk_prob", False),
+                router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.0),
+            )
+        elif model_type == "mixtral":
+            # Mixtral: top-k over full-softmax probs, renormalized — the
+            # norm_topk_prob=True convention; experts reuse
+            # intermediate_size; weights live under block_sparse_moe.*.
+            kw.update(
+                num_experts=hf.get("num_local_experts", 8),
+                num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+                moe_intermediate_size=hf["intermediate_size"],
+                norm_topk_prob=True,
+                router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.0),
+            )
+        elif model_type == "gemma":
+            # Gemma-1 (reference: realhf/api/from_hf/gemma.py — GeGLU MLP,
+            # zero-centered RMSNorm, sqrt(H)-scaled embeddings, tied head).
+            kw.update(
+                # HF Gemma ignores legacy `hidden_act` and defaults the
+                # newer `hidden_activation` field to gelu_pytorch_tanh.
+                hidden_act=hf.get("hidden_activation") or "gelu_pytorch_tanh",
+                norm_zero_centered=True,
+                normalize_embed=True,
+                tie_word_embeddings=hf.get("tie_word_embeddings", True),
+            )
+        elif model_type == "gemma2":
             raise NotImplementedError(
-                f"model_type={model_type!r}: shared-expert / mixtral weight "
-                "mapping not implemented — supported MoE family is qwen3_moe"
+                "gemma2 (attention softcapping, pre+post norms, sliding "
+                "window) is not implemented; supported gemma family: gemma"
             )
         kw.update(overrides)
         return cls(**kw)
@@ -230,6 +292,16 @@ def _layer_shapes(cfg: ModelConfig) -> dict:
                 "gate_kernel": (cfg.num_experts, H, cfg.moe_intermediate_size_),
                 "up_kernel": (cfg.num_experts, H, cfg.moe_intermediate_size_),
                 "down_kernel": (cfg.num_experts, cfg.moe_intermediate_size_, H),
+                **(
+                    {
+                        "shared_gate_kernel": (H, cfg.shared_expert_intermediate_size),
+                        "shared_up_kernel": (H, cfg.shared_expert_intermediate_size),
+                        "shared_down_kernel": (cfg.shared_expert_intermediate_size, H),
+                        "shared_router_kernel": (H, 1),
+                    }
+                    if cfg.shared_expert_intermediate_size
+                    else {}
+                ),
             }
         ),
         "input_norm": (H,),
@@ -271,11 +343,23 @@ _MOE_MLP_AXES = {
     "gate_kernel": ("experts", "embed", "mlp"),
     "up_kernel": ("experts", "embed", "mlp"),
     "down_kernel": ("experts", "mlp", "embed"),
+    # qwen2_moe shared expert: a dense MLP, tp-sharded like one.
+    "shared_gate_kernel": ("embed", "mlp"),
+    "shared_up_kernel": ("embed", "mlp"),
+    "shared_down_kernel": ("mlp", "embed"),
+    "shared_router_kernel": ("embed", None),
 }
 
 
 def _mlp_axes(cfg: ModelConfig) -> dict:
-    return dict(_MOE_MLP_AXES) if cfg.num_experts else dict(_LAYER_AXES["mlp"])
+    if not cfg.num_experts:
+        return dict(_LAYER_AXES["mlp"])
+    axes = dict(_MOE_MLP_AXES)
+    if not cfg.shared_expert_intermediate_size:
+        for k in list(axes):
+            if k.startswith("shared_"):
+                del axes[k]
+    return axes
 
 
 def param_shapes(cfg: ModelConfig) -> dict:
@@ -363,14 +447,17 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
         for s, k in zip(leaves, keys)
     ]
     params = jax.tree.unflatten(treedef, inited)
-    # biases start at zero
-    def zero_biases(path, x):
+    # biases start at zero; zero-centered norms (Gemma) too, since their
+    # effective scale is 1 + weight
+    def zero_special(path, x):
         name = path[-1].key if hasattr(path[-1], "key") else ""
         if name.endswith("_bias") or name == "bias":
             return jnp.zeros_like(x)
+        if cfg.norm_zero_centered and name.endswith("norm"):
+            return jnp.zeros_like(x)
         return x
 
-    return jax.tree_util.tree_map_with_path(zero_biases, params)
+    return jax.tree_util.tree_map_with_path(zero_special, params)
 
 
 # ---------------------------------------------------------------------------
@@ -378,12 +465,40 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, zero_centered: bool = False
+) -> jax.Array:
+    """f32 RMSNorm. `zero_centered` (Gemma): effective scale = 1 + weight."""
     dtype = x.dtype
     x = x.astype(jnp.float32)
     var = jnp.mean(x * x, axis=-1, keepdims=True)
     x = x * jax.lax.rsqrt(var + eps)
-    return (x * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if zero_centered:
+        w = w + 1.0
+    return (x * w).astype(dtype)
+
+
+def _norm(x: jax.Array, weight: jax.Array, cfg: ModelConfig) -> jax.Array:
+    return rms_norm(x, weight, cfg.rms_norm_eps, cfg.norm_zero_centered)
+
+
+def act_fn(cfg: ModelConfig):
+    """MLP activation from cfg.hidden_act (HF ACT2FN-compatible subset)."""
+    if cfg.hidden_act == "silu":
+        return jax.nn.silu
+    if cfg.hidden_act in ("gelu_pytorch_tanh", "gelu_new"):
+        return lambda x: jax.nn.gelu(x, approximate=True)
+    if cfg.hidden_act == "gelu":
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    raise NotImplementedError(f"hidden_act={cfg.hidden_act!r}")
+
+
+def _scale_embed(x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Gemma multiplies embedding outputs by sqrt(hidden_size)."""
+    if cfg.normalize_embed:
+        return x * jnp.asarray(np.sqrt(cfg.hidden_size), dtype=x.dtype)
+    return x
 
 
 def rope_table(
@@ -494,8 +609,8 @@ def attention(
         k = k + layer_p["k_bias"]
         v = v + layer_p["v_bias"]
     if cfg.qk_norm:
-        q = rms_norm(q, layer_p["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, layer_p["k_norm"], cfg.rms_norm_eps)
+        q = _norm(q, layer_p["q_norm"], cfg)
+        k = _norm(k, layer_p["k_norm"], cfg)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     q = _cstr(q, "tokens", "act_heads", None)
@@ -532,10 +647,11 @@ def attention(
     )
 
 
-def mlp(layer_p: dict, x: jax.Array) -> jax.Array:
+def mlp(layer_p: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    act = act_fn(cfg)
     gate = jnp.einsum("th,hm->tm", x, layer_p["gate_kernel"])
     up = jnp.einsum("th,hm->tm", x, layer_p["up_kernel"])
-    h = _cstr(jax.nn.silu(gate) * up, "tokens", "act_mlp")
+    h = _cstr(act(gate) * up, "tokens", "act_mlp")
     return _cstr(
         jnp.einsum("tm,mh->th", h, layer_p["down_kernel"]),
         "tokens",
@@ -606,13 +722,31 @@ def moe_mlp(
         combine = combine + slot_oh * gates_g[..., k][..., None, None]
         counts = counts + oh.sum(axis=1)
 
+    act = act_fn(cfg)
     xe = jnp.einsum("gsec,gsh->gech", dispatch, xg)  # [G, E, C, H]
     h_gate = jnp.einsum("gech,ehm->gecm", xe, layer_p["gate_kernel"])
     h_up = jnp.einsum("gech,ehm->gecm", xe, layer_p["up_kernel"])
-    he = jax.nn.silu(h_gate) * h_up
+    he = act(h_gate) * h_up
     ye = jnp.einsum("gecm,emh->gech", he, layer_p["down_kernel"])
     y = jnp.einsum("gsec,gech->gsh", combine.astype(ye.dtype), ye)
     y = y.reshape(T, H).astype(x.dtype)
+
+    if cfg.shared_expert_intermediate_size:
+        # Qwen2-MoE shared expert: dense SwiGLU mixed in via a per-token
+        # sigmoid gate (HF Qwen2MoeSparseMoeBlock semantics).
+        s_gate = jnp.einsum("th,hm->tm", x, layer_p["shared_gate_kernel"])
+        s_up = jnp.einsum("th,hm->tm", x, layer_p["shared_up_kernel"])
+        ys = jnp.einsum(
+            "tm,mh->th", act(s_gate) * s_up, layer_p["shared_down_kernel"]
+        )
+        g = jax.nn.sigmoid(
+            jnp.einsum(
+                "th,hk->tk",
+                x.astype(jnp.float32),
+                layer_p["shared_router_kernel"].astype(jnp.float32),
+            )
+        ).astype(x.dtype)
+        y = y + g * ys
 
     # Switch/GShard load-balancing aux over REAL tokens only:
     # E * sum_e fraction_assigned_e * mean_prob_e
@@ -639,15 +773,15 @@ def decoder_layer(
     cfg: ModelConfig,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (hidden [T, H], router aux loss scalar — 0 for dense)."""
-    h = rms_norm(x, layer_p["input_norm"], cfg.rms_norm_eps)
+    h = _norm(x, layer_p["input_norm"], cfg)
     x = x + attention(layer_p["attn"], h, cos, sin, segment_ids, mask, cfg)
-    h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
+    h = _norm(x, layer_p["post_attn_norm"], cfg)
     if cfg.num_experts:
         y, aux = moe_mlp(
             layer_p["mlp"], h, cfg, valid=segment_ids != PADDING_SEGMENT
         )
     else:
-        y, aux = mlp(layer_p["mlp"], h), jnp.float32(0.0)
+        y, aux = mlp(layer_p["mlp"], h, cfg), jnp.float32(0.0)
     return x + y, aux
 
 
@@ -673,7 +807,7 @@ def forward(
     # consumer wants and forces a full-remat reshard in the backward.
     table = _cstr(params["embed"]["embedding"], "vocab", None)
     x = _cstr(
-        table[input_ids].astype(compute_dtype),
+        _scale_embed(table[input_ids].astype(compute_dtype), cfg),
         "tokens",
         "act_embed",
     )
@@ -707,7 +841,7 @@ def forward(
             )
             aux_total = aux_total + aux
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     if cfg.is_critic:
         values = (
             jnp.einsum("th,hk->tk", x, params["value_head"]["kernel"])
@@ -763,7 +897,7 @@ def forward_pipelined(
     assert cfg.scan_layers, "pipeline parallelism requires scan_layers=True"
 
     table = _cstr(params["embed"]["embedding"], "vocab", None)
-    x = table[input_ids].astype(compute_dtype)  # [M, T, H]
+    x = _scale_embed(table[input_ids].astype(compute_dtype), cfg)  # [M, T, H]
 
     layer_fn = decoder_layer
     if cfg.remat:
@@ -799,7 +933,7 @@ def forward_pipelined(
         )
 
     def head_of(y):
-        h = rms_norm(y, params["final_norm"], cfg.rms_norm_eps)
+        h = _norm(y, params["final_norm"], cfg)
         if cfg.is_critic:
             values = (
                 jnp.einsum("th,hk->tk", h, params["value_head"]["kernel"])
@@ -855,8 +989,8 @@ def _project_qkv(layer_p: dict, x: jax.Array, cos, sin, cfg: ModelConfig):
         k = k + layer_p["k_bias"]
         v = v + layer_p["v_bias"]
     if cfg.qk_norm:
-        q = rms_norm(q, layer_p["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, layer_p["k_norm"], cfg.rms_norm_eps)
+        q = _norm(q, layer_p["q_norm"], cfg)
+        k = _norm(k, layer_p["k_norm"], cfg)
     cos_b = cos[..., None, :].astype(q.dtype)
     sin_b = sin[..., None, :].astype(q.dtype)
 
@@ -903,6 +1037,7 @@ def prefill(
         x = input_embeds.astype(compute_dtype)
     else:
         x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
+    x = _scale_embed(x, cfg)
     if rope_cos is not None:
         cos, sin = rope_cos, rope_sin
     else:
@@ -913,7 +1048,7 @@ def prefill(
     group = nH // nKV
 
     def layer(x, layer_p):
-        h = rms_norm(x, layer_p["input_norm"], cfg.rms_norm_eps)
+        h = _norm(x, layer_p["input_norm"], cfg)
         q, k, v = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
         qg = q.reshape(T, nKV, group, hd)
         scores = jnp.einsum("tkgd,skd->kgts", qg, k).astype(jnp.float32)
@@ -924,11 +1059,11 @@ def prefill(
         x = x + jnp.einsum(
             "tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"]
         )
-        h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
+        h = _norm(x, layer_p["post_attn_norm"], cfg)
         if cfg.num_experts:
             y, _ = moe_mlp(layer_p["mlp"], h, cfg, valid=valid)
         else:
-            y = mlp(layer_p["mlp"], h)
+            y = mlp(layer_p["mlp"], h, cfg)
         x = x + y
         return x, (k, v)
 
@@ -944,7 +1079,7 @@ def prefill(
 
     if not with_logits:
         return None, ks, vs
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     if cfg.tie_word_embeddings:
         logits = jnp.einsum(
             "th,vh->tv", x, params["embed"]["embedding"].astype(compute_dtype)
@@ -983,7 +1118,9 @@ def decode_step(
     S = k_cache.shape[2]
     nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
     group = nH // nKV
-    x = params["embed"]["embedding"][tokens].astype(compute_dtype)  # [R, H]
+    x = _scale_embed(
+        params["embed"]["embedding"][tokens].astype(compute_dtype), cfg
+    )  # [R, H]
     rope_pos = positions if rope_offset is None else positions + rope_offset
     cos, sin = rope_table(rope_pos, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)  # [R, hd/2]
     valid = jnp.arange(S)[None, :] <= positions[:, None]  # [R, S]
@@ -998,7 +1135,7 @@ def decode_step(
 
     def layer(x, inputs):
         layer_p, kc, vc = inputs
-        h = rms_norm(x, layer_p["input_norm"], cfg.rms_norm_eps)
+        h = _norm(x, layer_p["input_norm"], cfg)
         q, k_new, v_new = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
         kc = write(kc, k_new.astype(kc.dtype))
         vc = write(vc, v_new.astype(vc.dtype))
@@ -1011,11 +1148,11 @@ def decode_step(
             "rkgs,rskd->rkgd", probs, vc.astype(x.dtype)
         ).reshape(R, nH, hd)
         x = x + jnp.einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"])
-        h = rms_norm(x, layer_p["post_attn_norm"], cfg.rms_norm_eps)
+        h = _norm(x, layer_p["post_attn_norm"], cfg)
         if cfg.num_experts:
             y, _ = moe_mlp(layer_p["mlp"], h, cfg, valid=active)
         else:
-            y = mlp(layer_p["mlp"], h)
+            y = mlp(layer_p["mlp"], h, cfg)
         x = x + y
         return x, (kc, vc)
 
@@ -1033,7 +1170,7 @@ def decode_step(
             vcs.append(vc)
         k_cache, v_cache = jnp.stack(kcs), jnp.stack(vcs)
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     if cfg.tie_word_embeddings:
         logits = jnp.einsum(
             "rh,vh->rv", x, params["embed"]["embedding"].astype(compute_dtype)

@@ -1,0 +1,166 @@
+"""HF numerical parity for the widened model-family registry.
+
+The reference supports gemma / mixtral / qwen2_moe through its per-family
+from_hf converters (realhf/api/from_hf/{gemma,mixtral,qwen2.py + registry});
+here one flag-parameterized decoder covers them, so each family gets a
+golden test against the transformers implementation on a tiny random
+checkpoint, exercising config parsing, weight mapping, and forward math.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from areal_tpu.models.hf_io import load_hf_params, save_hf_params
+from areal_tpu.models.qwen2 import ModelConfig, forward
+
+torch = pytest.importorskip("torch")
+
+
+def _save_tiny(model, tmp_path, expect_type):
+    model_dir = tmp_path / "hf"
+    model.save_pretrained(model_dir, safe_serialization=True)
+    with open(model_dir / "config.json") as f:
+        assert json.load(f)["model_type"] == expect_type
+    return str(model_dir)
+
+
+def _parity(model, model_dir, vocab, T=12, atol=2e-3, **overrides):
+    cfg = ModelConfig.from_hf_config(
+        model_dir, dtype="float32", param_dtype="float32", **overrides
+    )
+    params = load_hf_params(model_dir, cfg)
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, vocab, (T,))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids)[None]).logits[0].numpy()
+    ours = np.asarray(
+        forward(params, ids, np.arange(T), np.zeros(T, dtype=np.int32), cfg)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=atol, rtol=1e-3)
+    return cfg, params
+
+
+def test_gemma_numerical_parity(tmp_path):
+    """Gemma-1: GeGLU MLP, zero-centered RMSNorm, sqrt(H)-scaled embeddings,
+    tied lm_head, explicit head_dim != H/nH."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    hf_cfg = GemmaConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,  # nH*hd = 64 != H=32: the real gemma geometry quirk
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = GemmaForCausalLM(hf_cfg).eval().float()
+    model_dir = _save_tiny(model, tmp_path, "gemma")
+    cfg, _ = _parity(model, model_dir, 96)
+    assert cfg.norm_zero_centered and cfg.normalize_embed
+    assert cfg.tie_word_embeddings and not cfg.qkv_bias
+    assert cfg.hidden_act == "gelu_pytorch_tanh"
+
+
+def test_mixtral_numerical_parity(tmp_path):
+    """Mixtral: block_sparse_moe.* weight names, w1/w3/w2 expert layout,
+    renormalized top-k routing."""
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    hf_cfg = MixtralConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=48,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=128,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = MixtralForCausalLM(hf_cfg).eval().float()
+    model_dir = _save_tiny(model, tmp_path, "mixtral")
+    # ample capacity: HF routes without drops; match it for the golden check
+    cfg, params = _parity(model, model_dir, 96, capacity_factor=8.0)
+    assert cfg.num_experts == 4 and cfg.norm_topk_prob
+    assert cfg.moe_intermediate_size_ == 48
+
+    # roundtrip preserves mixtral naming
+    out = save_hf_params(params, cfg, str(tmp_path / "ckpt"))
+    reloaded = load_hf_params(out, cfg, dtype="float32")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        params,
+        reloaded,
+    )
+
+
+def test_qwen2_moe_numerical_parity(tmp_path):
+    """Qwen2-MoE: routed experts + sigmoid-gated shared expert, qkv bias,
+    unnormalized top-k gates (norm_topk_prob=False)."""
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    hf_cfg = Qwen2MoeConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_experts=4,
+        num_experts_per_tok=2,
+        moe_intermediate_size=16,
+        shared_expert_intermediate_size=48,
+        norm_topk_prob=False,
+        decoder_sparse_step=1,
+        mlp_only_layers=[],
+        max_position_embeddings=128,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = Qwen2MoeForCausalLM(hf_cfg).eval().float()
+    model_dir = _save_tiny(model, tmp_path, "qwen2_moe")
+    cfg, _ = _parity(model, model_dir, 96, capacity_factor=8.0)
+    assert cfg.shared_expert_intermediate_size == 48
+    assert cfg.qkv_bias and not cfg.norm_topk_prob
+
+
+def test_qwen2_moe_heterogeneous_rejected(tmp_path):
+    with pytest.raises(NotImplementedError):
+        ModelConfig.from_hf_config(
+            {
+                "model_type": "qwen2_moe",
+                "vocab_size": 96,
+                "hidden_size": 32,
+                "intermediate_size": 64,
+                "num_hidden_layers": 4,
+                "num_attention_heads": 4,
+                "mlp_only_layers": [0, 1],
+            }
+        )
+
+
+def test_gemma2_rejected():
+    with pytest.raises(NotImplementedError):
+        ModelConfig.from_hf_config(
+            {
+                "model_type": "gemma2",
+                "vocab_size": 96,
+                "hidden_size": 32,
+                "intermediate_size": 64,
+                "num_hidden_layers": 2,
+                "num_attention_heads": 4,
+            }
+        )
