@@ -153,6 +153,70 @@ def _unconvert_tensor(path: tuple[str, ...], w: np.ndarray, cfg: ModelConfig) ->
     return w
 
 
+def _gpt2_flat(model_dir: str, cfg: ModelConfig) -> dict:
+    """GPT-2 checkpoint → flat path dict.
+
+    GPT-2 needs its own mapping pass: weights are Conv1D ([in, out] — no
+    transpose, unlike Linear), the QKV projection is one fused `c_attn`
+    tensor split three ways, and layernorms carry biases (reference
+    counterpart: realhf/api/from_hf/gpt2.py sd_from_gpt2)."""
+    H = cfg.hidden_size
+    nH, hd = cfg.num_attention_heads, cfg.head_dim_
+    flat: dict[tuple[str, ...], np.ndarray] = {}
+    for name, w in _iter_hf_tensors(model_dir):
+        name = name.removeprefix("transformer.")
+        if name == "wte.weight":
+            flat[("embed", "embedding")] = w
+        elif name == "wpe.weight":
+            flat[("pos_embed", "embedding")] = w
+        elif name == "ln_f.weight":
+            flat[("final_norm",)] = w
+        elif name == "ln_f.bias":
+            flat[("final_norm_bias",)] = w
+        elif name == "lm_head.weight":  # untied head (torch Linear [V, H])
+            flat[("lm_head", "kernel")] = np.ascontiguousarray(w.T)
+        elif name == "score.weight":  # critic value head
+            flat[("value_head", "kernel")] = np.ascontiguousarray(w.T)
+        elif name == "score.bias":
+            flat[("value_head", "bias")] = w
+        elif name.startswith("h."):
+            parts = name.split(".")
+            li = f"layers_{int(parts[1])}"
+            rest = ".".join(parts[2:])
+            if rest == "ln_1.weight":
+                flat[(li, "input_norm")] = w
+            elif rest == "ln_1.bias":
+                flat[(li, "input_norm_bias")] = w
+            elif rest == "ln_2.weight":
+                flat[(li, "post_attn_norm")] = w
+            elif rest == "ln_2.bias":
+                flat[(li, "post_attn_norm_bias")] = w
+            elif rest == "attn.c_attn.weight":  # [H, 3H] fused qkv
+                q, k, v = np.split(w, 3, axis=1)
+                flat[(li, "attn", "q_kernel")] = q.reshape(H, nH, hd)
+                flat[(li, "attn", "k_kernel")] = k.reshape(H, nH, hd)
+                flat[(li, "attn", "v_kernel")] = v.reshape(H, nH, hd)
+            elif rest == "attn.c_attn.bias":  # [3H]
+                q, k, v = np.split(w, 3)
+                flat[(li, "attn", "q_bias")] = q.reshape(nH, hd)
+                flat[(li, "attn", "k_bias")] = k.reshape(nH, hd)
+                flat[(li, "attn", "v_bias")] = v.reshape(nH, hd)
+            elif rest == "attn.c_proj.weight":  # [H, H], already [in, out]
+                flat[(li, "attn", "o_kernel")] = w.reshape(nH, hd, H)
+            elif rest == "attn.c_proj.bias":
+                flat[(li, "attn", "o_bias")] = w
+            elif rest == "mlp.c_fc.weight":  # [H, I]
+                flat[(li, "mlp", "fc1_kernel")] = w
+            elif rest == "mlp.c_fc.bias":
+                flat[(li, "mlp", "fc1_bias")] = w
+            elif rest == "mlp.c_proj.weight":  # [I, H]
+                flat[(li, "mlp", "fc2_kernel")] = w
+            elif rest == "mlp.c_proj.bias":
+                flat[(li, "mlp", "fc2_bias")] = w
+            # attn.bias / attn.masked_bias causal-mask buffers: ignored
+    return flat
+
+
 def load_hf_params(
     model_dir: str, cfg: ModelConfig, dtype: str | None = None
 ) -> dict:
@@ -161,6 +225,8 @@ def load_hf_params(
     With cfg.scan_layers, per-layer tensors are stacked along axis 0.
     """
     dtype = dtype or cfg.param_dtype
+    if cfg.model_type == "gpt2":
+        return assemble_params(_gpt2_flat(model_dir, cfg), cfg, dtype)
     flat: dict[tuple[str, ...], np.ndarray] = {}
     for name, w in _iter_hf_tensors(model_dir):
         path = hf_name_to_ours(name)
@@ -352,6 +418,73 @@ def ours_name_to_hf(path: tuple[str, ...], model_type: str = "qwen2") -> str:
     raise KeyError(path)
 
 
+def _gpt2_tensors(flat: dict, cfg: ModelConfig) -> dict[str, np.ndarray]:
+    """Inverse of _gpt2_flat: our flat paths → transformer.* Conv1D tensors
+    (qkv re-fused into c_attn)."""
+    H = cfg.hidden_size
+    out: dict[str, np.ndarray] = {}
+    top = {
+        ("embed", "embedding"): "transformer.wte.weight",
+        ("pos_embed", "embedding"): "transformer.wpe.weight",
+        ("final_norm",): "transformer.ln_f.weight",
+        ("final_norm_bias",): "transformer.ln_f.bias",
+    }
+    transposed_top = {
+        # torch Linear [out, in] layout, unlike the Conv1D layer weights
+        ("lm_head", "kernel"): "lm_head.weight",
+        ("value_head", "kernel"): "score.weight",
+    }
+    leaf = {
+        "input_norm": "ln_1.weight",
+        "input_norm_bias": "ln_1.bias",
+        "post_attn_norm": "ln_2.weight",
+        "post_attn_norm_bias": "ln_2.bias",
+    }
+    qkv_w: dict[int, dict[str, np.ndarray]] = {}
+    qkv_b: dict[int, dict[str, np.ndarray]] = {}
+    for path, w in flat.items():
+        w = np.asarray(w)
+        if path in top:
+            out[top[path]] = w
+        elif path in transposed_top:
+            out[transposed_top[path]] = np.ascontiguousarray(w.T)
+        elif path == ("value_head", "bias"):
+            out["score.bias"] = w
+        elif path[0].startswith("layers_"):
+            i = int(path[0].split("_")[1])
+            pre = f"transformer.h.{i}."
+            rest = path[1:]
+            if len(rest) == 1 and rest[0] in leaf:
+                out[pre + leaf[rest[0]]] = w
+            elif rest[0] == "attn":
+                k = rest[1]
+                if k in ("q_kernel", "k_kernel", "v_kernel"):
+                    qkv_w.setdefault(i, {})[k[0]] = w.reshape(H, -1)
+                elif k in ("q_bias", "k_bias", "v_bias"):
+                    qkv_b.setdefault(i, {})[k[0]] = w.reshape(-1)
+                elif k == "o_kernel":
+                    out[pre + "attn.c_proj.weight"] = w.reshape(-1, H)
+                elif k == "o_bias":
+                    out[pre + "attn.c_proj.bias"] = w
+            elif rest[0] == "mlp":
+                name = {
+                    "fc1_kernel": "mlp.c_fc.weight",
+                    "fc1_bias": "mlp.c_fc.bias",
+                    "fc2_kernel": "mlp.c_proj.weight",
+                    "fc2_bias": "mlp.c_proj.bias",
+                }[rest[1]]
+                out[pre + name] = w
+    for i, parts in qkv_w.items():
+        out[f"transformer.h.{i}.attn.c_attn.weight"] = np.concatenate(
+            [parts["q"], parts["k"], parts["v"]], axis=1
+        )
+    for i, parts in qkv_b.items():
+        out[f"transformer.h.{i}.attn.c_attn.bias"] = np.concatenate(
+            [parts["q"], parts["k"], parts["v"]]
+        )
+    return out
+
+
 def save_hf_params(params: dict, cfg: ModelConfig, out_dir: str) -> str:
     """Write the param tree as a single HF-format safetensors file +
     config passthrough. Weights are saved in torch [out, in] layout so any
@@ -359,13 +492,23 @@ def save_hf_params(params: dict, cfg: ModelConfig, out_dir: str) -> str:
     os.makedirs(out_dir, exist_ok=True)
     flat = flatten_params(params, cfg)
     tensors = {}
-    for path, w in flat.items():
-        hf_name = ours_name_to_hf(path, cfg.model_type)
-        arr = _unconvert_tensor(path, np.asarray(w), cfg)
-        # numpy safetensors cannot store bfloat16; upcast for the disk copy
-        if arr.dtype == jnp.bfloat16:
-            arr = arr.astype(np.float32)
-        tensors[hf_name] = np.ascontiguousarray(arr)
+    if cfg.model_type == "gpt2":
+        tensors = _gpt2_tensors(flat, cfg)
+        tensors = {
+            k: np.ascontiguousarray(
+                v.astype(np.float32) if v.dtype == jnp.bfloat16 else v
+            )
+            for k, v in tensors.items()
+        }
+    else:
+        for path, w in flat.items():
+            hf_name = ours_name_to_hf(path, cfg.model_type)
+            arr = _unconvert_tensor(path, np.asarray(w), cfg)
+            # numpy safetensors cannot store bfloat16; upcast for the disk
+            # copy
+            if arr.dtype == jnp.bfloat16:
+                arr = arr.astype(np.float32)
+            tensors[hf_name] = np.ascontiguousarray(arr)
     save_file(tensors, os.path.join(out_dir, "model.safetensors"))
     return out_dir
 

@@ -81,12 +81,19 @@ class ModelConfig:
     # Qwen3: per-head RMSNorm on q and k.
     qk_norm: bool = False
     # MLP activation: "silu" (SwiGLU families) | "gelu_pytorch_tanh" /
-    # "gelu" (Gemma's GeGLU).
+    # "gelu_new" / "gelu" (Gemma's GeGLU, GPT-2's fc MLP).
     hidden_act: str = "silu"
     # Gemma conventions: RMSNorm scale stored zero-centered (effective
     # scale = 1 + weight), and embeddings multiplied by sqrt(hidden_size).
     norm_zero_centered: bool = False
     normalize_embed: bool = False
+    # GPT-2 conventions: mean-centering LayerNorm with bias, learned
+    # absolute position embeddings (wpe), ungated fc1/act/fc2 MLP, and a
+    # bias on the attention output projection.
+    norm_type: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    pos_embed: str = "rope"  # "rope" | "learned"
+    mlp_style: str = "glu"  # "glu" (gate/up/down) | "fc" (fc1/fc2)
+    attn_out_bias: bool = False
     # compute/storage dtypes
     dtype: str = "bfloat16"
     param_dtype: str = "bfloat16"
@@ -135,6 +142,22 @@ class ModelConfig:
         else:
             hf = dict(path_or_dict)
         model_type = hf.get("model_type", "qwen2")
+        if model_type == "gpt2":
+            # GPT2Config uses its own key names; normalize them up front so
+            # the shared kw block below reads one schema.
+            hf = dict(hf)
+            hf.setdefault("hidden_size", hf["n_embd"])
+            hf.setdefault(
+                "intermediate_size", hf.get("n_inner") or 4 * hf["n_embd"]
+            )
+            hf.setdefault("num_hidden_layers", hf["n_layer"])
+            hf.setdefault("num_attention_heads", hf["n_head"])
+            hf.setdefault("max_position_embeddings", hf["n_positions"])
+            hf.setdefault("rms_norm_eps", hf.get("layer_norm_epsilon", 1e-5))
+            hf.setdefault(
+                "hidden_act", hf.get("activation_function", "gelu_new")
+            )
+            hf.setdefault("tie_word_embeddings", True)
         # Llama/Mistral-family checkpoints share the qwen2 decoder layout
         # and tensor names exactly (RMSNorm + SwiGLU + RoPE GQA, biasless
         # qkv); what distinguishes Llama-3.x is its RoPE frequency scaling,
@@ -242,6 +265,25 @@ class ModelConfig:
                 "gemma2 (attention softcapping, pre+post norms, sliding "
                 "window) is not implemented; supported gemma family: gemma"
             )
+        elif model_type == "gpt2":
+            # GPT-2 (reference: realhf/api/from_hf/gpt2.py — its CPU-test
+            # workhorse): LayerNorm+bias, wpe positions, fc MLP, MHA with
+            # fused c_attn (split at load, hf_io._gpt2_flat).
+            if hf.get("scale_attn_by_inverse_layer_idx") or hf.get(
+                "reorder_and_upcast_attn"
+            ):
+                raise NotImplementedError(
+                    "gpt2 variants with scale_attn_by_inverse_layer_idx / "
+                    "reorder_and_upcast_attn would silently mis-scale "
+                    "attention; not implemented"
+                )
+            kw.update(
+                norm_type="layernorm",
+                pos_embed="learned",
+                mlp_style="fc",
+                qkv_bias=True,
+                attn_out_bias=True,
+            )
         kw.update(overrides)
         return cls(**kw)
 
@@ -281,11 +323,20 @@ def _layer_shapes(cfg: ModelConfig) -> dict:
             "o_kernel": (nH, hd, H),
         },
         "mlp": (
-            {
-                "gate_kernel": (H, M),
-                "up_kernel": (H, M),
-                "down_kernel": (M, H),
-            }
+            (
+                {
+                    "fc1_kernel": (H, M),
+                    "fc1_bias": (M,),
+                    "fc2_kernel": (M, H),
+                    "fc2_bias": (H,),
+                }
+                if cfg.mlp_style == "fc"
+                else {
+                    "gate_kernel": (H, M),
+                    "up_kernel": (H, M),
+                    "down_kernel": (M, H),
+                }
+            )
             if cfg.num_experts == 0
             else {
                 "router_kernel": (H, cfg.num_experts),
@@ -311,9 +362,14 @@ def _layer_shapes(cfg: ModelConfig) -> dict:
         shapes["attn"]["q_bias"] = (nH, hd)
         shapes["attn"]["k_bias"] = (nKV, hd)
         shapes["attn"]["v_bias"] = (nKV, hd)
+    if cfg.attn_out_bias:
+        shapes["attn"]["o_bias"] = (H,)
     if cfg.qk_norm:
         shapes["attn"]["q_norm"] = (hd,)
         shapes["attn"]["k_norm"] = (hd,)
+    if cfg.norm_type == "layernorm":
+        shapes["input_norm_bias"] = (H,)
+        shapes["post_attn_norm_bias"] = (H,)
     return shapes
 
 
@@ -328,14 +384,22 @@ _LAYER_AXES = {
         "v_bias": ("kv_heads", "head_dim"),
         "q_norm": ("norm",),
         "k_norm": ("norm",),
+        "o_bias": ("norm",),
     },
     "mlp": {
         "gate_kernel": ("embed", "mlp"),
         "up_kernel": ("embed", "mlp"),
         "down_kernel": ("mlp", "embed"),
+        # fc style (GPT-2)
+        "fc1_kernel": ("embed", "mlp"),
+        "fc1_bias": ("mlp",),
+        "fc2_kernel": ("mlp", "embed"),
+        "fc2_bias": ("norm",),
     },
     "input_norm": ("norm",),
     "post_attn_norm": ("norm",),
+    "input_norm_bias": ("norm",),
+    "post_attn_norm_bias": ("norm",),
 }
 
 _MOE_MLP_AXES = {
@@ -353,7 +417,8 @@ _MOE_MLP_AXES = {
 
 def _mlp_axes(cfg: ModelConfig) -> dict:
     if not cfg.num_experts:
-        return dict(_LAYER_AXES["mlp"])
+        keys = _layer_shapes(cfg)["mlp"].keys()
+        return {k: _LAYER_AXES["mlp"][k] for k in keys}
     axes = dict(_MOE_MLP_AXES)
     if not cfg.shared_expert_intermediate_size:
         for k in list(axes):
@@ -377,6 +442,12 @@ def param_shapes(cfg: ModelConfig) -> dict:
         **layers_tree,
         "final_norm": (cfg.hidden_size,),
     }
+    if cfg.pos_embed == "learned":
+        out["pos_embed"] = {
+            "embedding": (cfg.max_position_embeddings, cfg.hidden_size)
+        }
+    if cfg.norm_type == "layernorm":
+        out["final_norm_bias"] = (cfg.hidden_size,)
     if cfg.is_critic:
         out["value_head"] = {"kernel": (cfg.hidden_size, 1), "bias": (1,)}
     elif not cfg.tie_word_embeddings:
@@ -405,6 +476,9 @@ def param_logical_axes(cfg: ModelConfig) -> dict:
         "input_norm": _LAYER_AXES["input_norm"],
         "post_attn_norm": _LAYER_AXES["post_attn_norm"],
     }
+    if cfg.norm_type == "layernorm":
+        layer_axes["input_norm_bias"] = _LAYER_AXES["input_norm_bias"]
+        layer_axes["post_attn_norm_bias"] = _LAYER_AXES["post_attn_norm_bias"]
     if cfg.scan_layers:
         layers_tree = {"layers": prefix_layers(layer_axes)}
     else:
@@ -416,6 +490,10 @@ def param_logical_axes(cfg: ModelConfig) -> dict:
         **layers_tree,
         "final_norm": ("norm",),
     }
+    if cfg.pos_embed == "learned":
+        out["pos_embed"] = {"embedding": (None, "embed")}
+    if cfg.norm_type == "layernorm":
+        out["final_norm_bias"] = ("norm",)
     if cfg.is_critic:
         out["value_head"] = {"kernel": ("embed", "norm"), "bias": ("norm",)}
     elif not cfg.tie_word_embeddings:
@@ -479,7 +557,24 @@ def rms_norm(
     return (x * w).astype(dtype)
 
 
-def _norm(x: jax.Array, weight: jax.Array, cfg: ModelConfig) -> jax.Array:
+def _norm(
+    x: jax.Array,
+    weight: jax.Array,
+    cfg: ModelConfig,
+    bias: jax.Array | None = None,
+) -> jax.Array:
+    """Config-dispatched norm: RMSNorm (optionally zero-centered, Gemma) or
+    mean-centering LayerNorm with bias (GPT-2)."""
+    if cfg.norm_type == "layernorm":
+        dtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + cfg.rms_norm_eps)
+        y = y * weight.astype(jnp.float32)
+        if bias is not None:
+            y = y + bias.astype(jnp.float32)
+        return y.astype(dtype)
     return rms_norm(x, weight, cfg.rms_norm_eps, cfg.norm_zero_centered)
 
 
@@ -611,8 +706,9 @@ def attention(
     if cfg.qk_norm:
         q = _norm(q, layer_p["q_norm"], cfg)
         k = _norm(k, layer_p["k_norm"], cfg)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    if cfg.pos_embed == "rope":
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
     q = _cstr(q, "tokens", "act_heads", None)
     k = _cstr(k, "tokens", "act_kv_heads", None)
     v = _cstr(v, "tokens", "act_kv_heads", None)
@@ -640,15 +736,26 @@ def attention(
         out = jnp.einsum("kgts,skd->tkgd", probs, v)
         out = out.reshape(T, nH, hd)
     out = _cstr(out, "tokens", "act_heads", None)
-    return _cstr(
-        jnp.einsum("tnd,ndh->th", out, layer_p["o_kernel"]),
-        "tokens",
-        "act_embed",
-    )
+    proj = jnp.einsum("tnd,ndh->th", out, layer_p["o_kernel"])
+    if cfg.attn_out_bias:
+        proj = proj + layer_p["o_bias"]
+    return _cstr(proj, "tokens", "act_embed")
 
 
 def mlp(layer_p: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     act = act_fn(cfg)
+    if cfg.mlp_style == "fc":
+        h = act(
+            jnp.einsum("th,hm->tm", x, layer_p["fc1_kernel"])
+            + layer_p["fc1_bias"]
+        )
+        h = _cstr(h, "tokens", "act_mlp")
+        return _cstr(
+            jnp.einsum("tm,mh->th", h, layer_p["fc2_kernel"])
+            + layer_p["fc2_bias"],
+            "tokens",
+            "act_embed",
+        )
     gate = jnp.einsum("th,hm->tm", x, layer_p["gate_kernel"])
     up = jnp.einsum("th,hm->tm", x, layer_p["up_kernel"])
     h = _cstr(act(gate) * up, "tokens", "act_mlp")
@@ -736,8 +843,11 @@ def moe_mlp(
         # sigmoid gate (HF Qwen2MoeSparseMoeBlock semantics).
         s_gate = jnp.einsum("th,hm->tm", x, layer_p["shared_gate_kernel"])
         s_up = jnp.einsum("th,hm->tm", x, layer_p["shared_up_kernel"])
-        ys = jnp.einsum(
-            "tm,mh->th", act(s_gate) * s_up, layer_p["shared_down_kernel"]
+        sh = _cstr(act(s_gate) * s_up, "tokens", "act_mlp")
+        ys = _cstr(
+            jnp.einsum("tm,mh->th", sh, layer_p["shared_down_kernel"]),
+            "tokens",
+            "act_embed",
         )
         g = jax.nn.sigmoid(
             jnp.einsum(
@@ -773,9 +883,9 @@ def decoder_layer(
     cfg: ModelConfig,
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (hidden [T, H], router aux loss scalar — 0 for dense)."""
-    h = _norm(x, layer_p["input_norm"], cfg)
+    h = _norm(x, layer_p["input_norm"], cfg, layer_p.get("input_norm_bias"))
     x = x + attention(layer_p["attn"], h, cos, sin, segment_ids, mask, cfg)
-    h = _norm(x, layer_p["post_attn_norm"], cfg)
+    h = _norm(x, layer_p["post_attn_norm"], cfg, layer_p.get("post_attn_norm_bias"))
     if cfg.num_experts:
         y, aux = moe_mlp(
             layer_p["mlp"], h, cfg, valid=segment_ids != PADDING_SEGMENT
@@ -811,6 +921,16 @@ def forward(
         "tokens",
         "act_embed",
     )
+    if cfg.pos_embed == "learned":
+        # Same gather rule as the token table above: hidden dim must be
+        # UNSHARDED going into the gather or its fsdp shards collide with
+        # the tokens-over-(dp,sp) activation layout (full-remat reshard).
+        ptab = _cstr(params["pos_embed"]["embedding"], None, None)
+        x = _cstr(
+            x + ptab[position_ids].astype(compute_dtype),
+            "tokens",
+            "act_embed",
+        )
     cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)
     # Dense path: build the [T,T] mask ONCE here (outside the per-layer remat
     # region); flash/ring never materialise it.
@@ -841,7 +961,7 @@ def forward(
             )
             aux_total = aux_total + aux
 
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
     if cfg.is_critic:
         values = (
             jnp.einsum("th,hk->tk", x, params["value_head"]["kernel"])
@@ -898,6 +1018,9 @@ def forward_pipelined(
 
     table = _cstr(params["embed"]["embedding"], "vocab", None)
     x = _scale_embed(table[input_ids].astype(compute_dtype), cfg)  # [M, T, H]
+    if cfg.pos_embed == "learned":
+        ptab = _cstr(params["pos_embed"]["embedding"], None, None)
+        x = x + ptab[position_ids].astype(compute_dtype)
 
     layer_fn = decoder_layer
     if cfg.remat:
@@ -933,7 +1056,7 @@ def forward_pipelined(
         )
 
     def head_of(y):
-        h = _norm(y, params["final_norm"], cfg)
+        h = _norm(y, params["final_norm"], cfg, params.get("final_norm_bias"))
         if cfg.is_critic:
             values = (
                 jnp.einsum("th,hk->tk", h, params["value_head"]["kernel"])
@@ -1001,6 +1124,8 @@ def _project_qkv(layer_p: dict, x: jax.Array, cos, sin, cfg: ModelConfig):
             [t1 * cos_b - t2 * sin_b, t2 * cos_b + t1 * sin_b], axis=-1
         )
 
+    if cfg.pos_embed != "rope":
+        return q, k, v
     return rot(q), rot(k), v
 
 
@@ -1038,6 +1163,10 @@ def prefill(
     else:
         x = params["embed"]["embedding"][input_ids].astype(compute_dtype)
     x = _scale_embed(x, cfg)
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"]["embedding"][position_ids].astype(
+            compute_dtype
+        )
     if rope_cos is not None:
         cos, sin = rope_cos, rope_sin
     else:
@@ -1048,7 +1177,7 @@ def prefill(
     group = nH // nKV
 
     def layer(x, layer_p):
-        h = _norm(x, layer_p["input_norm"], cfg)
+        h = _norm(x, layer_p["input_norm"], cfg, layer_p.get("input_norm_bias"))
         q, k, v = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
         qg = q.reshape(T, nKV, group, hd)
         scores = jnp.einsum("tkgd,skd->kgts", qg, k).astype(jnp.float32)
@@ -1056,10 +1185,11 @@ def prefill(
         scores = jnp.where(causal[None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn_out = jnp.einsum("kgts,skd->tkgd", probs, v).reshape(T, nH, hd)
-        x = x + jnp.einsum(
-            "tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"]
-        )
-        h = _norm(x, layer_p["post_attn_norm"], cfg)
+        proj = jnp.einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"])
+        if cfg.attn_out_bias:
+            proj = proj + layer_p["attn"]["o_bias"]
+        x = x + proj
+        h = _norm(x, layer_p["post_attn_norm"], cfg, layer_p.get("post_attn_norm_bias"))
         if cfg.num_experts:
             y, _ = moe_mlp(layer_p["mlp"], h, cfg, valid=valid)
         else:
@@ -1079,7 +1209,7 @@ def prefill(
 
     if not with_logits:
         return None, ks, vs
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
     if cfg.tie_word_embeddings:
         logits = jnp.einsum(
             "th,vh->tv", x, params["embed"]["embedding"].astype(compute_dtype)
@@ -1121,6 +1251,10 @@ def decode_step(
     x = _scale_embed(
         params["embed"]["embedding"][tokens].astype(compute_dtype), cfg
     )  # [R, H]
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"]["embedding"][positions].astype(
+            compute_dtype
+        )
     rope_pos = positions if rope_offset is None else positions + rope_offset
     cos, sin = rope_table(rope_pos, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)  # [R, hd/2]
     valid = jnp.arange(S)[None, :] <= positions[:, None]  # [R, S]
@@ -1135,7 +1269,7 @@ def decode_step(
 
     def layer(x, inputs):
         layer_p, kc, vc = inputs
-        h = _norm(x, layer_p["input_norm"], cfg)
+        h = _norm(x, layer_p["input_norm"], cfg, layer_p.get("input_norm_bias"))
         q, k_new, v_new = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
         kc = write(kc, k_new.astype(kc.dtype))
         vc = write(vc, v_new.astype(vc.dtype))
@@ -1147,8 +1281,11 @@ def decode_step(
         attn_out = jnp.einsum(
             "rkgs,rskd->rkgd", probs, vc.astype(x.dtype)
         ).reshape(R, nH, hd)
-        x = x + jnp.einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"])
-        h = _norm(x, layer_p["post_attn_norm"], cfg)
+        proj = jnp.einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"])
+        if cfg.attn_out_bias:
+            proj = proj + layer_p["attn"]["o_bias"]
+        x = x + proj
+        h = _norm(x, layer_p["post_attn_norm"], cfg, layer_p.get("post_attn_norm_bias"))
         if cfg.num_experts:
             y, _ = moe_mlp(layer_p["mlp"], h, cfg, valid=active)
         else:
@@ -1170,7 +1307,7 @@ def decode_step(
             vcs.append(vc)
         k_cache, v_cache = jnp.stack(kcs), jnp.stack(vcs)
 
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
     if cfg.tie_word_embeddings:
         logits = jnp.einsum(
             "rh,vh->rv", x, params["embed"]["embedding"].astype(compute_dtype)

@@ -15,9 +15,49 @@ import pytest
 import jax
 
 from areal_tpu.models.hf_io import load_hf_params, save_hf_params
-from areal_tpu.models.qwen2 import ModelConfig, forward
+from areal_tpu.models.qwen2 import ModelConfig, decode_step, forward, prefill
 
 torch = pytest.importorskip("torch")
+
+
+def _decode_consistency(cfg, params, T=10, atol=2e-3):
+    """prefill + decode_step must agree with the packed training forward —
+    the decode engine serves THESE functions, and family-specific terms
+    (o_bias, wpe, shared expert) are easy to drop from one path only."""
+    rng = np.random.RandomState(7)
+    ids = rng.randint(0, cfg.vocab_size, (T,))
+    ref = np.asarray(
+        forward(params, ids, np.arange(T), np.zeros(T, dtype=np.int32), cfg)
+    )
+    logits, ks, vs = prefill(params, ids[:-1], np.arange(T - 1), cfg)
+    np.testing.assert_allclose(np.asarray(logits), ref[:-1], atol=atol, rtol=1e-3)
+
+    L = cfg.num_hidden_layers
+    nKV, hd = cfg.num_key_value_heads, cfg.head_dim_
+    S, R = T + 4, 2
+    k_cache = np.zeros((L, R, S, nKV, hd), np.float32)
+    v_cache = np.zeros((L, R, S, nKV, hd), np.float32)
+    k_cache[:, 0, : T - 1] = np.asarray(ks)
+    v_cache[:, 0, : T - 1] = np.asarray(vs)
+    lg, _, _ = decode_step(
+        params,
+        np.array([ids[-1], 0], np.int32),
+        np.array([T - 1, 0], np.int32),
+        k_cache,
+        v_cache,
+        cfg,
+        active=np.array([True, False]),
+    )
+    np.testing.assert_allclose(np.asarray(lg)[0], ref[-1], atol=atol, rtol=1e-3)
+
+
+def _randomize_biases(model):
+    """HF inits GPT-2 biases to zero; perturb them so bias-dropping bugs
+    can't hide behind zeros."""
+    with torch.no_grad():
+        for n, p in model.named_parameters():
+            if n.endswith(".bias"):
+                p.add_(torch.randn_like(p) * 0.05)
 
 
 def _save_tiny(model, tmp_path, expect_type):
@@ -64,7 +104,8 @@ def test_gemma_numerical_parity(tmp_path):
     torch.manual_seed(0)
     model = GemmaForCausalLM(hf_cfg).eval().float()
     model_dir = _save_tiny(model, tmp_path, "gemma")
-    cfg, _ = _parity(model, model_dir, 96)
+    cfg, params = _parity(model, model_dir, 96)
+    _decode_consistency(cfg, params)
     assert cfg.norm_zero_centered and cfg.normalize_embed
     assert cfg.tie_word_embeddings and not cfg.qkv_bias
     assert cfg.hidden_act == "gelu_pytorch_tanh"
@@ -132,9 +173,46 @@ def test_qwen2_moe_numerical_parity(tmp_path):
     torch.manual_seed(0)
     model = Qwen2MoeForCausalLM(hf_cfg).eval().float()
     model_dir = _save_tiny(model, tmp_path, "qwen2_moe")
-    cfg, _ = _parity(model, model_dir, 96, capacity_factor=8.0)
+    cfg, params = _parity(model, model_dir, 96, capacity_factor=8.0)
+    _decode_consistency(cfg, params)
     assert cfg.shared_expert_intermediate_size == 48
     assert cfg.qkv_bias and not cfg.norm_topk_prob
+
+
+def test_gpt2_numerical_parity(tmp_path):
+    """GPT-2: LayerNorm+bias, learned wpe positions, fused Conv1D c_attn
+    split at load, fc MLP with gelu_new, tied head."""
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_cfg = GPT2Config(
+        vocab_size=96,
+        n_positions=64,
+        n_embd=32,
+        n_layer=2,
+        n_head=4,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = GPT2LMHeadModel(hf_cfg).eval().float()
+    _randomize_biases(model)
+    model_dir = _save_tiny(model, tmp_path, "gpt2")
+    cfg, params = _parity(model, model_dir, 96)
+    _decode_consistency(cfg, params)
+    assert cfg.norm_type == "layernorm" and cfg.pos_embed == "learned"
+    assert cfg.mlp_style == "fc" and cfg.attn_out_bias
+    assert cfg.hidden_act == "gelu_new" and cfg.tie_word_embeddings
+    assert cfg.intermediate_size == 128  # 4 * n_embd default
+
+    # roundtrip re-fuses c_attn and keeps transformer.* Conv1D layout
+    out = save_hf_params(params, cfg, str(tmp_path / "ckpt"))
+    reloaded = load_hf_params(out, cfg, dtype="float32")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        params,
+        reloaded,
+    )
 
 
 def test_qwen2_moe_heterogeneous_rejected(tmp_path):
