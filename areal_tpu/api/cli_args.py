@@ -139,6 +139,12 @@ class JaxEngineConfig:
     # jax.checkpoint policy: "none" | "full" | "dots_saveable" |
     # "dots_with_no_batch_dims_saveable"
     remat_policy: str = "full"
+    # Fused LM-head loss: apply the head + cross-entropy in vocab chunks
+    # (ops/fused_xent.py) so the f32 [tokens, vocab] logits never
+    # materialize — lifts the micro-batch HBM ceiling the dense path hits
+    # on wide-vocab models. Exact to f32 roundoff; disable to force the
+    # dense logits path.
+    fused_lm_loss: bool = True
     # Use scan-over-layers for fast compiles and PP-friendly stacking.
     scan_layers: bool = True
     # Offload optimizer state to host memory (jax.device_put w/ host sharding).

@@ -49,6 +49,7 @@ from areal_tpu.api.io_struct import (
 )
 from areal_tpu.models import hf_io
 from areal_tpu.models.qwen2 import (
+    LMHead,
     ModelConfig,
     forward as model_forward,
     init_params,
@@ -191,6 +192,7 @@ class JaxTrainEngine(TrainEngine):
                 dtype=cfg.dtype,
                 param_dtype=cfg.dtype,
                 remat=cfg.gradient_checkpointing,
+                remat_policy=cfg.jax.remat_policy,
                 scan_layers=cfg.jax.scan_layers,
                 is_critic=cfg.is_critic,
                 attn_impl=attn_impl,
@@ -560,6 +562,21 @@ class JaxTrainEngine(TrainEngine):
         pos = np.arange(total, dtype=np.int32) - np.repeat(
             np.asarray(cu[:-1]), np.diff(np.asarray(cu))
         ).astype(np.int32)
+        if (
+            self.model_config is not None
+            and self.model_config.pos_embed == "learned"
+            and pos.size
+            and int(pos.max()) >= self.model_config.max_position_embeddings
+        ):
+            # jax gathers clamp out-of-bounds indices, so an overlong
+            # sequence would silently reuse the last wpe row where HF
+            # raises an index error — fail loudly instead.
+            raise ValueError(
+                f"sequence position {int(pos.max())} exceeds the learned "
+                "position table "
+                f"(max_position_embeddings="
+                f"{self.model_config.max_position_embeddings})"
+            )
         out["segment_ids"] = seg
         out["position_ids"] = pos
         return out
@@ -608,6 +625,14 @@ class JaxTrainEngine(TrainEngine):
             for k in keys
         }
 
+    @staticmethod
+    def _wants_hidden(fn: Callable | None) -> bool:
+        """Loss/hook functions tagged `hidden_loss=True` consume an LMHead
+        (vocab-chunked fused head, ops/fused_xent.py) instead of dense
+        [T, V] logits — the TPU answer to the reference's Megatron
+        vocab-parallel cross-entropy."""
+        return bool(getattr(fn, "hidden_loss", False))
+
     def _get_pipelined_grad_step(self, loss_fn: Callable) -> Callable:
         """One jitted program: GPipe trunk over the pp axis for all M
         micro-batches, per-mb loss in a head scan, ONE backward. Replaces
@@ -626,7 +651,15 @@ class JaxTrainEngine(TrainEngine):
             model_cfg.num_experts and model_cfg.router_aux_loss_coef > 0
         )
 
+        hidden_mode = self._wants_hidden(loss_fn)
+
         def loss_of(params, stacked, weights):
+            if hidden_mode:
+                per_mb_fn = lambda h, mb: loss_fn(  # noqa: E731
+                    LMHead(h, params, model_cfg), mb
+                )
+            else:
+                per_mb_fn = lambda logits, mb: loss_fn(logits, mb)  # noqa: E731
             out = forward_pipelined(
                 params,
                 stacked["input_ids"],
@@ -634,9 +667,10 @@ class JaxTrainEngine(TrainEngine):
                 stacked["segment_ids"],
                 model_cfg,
                 mesh,
-                per_mb_fn=lambda logits, mb: loss_fn(logits, mb),
+                per_mb_fn=per_mb_fn,
                 mb_data=stacked,
                 with_aux=use_aux,
+                head_mode="hidden" if hidden_mode else "logits",
             )
             losses, aux = out if use_aux else (out, jnp.float32(0.0))
             total = jnp.sum(losses * weights)
@@ -665,25 +699,28 @@ class JaxTrainEngine(TrainEngine):
         model_cfg = self.model_config
         grad_dtype = jnp.dtype(self.config.grad_reduce_dtype)
 
+        hidden_mode = self._wants_hidden(loss_fn)
+
         def loss_of(params, mb):
-            if model_cfg.num_experts and model_cfg.router_aux_loss_coef > 0:
-                logits, aux = model_forward(
-                    params,
-                    mb["input_ids"],
-                    mb["position_ids"],
-                    mb["segment_ids"],
-                    model_cfg,
-                    with_aux=True,
-                )
-                return loss_fn(logits, mb) + model_cfg.router_aux_loss_coef * aux
-            logits = model_forward(
+            with_aux = bool(
+                model_cfg.num_experts and model_cfg.router_aux_loss_coef > 0
+            )
+            out = model_forward(
                 params,
                 mb["input_ids"],
                 mb["position_ids"],
                 mb["segment_ids"],
                 model_cfg,
+                with_aux=with_aux,
+                return_hidden=hidden_mode,
             )
-            return loss_fn(logits, mb)
+            x, aux = out if with_aux else (out, None)
+            if hidden_mode:
+                x = LMHead(x, params, model_cfg)
+            loss = loss_fn(x, mb)
+            if with_aux:
+                loss = loss + model_cfg.router_aux_loss_coef * aux
+            return loss
 
         param_sh = self._param_shardings
 
@@ -870,15 +907,20 @@ class JaxTrainEngine(TrainEngine):
         if key not in self._fwd_cache:
             model_cfg = self.model_config
 
+            hidden_mode = self._wants_hidden(loss_fn)
+
             def eval_step(params, mb):
-                logits = model_forward(
+                x = model_forward(
                     params,
                     mb["input_ids"],
                     mb["position_ids"],
                     mb["segment_ids"],
                     model_cfg,
+                    return_hidden=hidden_mode,
                 )
-                return loss_fn(logits, mb)
+                if hidden_mode:
+                    x = LMHead(x, params, model_cfg)
+                return loss_fn(x, mb)
 
             self._fwd_cache[key] = jax.jit(eval_step)
         eval_step = self._fwd_cache[key]
@@ -917,7 +959,17 @@ class JaxTrainEngine(TrainEngine):
                 model_cfg = self.model_config
                 mesh = self.mesh
 
+                hidden_mode = self._wants_hidden(post_hook)
+
                 def fwd_pp(params, stacked):
+                    if hidden_mode:
+                        per_mb_fn = lambda h, mb: post_hook(  # noqa: E731
+                            LMHead(h, params, model_cfg), mb
+                        )
+                    elif post_hook is not None:
+                        per_mb_fn = post_hook
+                    else:
+                        per_mb_fn = lambda logits, mb: logits  # noqa: E731
                     return forward_pipelined(
                         params,
                         stacked["input_ids"],
@@ -925,12 +977,9 @@ class JaxTrainEngine(TrainEngine):
                         stacked["segment_ids"],
                         model_cfg,
                         mesh,
-                        per_mb_fn=(
-                            post_hook
-                            if post_hook is not None
-                            else lambda logits, mb: logits
-                        ),
+                        per_mb_fn=per_mb_fn,
                         mb_data=stacked,
+                        head_mode="hidden" if hidden_mode else "logits",
                     )
 
                 self._fwd_cache[key] = jax.jit(fwd_pp)
@@ -953,17 +1002,22 @@ class JaxTrainEngine(TrainEngine):
         if key not in self._fwd_cache:
             model_cfg = self.model_config
 
+            hidden_mode = self._wants_hidden(post_hook)
+
             def fwd_step(params, mb):
-                logits = model_forward(
+                x = model_forward(
                     params,
                     mb["input_ids"],
                     mb["position_ids"],
                     mb["segment_ids"],
                     model_cfg,
+                    return_hidden=hidden_mode,
                 )
+                if hidden_mode:
+                    return post_hook(LMHead(x, params, model_cfg), mb)
                 if post_hook is not None:
-                    return post_hook(logits, mb)
-                return logits
+                    return post_hook(x, mb)
+                return x
 
             self._fwd_cache[key] = jax.jit(fwd_step)
         fwd_step = self._fwd_cache[key]

@@ -35,8 +35,7 @@ from areal_tpu.utils.data import KLEstimator, Normalization
 from areal_tpu.utils.datapack import ffd_allocate
 from areal_tpu.utils.functional import (
     dynamic_sampling,
-    gather_logprobs,
-    gather_logprobs_entropy,
+    label_logprobs_of,
     ppo_actor_loss_fn,
     reward_overlong_penalty,
 )
@@ -72,13 +71,25 @@ class PPOActor:
             c_clip=config.c_clip,
             behav_imp_weight_cap=config.behav_imp_weight_cap,
         )
+        if self._fused_head():
+            self._loss_fn.hidden_loss = True
+
+    def _fused_head(self) -> bool:
+        """Vocab-chunked fused LM head (no [T, V] logits) when the engine
+        supports it — see JaxEngineConfig.fused_lm_loss."""
+        ecfg = getattr(self.engine, "config", None)
+        return bool(
+            ecfg is not None
+            and getattr(getattr(ecfg, "jax", None), "fused_lm_loss", False)
+        )
 
     def _calc_logprobs_fn(self, temp: float):
         if temp not in self._logp_fns:
             def calc_logprobs(logits, mb):
                 labels = jnp.roll(mb["input_ids"], shift=-1)
-                return gather_logprobs(logits, labels, temp)
+                return label_logprobs_of(logits, labels, temp)
 
+            calc_logprobs.hidden_loss = self._fused_head()
             self._logp_fns[temp] = calc_logprobs
         return self._logp_fns[temp]
 
@@ -335,7 +346,7 @@ def grpo_loss_fn(
     loss_mask = mb["loss_mask"].astype(bool)
     prox_logp = mb["prox_logp"]
 
-    logprobs = gather_logprobs(logits, labels, temperature)
+    logprobs = label_logprobs_of(logits, labels, temperature)
     loss, _stat = ppo_actor_loss_fn(
         logprobs=logprobs,
         proximal_logprobs=prox_logp,

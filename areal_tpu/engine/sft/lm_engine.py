@@ -16,7 +16,7 @@ import numpy as np
 
 from areal_tpu.engine.jax_engine import JaxTrainEngine
 from areal_tpu.utils import stats_tracker
-from areal_tpu.utils.functional import gather_logprobs
+from areal_tpu.utils.functional import label_logprobs_of
 
 
 def compute_packed_sft_loss(logits: jax.Array, mb: dict[str, Any]) -> jax.Array:
@@ -33,9 +33,18 @@ def compute_packed_sft_loss(logits: jax.Array, mb: dict[str, Any]) -> jax.Array:
     same_seg = jnp.roll(seg, -1) == seg
     # position t is trained iff its LABEL (t+1) is a loss token
     valid = same_seg & jnp.roll(loss_mask, -1)
-    logprobs = gather_logprobs(logits, labels)
+    logprobs = label_logprobs_of(logits, labels)
     n = jnp.maximum(valid.sum(), 1)
     return -jnp.where(valid, logprobs, 0.0).sum() / n
+
+
+def compute_packed_sft_loss_fused(head, mb: dict[str, Any]) -> jax.Array:
+    """Same objective through the fused vocab-chunked LM head (`head` is a
+    models/qwen2.py::LMHead) — no [T, V] logits in either pass."""
+    return compute_packed_sft_loss(head, mb)
+
+
+compute_packed_sft_loss_fused.hidden_loss = True
 
 
 def sft_loss_weight(mb: dict[str, Any]) -> float:
@@ -62,16 +71,22 @@ class LMEngine:
     def __init__(self, engine: JaxTrainEngine):
         self.engine = engine
 
+    def _loss_fn(self):
+        cfg = getattr(self.engine, "config", None)
+        if cfg is not None and getattr(cfg.jax, "fused_lm_loss", False):
+            return compute_packed_sft_loss_fused
+        return compute_packed_sft_loss
+
     def train_lm(self, data: dict[str, Any]) -> dict[str, float]:
         stats = self.engine.train_batch(
-            data, compute_packed_sft_loss, sft_loss_weight
+            data, self._loss_fn(), sft_loss_weight
         )
         stats_tracker.scalar(**{f"sft/{k}": v for k, v in stats.items()})
         return stats
 
     def evaluate_lm(self, data: dict[str, Any]) -> float:
         return self.engine.eval_batch(
-            data, compute_packed_sft_loss, sft_loss_weight
+            data, self._loss_fn(), sft_loss_weight
         )
 
 

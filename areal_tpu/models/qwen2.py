@@ -100,6 +100,12 @@ class ModelConfig:
     # compile-time toggles
     scan_layers: bool = True
     remat: bool = False
+    # jax.checkpoint policy under remat: "full" recomputes everything
+    # (min HBM); "dots_saveable" / "dots_with_no_batch_dims_saveable" keep
+    # matmul outputs resident and recompute only the cheap elementwise ops
+    # — ~25% fewer FLOPs per step when activations fit (cli_args
+    # JaxEngineConfig.remat_policy wires this from YAML).
+    remat_policy: str = "full"
     # attention implementation: "dense" materialises the [T,T] score matrix
     # (fine for short packs / CPU tests); "flash" uses the Pallas
     # online-softmax kernel (areal_tpu/ops/flash_attention.py) — O(T) memory,
@@ -128,6 +134,9 @@ class ModelConfig:
     # T * moe_group_size * top_k; smaller groups = less memory, slightly
     # worse balance)
     moe_group_size: int = 1024
+    # vocab chunk for the fused LM-head loss (ops/fused_xent.py): peak
+    # logits transient is [tokens, loss_vocab_chunk]
+    loss_vocab_chunk: int = 16384
 
     @property
     def head_dim_(self) -> int:
@@ -276,6 +285,12 @@ class ModelConfig:
                     "gpt2 variants with scale_attn_by_inverse_layer_idx / "
                     "reorder_and_upcast_attn would silently mis-scale "
                     "attention; not implemented"
+                )
+            if hf.get("add_cross_attention"):
+                raise NotImplementedError(
+                    "gpt2 with add_cross_attention: the crossattention.* "
+                    "tensors have no slot in the causal-LM tree and would "
+                    "be silently dropped"
                 )
             kw.update(
                 norm_type="layernorm",
@@ -596,6 +611,54 @@ def _scale_embed(x: jax.Array, cfg: ModelConfig) -> jax.Array:
     return x
 
 
+class LMHead:
+    """Lazy LM head over post-final-norm hidden states.
+
+    Handed to `hidden_loss`-tagged loss functions instead of dense logits
+    (engine/jax_engine.py loss paths): label logprobs / entropy come from
+    the vocab-chunked online-logsumexp kernel (ops/fused_xent.py), so the
+    f32 [T, V] logits tensor never materializes in either pass. Chunk size
+    is `cfg` vocab-bounded 16k — [T, 16k] transient instead of [T, V].
+    """
+
+    def __init__(self, hidden: jax.Array, params: dict, cfg: ModelConfig):
+        self.hidden = hidden
+        self.params = params
+        self.cfg = cfg
+
+    def _head(self) -> tuple[jax.Array, bool]:
+        if self.cfg.tie_word_embeddings:
+            return self.params["embed"]["embedding"], True
+        return self.params["lm_head"]["kernel"], False
+
+    def label_logprobs(
+        self, labels: jax.Array, temperature: float = 1.0
+    ) -> jax.Array:
+        from areal_tpu.ops.fused_xent import chunked_label_logprobs
+
+        w, vh = self._head()
+        return chunked_label_logprobs(
+            self.hidden, w, labels, head_is_vh=vh, temperature=temperature,
+            vocab_chunk=self.cfg.loss_vocab_chunk,
+        )
+
+    def label_logprobs_entropy(
+        self, labels: jax.Array, temperature: float = 1.0
+    ) -> tuple[jax.Array, jax.Array]:
+        from areal_tpu.ops.fused_xent import chunked_label_logprobs
+
+        w, vh = self._head()
+        return chunked_label_logprobs(
+            self.hidden,
+            w,
+            labels,
+            head_is_vh=vh,
+            temperature=temperature,
+            with_entropy=True,
+            vocab_chunk=self.cfg.loss_vocab_chunk,
+        )
+
+
 def rope_table(
     positions: jax.Array,
     head_dim: int,
@@ -873,6 +936,28 @@ def moe_mlp(
     return y, aux
 
 
+_REMAT_POLICIES = {
+    "full": None,
+    "dots_saveable": "dots_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+}
+
+
+def _maybe_remat(layer_fn, cfg: ModelConfig):
+    if not cfg.remat:
+        return layer_fn
+    if cfg.remat_policy not in _REMAT_POLICIES:
+        raise ValueError(
+            f"remat_policy={cfg.remat_policy!r} not in "
+            f"{sorted(_REMAT_POLICIES)}"
+        )
+    policy_name = _REMAT_POLICIES[cfg.remat_policy]
+    policy = (
+        getattr(jax.checkpoint_policies, policy_name) if policy_name else None
+    )
+    return jax.checkpoint(layer_fn, static_argnums=(6,), policy=policy)
+
+
 def decoder_layer(
     layer_p: dict,
     x: jax.Array,
@@ -903,12 +988,18 @@ def forward(
     cfg: ModelConfig,
     *,
     with_aux: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Packed forward: [T] ids → [T, V] logits (f32).
 
     `segment_ids` mark sequence membership (PADDING_SEGMENT for pad tail);
     attention is causal within a segment. With `with_aux=True` also returns
     the summed MoE router load-balancing loss (0 for dense models).
+
+    `return_hidden=True` stops after the final norm and returns the [T, H]
+    hidden states instead of logits — the fused-LM-loss path (LMHead +
+    ops/fused_xent.py) applies the head in vocab chunks so the f32 [T, V]
+    tensor never exists.
     """
     compute_dtype = jnp.dtype(cfg.dtype)
     # Gather from a table whose hidden dim is UNSHARDED: leaving the fsdp
@@ -940,9 +1031,7 @@ def forward(
         else None
     )
 
-    layer_fn = decoder_layer
-    if cfg.remat:
-        layer_fn = jax.checkpoint(decoder_layer, static_argnums=(6,))
+    layer_fn = _maybe_remat(decoder_layer, cfg)
 
     if cfg.scan_layers:
         def body(carry, layer_p):
@@ -962,7 +1051,10 @@ def forward(
             aux_total = aux_total + aux
 
     x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
-    if cfg.is_critic:
+    if return_hidden:
+        assert not cfg.is_critic, "fused head path is for LM heads only"
+        out = _cstr(x, "tokens", "act_embed")
+    elif cfg.is_critic:
         values = (
             jnp.einsum("th,hk->tk", x, params["value_head"]["kernel"])
             + params["value_head"]["bias"]
@@ -994,6 +1086,7 @@ def forward_pipelined(
     mb_data: dict | None = None,
     *,
     with_aux: bool = False,
+    head_mode: str = "logits",
 ):
     """Pipelined packed forward over M stacked microbatches.
 
@@ -1022,9 +1115,7 @@ def forward_pipelined(
         ptab = _cstr(params["pos_embed"]["embedding"], None, None)
         x = x + ptab[position_ids].astype(compute_dtype)
 
-    layer_fn = decoder_layer
-    if cfg.remat:
-        layer_fn = jax.checkpoint(decoder_layer, static_argnums=(6,))
+    layer_fn = _maybe_remat(decoder_layer, cfg)
 
     def stage_fn(layers_local, h, aux_t):
         pos, seg = aux_t
@@ -1057,6 +1148,10 @@ def forward_pipelined(
 
     def head_of(y):
         h = _norm(y, params["final_norm"], cfg, params.get("final_norm_bias"))
+        if head_mode == "hidden":
+            # fused-loss path: per_mb_fn consumes hidden states directly
+            # (wrapping them in an LMHead) — no logits here either.
+            return h
         if cfg.is_critic:
             values = (
                 jnp.einsum("th,hk->tk", h, params["value_head"]["kernel"])

@@ -30,6 +30,8 @@ import numpy as np
 __all__ = [
     "gather_logprobs",
     "gather_logprobs_entropy",
+    "label_logprobs_of",
+    "label_logprobs_entropy_of",
     "masked_normalization",
     "ppo_actor_loss_fn",
     "ppo_critic_loss_fn",
@@ -52,6 +54,22 @@ def gather_logprobs(
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gathered = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     return gathered - logz
+
+
+def label_logprobs_of(x, labels, temperature: float = 1.0):
+    """log p(labels) from either dense [T, V] logits or an LMHead (the
+    engine's fused vocab-chunked head, models/qwen2.py::LMHead). Loss
+    functions written against this helper work in both engine modes."""
+    if hasattr(x, "label_logprobs"):
+        return x.label_logprobs(labels, temperature)
+    return gather_logprobs(x, labels, temperature)
+
+
+def label_logprobs_entropy_of(x, labels, temperature: float = 1.0):
+    """(log p(labels), entropy) — dense logits or LMHead (see above)."""
+    if hasattr(x, "label_logprobs_entropy"):
+        return x.label_logprobs_entropy(labels, temperature)
+    return gather_logprobs_entropy(x, labels, temperature)
 
 
 def gather_logprobs_entropy(
