@@ -170,6 +170,9 @@ class JaxDecodeEngine(InferenceEngine):
         ft_spec: FinetuneSpec | None = None,
         train_data_parallel_size: int | None = None,
     ):
+        from areal_tpu.platforms import enable_compilation_cache
+
+        enable_compilation_cache()
         if self.params is None:
             assert self.config.model_path, "no model installed or configured"
             self.model_config = ModelConfig.from_hf_config(
@@ -182,6 +185,19 @@ class JaxDecodeEngine(InferenceEngine):
             self._maybe_load_vision_tower(self.config.model_path)
         self._maybe_repeat_kv_heads()
         cfg = self.model_config
+        if (
+            cfg.pos_embed == "learned"
+            and self.config.context_length > cfg.max_position_embeddings
+        ):
+            # jax gathers clamp out-of-bounds indices: positions past the
+            # wpe table would silently reuse its last row. All request
+            # positions are < context_length, so bounding it here guards
+            # every prefill/decode step.
+            raise ValueError(
+                f"context_length={self.config.context_length} exceeds the "
+                "learned position table (max_position_embeddings="
+                f"{cfg.max_position_embeddings})"
+            )
         self._build_mesh()
         if self._param_shardings is not None:
             self.params = jax.tree.map(

@@ -128,6 +128,13 @@ def make_optimizer(
     return opt, schedule
 
 
+def fused_lm_loss_enabled(engine) -> bool:
+    """Whether `engine` wants hidden_loss-tagged (fused vocab-chunked head)
+    loss functions — the one probe shared by the SFT engine and PPO actor."""
+    cfg = getattr(engine, "config", None)
+    return bool(getattr(getattr(cfg, "jax", None), "fused_lm_loss", False))
+
+
 class JaxTrainEngine(TrainEngine):
     """GSPMD training engine for decoder LMs (parity: FSDPEngine)."""
 
@@ -169,6 +176,9 @@ class JaxTrainEngine(TrainEngine):
             and jax.process_count() == 1
         ):  # pragma: no cover - multi-host only
             jax.distributed.initialize()
+        from areal_tpu.platforms import enable_compilation_cache
+
+        enable_compilation_cache()
         self.parallel_strategy = parallel_strategy
         self.mesh = mesh_lib.build_mesh(parallel_strategy)
         mesh_lib.set_current_mesh(self.mesh)
@@ -626,6 +636,15 @@ class JaxTrainEngine(TrainEngine):
         }
 
     @staticmethod
+    def _returns_aux(fn: Callable | None) -> bool:
+        """Loss functions tagged `returns_aux=True` return (loss, aux) where
+        aux is a dict of scalar training statistics (entropy, clip ratios,
+        KL terms). The engine weight-averages aux across micro-batches into
+        the train_batch stats — the reference records the same per-update
+        stats from inside its loss (areal/engine/ppo/actor.py:335-377)."""
+        return bool(getattr(fn, "returns_aux", False))
+
+    @staticmethod
     def _wants_hidden(fn: Callable | None) -> bool:
         """Loss/hook functions tagged `hidden_loss=True` consume an LMHead
         (vocab-chunked fused head, ops/fused_xent.py) instead of dense
@@ -652,6 +671,7 @@ class JaxTrainEngine(TrainEngine):
         )
 
         hidden_mode = self._wants_hidden(loss_fn)
+        aux_mode = self._returns_aux(loss_fn)
 
         def loss_of(params, stacked, weights):
             if hidden_mode:
@@ -672,22 +692,30 @@ class JaxTrainEngine(TrainEngine):
                 with_aux=use_aux,
                 head_mode="hidden" if hidden_mode else "logits",
             )
-            losses, aux = out if use_aux else (out, jnp.float32(0.0))
+            per_mb, aux = out if use_aux else (out, jnp.float32(0.0))
+            if aux_mode:
+                losses, stats = per_mb  # ([M], {k: [M]})
+            else:
+                losses, stats = per_mb, {}
             total = jnp.sum(losses * weights)
             if use_aux:
                 total = total + model_cfg.router_aux_loss_coef * aux
-            return total, losses
+            return total, (losses, stats)
 
         def pip_grad_step(params, stacked, weights):
-            (_, losses), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                params, stacked, weights
-            )
+            (_, (losses, stats)), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(params, stacked, weights)
             grads = jax.lax.with_sharding_constraint(grads, param_sh)
-            return losses, grads
+            return losses, stats, grads
 
         fn = jax.jit(
             pip_grad_step,
-            out_shardings=(mesh_lib.replicated(self.mesh), param_sh),
+            out_shardings=(
+                mesh_lib.replicated(self.mesh),
+                mesh_lib.replicated(self.mesh),
+                param_sh,
+            ),
         )
         self._grad_step_cache[key] = fn
         return fn
@@ -700,6 +728,7 @@ class JaxTrainEngine(TrainEngine):
         grad_dtype = jnp.dtype(self.config.grad_reduce_dtype)
 
         hidden_mode = self._wants_hidden(loss_fn)
+        aux_mode = self._returns_aux(loss_fn)
 
         def loss_of(params, mb):
             with_aux = bool(
@@ -717,15 +746,18 @@ class JaxTrainEngine(TrainEngine):
             x, aux = out if with_aux else (out, None)
             if hidden_mode:
                 x = LMHead(x, params, model_cfg)
-            loss = loss_fn(x, mb)
+            res = loss_fn(x, mb)
+            loss, stats = res if aux_mode else (res, {})
             if with_aux:
                 loss = loss + model_cfg.router_aux_loss_coef * aux
-            return loss
+            return loss, stats
 
         param_sh = self._param_shardings
 
         def grad_step(params, acc, weight, mb):
-            loss, grads = jax.value_and_grad(loss_of)(params, mb)
+            (loss, stats), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                params, mb
+            )
             # Pin gradients to their parameter's layout BEFORE accumulation:
             # left free, XLA may lay the backward's psum outputs out
             # differently from the donated accumulator and fall back to
@@ -734,12 +766,16 @@ class JaxTrainEngine(TrainEngine):
             acc = jax.tree.map(
                 lambda a, g: a + g.astype(grad_dtype) * weight, acc, grads
             )
-            return loss, acc
+            return loss, stats, acc
 
         fn = jax.jit(
             grad_step,
             donate_argnums=(1,),
-            out_shardings=(mesh_lib.replicated(self.mesh), param_sh),
+            out_shardings=(
+                mesh_lib.replicated(self.mesh),
+                mesh_lib.replicated(self.mesh),
+                param_sh,
+            ),
         )
         self._grad_step_cache[key] = fn
         return fn
@@ -809,23 +845,35 @@ class JaxTrainEngine(TrainEngine):
         )
         weights = [float(loss_weight_fn(mb)) for mb in mb_list.mbs]
         total_weight = float(sum(weights)) or 1.0
+        aux_stats: dict[str, float] = {}
         if self._pp_size > 1:
             # pipelined path: all micro-batches stream through the pp
             # stages inside ONE jitted step (fill/steady/drain), one backward
             stacked = self._stack_mbs(mb_list.mbs)
             pip_step = self._get_pipelined_grad_step(loss_fn)
-            losses, acc = pip_step(
+            losses, mb_stats, acc = pip_step(
                 self.params, stacked, jnp.asarray(weights, jnp.float32)
             )
             losses = list(np.asarray(losses))
+            w_arr = np.asarray(weights, np.float64)
+            for k, v in mb_stats.items():
+                aux_stats[k] = float(
+                    (np.asarray(v, np.float64) * w_arr).sum() / total_weight
+                )
         else:
             grad_step = self._get_grad_step(loss_fn)
             acc = self._zero_grads()
             losses = []
+            stat_acc: dict[str, float] = {}
             for mb, w in zip(mb_list.mbs, weights):
                 dev_mb = self._device_mb(mb)
-                loss, acc = grad_step(self.params, acc, w, dev_mb)
+                loss, mb_stats, acc = grad_step(self.params, acc, w, dev_mb)
                 losses.append(loss)
+                for k, v in mb_stats.items():
+                    stat_acc[k] = stat_acc.get(k, 0.0) + float(v) * w
+            aux_stats = {
+                k: v / total_weight for k, v in stat_acc.items()
+            }
         apply_update = self._get_apply_update()
         self.params, self.opt_state, gnorm = apply_update(
             self.params, self.opt_state, acc, total_weight
@@ -843,6 +891,7 @@ class JaxTrainEngine(TrainEngine):
             lr=lr,
             n_mbs=len(mb_list.mbs),
             update_steps=self._step_count,
+            **aux_stats,
         )
         stats.update(self._throughput_stats(input_, step_time))
         return stats
@@ -908,6 +957,7 @@ class JaxTrainEngine(TrainEngine):
             model_cfg = self.model_config
 
             hidden_mode = self._wants_hidden(loss_fn)
+            aux_mode = self._returns_aux(loss_fn)
 
             def eval_step(params, mb):
                 x = model_forward(
@@ -920,7 +970,8 @@ class JaxTrainEngine(TrainEngine):
                 )
                 if hidden_mode:
                     x = LMHead(x, params, model_cfg)
-                return loss_fn(x, mb)
+                res = loss_fn(x, mb)
+                return res[0] if aux_mode else res
 
             self._fwd_cache[key] = jax.jit(eval_step)
         eval_step = self._fwd_cache[key]

@@ -24,6 +24,7 @@ from typing import Any
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from areal_tpu.api.cli_args import MicroBatchSpec, PPOActorConfig
@@ -35,6 +36,7 @@ from areal_tpu.utils.data import KLEstimator, Normalization
 from areal_tpu.utils.datapack import ffd_allocate
 from areal_tpu.utils.functional import (
     dynamic_sampling,
+    label_logprobs_entropy_of,
     label_logprobs_of,
     ppo_actor_loss_fn,
     reward_overlong_penalty,
@@ -73,15 +75,17 @@ class PPOActor:
         )
         if self._fused_head():
             self._loss_fn.hidden_loss = True
+        # grpo_loss_fn returns (loss, per-update stats incl. entropy) — the
+        # engine averages the stats across micro-batches (reference records
+        # the same set, areal/engine/ppo/actor.py:335-377).
+        self._loss_fn.returns_aux = True
 
     def _fused_head(self) -> bool:
         """Vocab-chunked fused LM head (no [T, V] logits) when the engine
         supports it — see JaxEngineConfig.fused_lm_loss."""
-        ecfg = getattr(self.engine, "config", None)
-        return bool(
-            ecfg is not None
-            and getattr(getattr(ecfg, "jax", None), "fused_lm_loss", False)
-        )
+        from areal_tpu.engine.jax_engine import fused_lm_loss_enabled
+
+        return fused_lm_loss_enabled(self.engine)
 
     def _calc_logprobs_fn(self, temp: float):
         if temp not in self._logp_fns:
@@ -346,8 +350,8 @@ def grpo_loss_fn(
     loss_mask = mb["loss_mask"].astype(bool)
     prox_logp = mb["prox_logp"]
 
-    logprobs = label_logprobs_of(logits, labels, temperature)
-    loss, _stat = ppo_actor_loss_fn(
+    logprobs, entropy = label_logprobs_entropy_of(logits, labels, temperature)
+    loss, stat = ppo_actor_loss_fn(
         logprobs=logprobs,
         proximal_logprobs=prox_logp,
         old_logprobs=old_logp,
@@ -358,4 +362,22 @@ def grpo_loss_fn(
         c_clip=c_clip,
         behav_imp_weight_cap=behav_imp_weight_cap,
     )
-    return loss
+
+    # Per-update stats (masked means over trained tokens), mirroring the
+    # reference's recorded set. Entropy is logging-only: stop_gradient keeps
+    # it out of the policy gradient exactly as the reference detaches it.
+    n = jnp.maximum(loss_mask.sum(), 1)
+
+    def masked_mean(x, m=loss_mask):
+        return jnp.where(m, x, 0.0).sum() / n
+
+    stats = dict(
+        entropy=jax.lax.stop_gradient(masked_mean(entropy)),
+        importance_weight=masked_mean(stat["importance_weight"]),
+        approx_kl=masked_mean(stat["approx_kl"]),
+        clip_ratio=stat["clip_mask"].sum() / n,
+        dual_clip_ratio=stat["dual_clip_mask"].sum() / n,
+        behave_imp_weight=masked_mean(stat["behave_imp_weight"]),
+        behave_approx_kl=masked_mean(stat["behave_approx_kl"]),
+    )
+    return loss, stats

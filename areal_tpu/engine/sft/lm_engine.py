@@ -72,8 +72,9 @@ class LMEngine:
         self.engine = engine
 
     def _loss_fn(self):
-        cfg = getattr(self.engine, "config", None)
-        if cfg is not None and getattr(cfg.jax, "fused_lm_loss", False):
+        from areal_tpu.engine.jax_engine import fused_lm_loss_enabled
+
+        if fused_lm_loss_enabled(self.engine):
             return compute_packed_sft_loss_fused
         return compute_packed_sft_loss
 

@@ -1,0 +1,90 @@
+"""ctypes loader for the C++ host kernels (csrc/).
+
+The shared library is compiled on demand with the ambient g++ (one ~1s
+compile, cached next to the package in areal_tpu/_native/ and rebuilt when
+csrc/datapack.cc is newer). Loading is strictly best-effort: any failure
+(no compiler, read-only install, exotic platform) returns None and callers
+keep their numpy implementations — native code is an accelerator here,
+never a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("native")
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(os.path.dirname(_PKG_ROOT), "csrc", "datapack.cc")
+_SO = os.path.join(_PKG_ROOT, "_native", "libdatapack.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_failed = False
+
+
+def _build() -> bool:
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [
+        cxx, "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall", _SRC,
+        "-o", _SO,
+    ]
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.debug(f"native build unavailable: {e}")
+        return False
+    if r.returncode != 0:
+        logger.warning(
+            f"native datapack build failed (falling back to numpy): "
+            f"{r.stderr[-500:]}"
+        )
+        return False
+    return True
+
+
+def load_datapack() -> ctypes.CDLL | None:
+    """The datapack shared library, building it if needed; None on any
+    failure (callers fall back to the numpy implementations)."""
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            stale = not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+            )
+            if stale and not _build():
+                _failed = True
+                return None
+            lib = ctypes.CDLL(_SO)
+            lib.ffd_allocate_native.restype = ctypes.c_int64
+            lib.ffd_allocate_native.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.partition_balanced_native.restype = ctypes.c_int64
+            lib.partition_balanced_native.argtypes = [
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            _lib = lib
+        except Exception as e:  # noqa: BLE001 — never fail the caller
+            logger.warning(f"native datapack unavailable: {e}")
+            _failed = True
+    return _lib

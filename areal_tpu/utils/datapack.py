@@ -2,9 +2,16 @@
 
 Parity target: areal/utils/datapack.py — `ffd_allocate` (first-fit-decreasing
 bin packing under a token budget, :187), `partition_balanced` (:14),
-`min_abs_diff_partition` (:77), `flat2d` (:9). These are host-side numpy
+`min_abs_diff_partition` (:77), `flat2d` (:9). These are host-side
 routines that drive micro-batch splitting and cross-DP rollout
 redistribution; they never run on device.
+
+The two loops that scale with the rollout batch (FFD over thousands of
+sequences per PPO step; the O(n²k) partition DP) run through the C++
+kernels in csrc/datapack.cc (ctypes, built on demand — the reference
+compiles the same loops with numba). The numpy implementations below are
+the behavioral spec and the fallback when no compiler is available;
+semantics are identical and tested equal.
 """
 
 from __future__ import annotations
@@ -29,13 +36,35 @@ def partition_balanced(nums: np.ndarray, k: int, min_size: int = 1) -> list[list
     """Partition the *ordered* sequence `nums` into `k` contiguous pieces
     minimising the maximum piece sum (each piece ≥ min_size elements).
 
-    Dynamic programming over prefix sums, O(n²k); n is a micro-batch count so
-    this is cheap. Returns index lists per piece.
+    Dynamic programming over prefix sums, O(n²k). C++ fast path
+    (csrc/datapack.cc::partition_balanced_native); numpy DP fallback.
+    Returns index lists per piece.
     """
     nums = np.asarray(nums, dtype=np.int64)
     n = len(nums)
     if k <= 0 or n < k * min_size:
         raise ValueError(f"cannot split {n} items into {k} parts of >= {min_size}")
+
+    from areal_tpu.utils._native import load_datapack
+
+    lib = load_datapack()
+    if lib is not None:
+        import ctypes
+
+        arr = np.ascontiguousarray(nums)
+        bounds = np.zeros(k + 1, dtype=np.int64)
+        rc = lib.partition_balanced_native(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+            k,
+            min_size,
+            bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        if rc == 0:
+            return [
+                list(range(int(bounds[j]), int(bounds[j + 1])))
+                for j in range(k)
+            ]
     prefix = np.concatenate([[0], np.cumsum(nums)])
 
     # dp[j][i]: minimal max-sum splitting the first i items into j pieces.
@@ -81,6 +110,33 @@ def ffd_allocate(
     values = list(values)
     if capacity <= 0:
         raise ValueError("capacity must be positive")
+
+    from areal_tpu.utils._native import load_datapack
+
+    lib = load_datapack()
+    if lib is not None and values:
+        import ctypes
+
+        arr = np.ascontiguousarray(np.asarray(values, dtype=np.int64))
+        bin_of = np.zeros(len(values), dtype=np.int32)
+        n_bins = int(
+            lib.ffd_allocate_native(
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(values),
+                capacity,
+                bin_of.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+        )
+        bins = [[] for _ in range(n_bins)]
+        for i, b in enumerate(bin_of):
+            bins[int(b)].append(i)
+        # Restore FFD insertion order (desc value, ties by index) so the
+        # min_groups splitting in _finish_ffd cuts bins exactly where the
+        # pure-python path would — native and fallback stay bit-identical.
+        bins = [sorted(b, key=lambda i: (-values[i], i)) for b in bins]
+        bin_sums = [int(arr[b].astype(np.int64).sum()) for b in bins]
+        return _finish_ffd(values, bins, bin_sums, min_groups)
+
     order = sorted(range(len(values)), key=lambda i: values[i], reverse=True)
     bins: list[list[int]] = []
     bin_sums: list[int] = []
@@ -96,6 +152,15 @@ def ffd_allocate(
         if not placed:
             bins.append([idx])
             bin_sums.append(v)
+    return _finish_ffd(values, bins, bin_sums, min_groups)
+
+
+def _finish_ffd(
+    values: list[int],
+    bins: list[list[int]],
+    bin_sums: list[int],
+    min_groups: int,
+) -> list[list[int]]:
     # Meet the minimum group count by splitting the largest bins.
     while len(bins) < min_groups:
         # pick the bin with most items that can be split

@@ -1,0 +1,95 @@
+// Host-side packing kernels for areal_tpu.utils.datapack.
+//
+// Parity role: the reference compiles its packing hot loops with numba and
+// ships C++/CUDA host kernels in csrc/ (interval ops); here the two loops
+// that scale with the rollout batch (first-fit-decreasing bin packing and
+// the balanced-partition DP) are C++ behind ctypes, with the numpy
+// implementations kept as the documented fallback. Semantics are
+// bit-identical to the Python versions (stable sort, same tie-breaking,
+// same first-fit bin scan order) — tests/test_datapack.py asserts
+// native == python on randomized inputs.
+//
+// Build: make -C csrc  (or areal_tpu/utils/_native.py compiles on demand).
+
+#include <algorithm>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+extern "C" {
+
+// First-fit-decreasing: items sorted by value desc (stable: ties keep
+// index order), each placed into the first bin whose sum stays <=
+// capacity. Returns the number of bins; out_bin_of[i] = bin id of item i.
+// Bin ids are in bin-creation order (the Python side re-sorts bins by
+// first index, which is order-preserving relative to creation).
+int64_t ffd_allocate_native(const int64_t* values, int64_t n,
+                            int64_t capacity, int32_t* out_bin_of) {
+  std::vector<int64_t> order(n);
+  for (int64_t i = 0; i < n; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return values[a] > values[b]; });
+  std::vector<int64_t> bin_sums;
+  bin_sums.reserve(64);
+  for (int64_t oi = 0; oi < n; ++oi) {
+    const int64_t idx = order[oi];
+    const int64_t v = values[idx];
+    int64_t placed = -1;
+    for (size_t b = 0; b < bin_sums.size(); ++b) {
+      if (bin_sums[b] + v <= capacity) {
+        placed = static_cast<int64_t>(b);
+        break;
+      }
+    }
+    if (placed < 0) {
+      placed = static_cast<int64_t>(bin_sums.size());
+      bin_sums.push_back(0);
+    }
+    bin_sums[placed] += v;
+    out_bin_of[idx] = static_cast<int32_t>(placed);
+  }
+  return static_cast<int64_t>(bin_sums.size());
+}
+
+// Balanced contiguous partition: split nums[0..n) into k contiguous pieces
+// (each >= min_size items) minimising the max piece sum. Same DP and
+// tie-breaking (< strict improvement) as the numpy version. Writes k+1
+// boundary indices into out_bounds. Returns 0 on success, -1 on invalid
+// arguments.
+int64_t partition_balanced_native(const int64_t* nums, int64_t n, int64_t k,
+                                  int64_t min_size, int64_t* out_bounds) {
+  if (k <= 0 || n < k * min_size) return -1;
+  std::vector<int64_t> prefix(n + 1, 0);
+  for (int64_t i = 0; i < n; ++i) prefix[i + 1] = prefix[i] + nums[i];
+  const double INF = std::numeric_limits<double>::infinity();
+  // dp[j*(n+1)+i]: minimal max-sum splitting first i items into j pieces
+  std::vector<double> dp((k + 1) * (n + 1), INF);
+  std::vector<int64_t> choice((k + 1) * (n + 1), 0);
+  dp[0] = 0.0;
+  for (int64_t j = 1; j <= k; ++j) {
+    for (int64_t i = j * min_size; i <= n; ++i) {
+      double best = INF;
+      int64_t best_t = 0;
+      for (int64_t t = (j - 1) * min_size; t <= i - min_size; ++t) {
+        const double prev = dp[(j - 1) * (n + 1) + t];
+        const double piece = static_cast<double>(prefix[i] - prefix[t]);
+        const double cand = prev > piece ? prev : piece;
+        if (cand < best) {
+          best = cand;
+          best_t = t;
+        }
+      }
+      dp[j * (n + 1) + i] = best;
+      choice[j * (n + 1) + i] = best_t;
+    }
+  }
+  out_bounds[k] = n;
+  int64_t i = n;
+  for (int64_t j = k; j >= 1; --j) {
+    i = choice[j * (n + 1) + i];
+    out_bounds[j - 1] = i;
+  }
+  return 0;
+}
+
+}  // extern "C"

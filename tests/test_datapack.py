@@ -58,3 +58,36 @@ def test_reorder_to_balanced_batches():
     assert sorted(flat2d(chunks)) == list(range(6))
     sums = [sum(int(seqlens[i]) for i in c) for c in chunks]
     assert max(sums) - min(sums) <= 100
+
+
+def test_native_matches_python_fallback(monkeypatch):
+    """C++ kernels (csrc/datapack.cc) must be bit-identical to the numpy
+    spec, including the min_groups bin-splitting path."""
+    import areal_tpu.utils.datapack as dp
+    from areal_tpu.utils import _native
+
+    if _native.load_datapack() is None:
+        pytest.skip("no native build available")
+
+    rng = np.random.RandomState(0)
+    for trial in range(20):
+        n = int(rng.randint(1, 300))
+        values = rng.randint(1, 500, n).tolist()
+        cap = int(rng.randint(300, 1500))
+        min_groups = int(rng.randint(1, 5))
+        native = dp.ffd_allocate(values, cap, min_groups=min_groups)
+        with monkeypatch.context() as m:
+            m.setattr(_native, "load_datapack", lambda: None)
+            python = dp.ffd_allocate(values, cap, min_groups=min_groups)
+        assert native == python, (trial, values[:8], cap, min_groups)
+
+    for trial in range(20):
+        k = int(rng.randint(1, 6))
+        n = int(rng.randint(k, 60))
+        nums = rng.randint(1, 200, n)
+        native = dp.partition_balanced(nums, k)
+        with monkeypatch.context() as m:
+            m.setattr(_native, "load_datapack", lambda: None)
+            python = dp.partition_balanced(nums, k)
+        # DP tie-breaks identically (strict <, same scan order)
+        assert native == python, (trial, k, nums[:8])

@@ -114,6 +114,21 @@ def test_update_moves_policy_toward_reward(actor):
     assert after[1] < before[1] * 2
 
 
+def test_ppo_update_reports_loss_stats(actor):
+    """The loss's aux stats (entropy, clip/KL ratios — the set the
+    reference records from inside grpo_loss_fn) must surface through
+    train_batch instead of being discarded."""
+    batch = _synthetic_batch()
+    batch["prox_logp"] = actor.compute_logp(batch)
+    actor.compute_advantages(batch)
+    stats = actor.ppo_update(batch)[0]
+    for k in ("entropy", "importance_weight", "approx_kl", "clip_ratio",
+              "behave_imp_weight"):
+        assert any(key.endswith(k) for key in stats), (k, sorted(stats))
+    ent = next(v for key, v in stats.items() if key.endswith("entropy"))
+    assert 0.0 < ent < 10.0, ent
+
+
 def test_split_minibatches_covers_batch():
     B, T = 6, 10
     rng = np.random.RandomState(0)

@@ -97,6 +97,42 @@ def run_case(T, nH, nKV, hd, seed=0):
     return fwd_err, bwd_err
 
 
+def run_fused_xent_case(T=1024, H=896, V=151936, seed=0):
+    """bf16 fused vocab-chunked LM loss vs dense on hardware: the bench
+    trains through ops/fused_xent.py, so its numerics+lowering get the
+    same hardware gate as the flash kernel."""
+    from areal_tpu.ops.fused_xent import chunked_label_logprobs
+    from areal_tpu.utils.functional import gather_logprobs
+
+    key = jax.random.PRNGKey(seed)
+    kh, kw, kl = jax.random.split(key, 3)
+    h = (jax.random.normal(kh, (T, H), jnp.bfloat16) * 0.5).astype(jnp.bfloat16)
+    w = (jax.random.normal(kw, (H, V), jnp.bfloat16) * 0.02).astype(jnp.bfloat16)
+    labels = jax.random.randint(kl, (T,), 0, V)
+
+    def fused_loss(h, w):
+        return -chunked_label_logprobs(h, w, labels).mean()
+
+    def dense_loss(h, w):
+        return -gather_logprobs(
+            jnp.einsum(
+                "th,hv->tv", h, w, preferred_element_type=jnp.float32
+            ),
+            labels,
+        ).mean()
+
+    lf, (dhf, dwf) = jax.jit(jax.value_and_grad(fused_loss, argnums=(0, 1)))(h, w)
+    ld, (dhd, dwd) = jax.jit(jax.value_and_grad(dense_loss, argnums=(0, 1)))(h, w)
+    val_err = abs(float(lf) - float(ld)) / max(abs(float(ld)), 1e-6)
+
+    def rel(a, b):
+        na = jnp.linalg.norm(a.astype(jnp.float32) - b.astype(jnp.float32))
+        nb = jnp.linalg.norm(b.astype(jnp.float32)) + 1e-6
+        return float(na / nb)
+
+    return val_err, max(rel(dhf, dhd), rel(dwf, dwd))
+
+
 def main():
     backend = jax.default_backend()
     if backend != "tpu":
@@ -126,6 +162,17 @@ def main():
         except Exception as e:  # lowering failures land here
             print(f"FAIL T={T} nH={nH} nKV={nKV} hd={hd}: {type(e).__name__}: {e}")
             failures += 1
+    try:
+        val_err, grad_err = run_fused_xent_case()
+        ok = val_err < 0.01 and grad_err < 0.05
+        print(
+            f"{'OK ' if ok else 'BAD'} fused_xent bf16 151936-vocab  "
+            f"val_relerr={val_err:.5f} grad_relerr={grad_err:.4f}"
+        )
+        failures += 0 if ok else 1
+    except Exception as e:
+        print(f"FAIL fused_xent: {type(e).__name__}: {e}")
+        failures += 1
     print("RESULT:", "PASS" if failures == 0 else f"{failures} FAILURES")
     return 0 if failures == 0 else 1
 
