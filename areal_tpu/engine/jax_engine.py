@@ -52,7 +52,10 @@ from areal_tpu.models.qwen2 import (
     LMHead,
     ModelConfig,
     forward as model_forward,
+    init_lora_params,
     init_params,
+    lora_param_axes,
+    merge_lora,
     param_logical_axes,
     segment_ids_from_cu_seqlens,
 )
@@ -207,6 +210,19 @@ class JaxTrainEngine(TrainEngine):
                 is_critic=cfg.is_critic,
                 attn_impl=attn_impl,
             )
+            if cfg.use_lora:
+                if not cfg.jax.scan_layers:
+                    # the non-scan forward never applies adapters; with the
+                    # base frozen, training would silently be a no-op
+                    raise ValueError(
+                        "use_lora requires jax.scan_layers=True"
+                    )
+                overrides.update(
+                    lora_rank=cfg.lora_rank,
+                    lora_alpha=float(cfg.lora_alpha),
+                    lora_targets=tuple(cfg.target_modules)
+                    or ("q_proj", "v_proj"),
+                )
             self.model_config = ModelConfig.from_hf_config(cfg.path, **overrides)
 
         pp_enabled = self.mesh.shape.get(mesh_lib.AXIS_PP, 1) > 1
@@ -224,6 +240,8 @@ class JaxTrainEngine(TrainEngine):
             fsdp=bool(cfg.jax.fsdp_axes), pp=pp_enabled
         )
         axes = param_logical_axes(self.model_config)
+        if self.model_config.lora_rank:
+            axes["lora"] = lora_param_axes(self.model_config)
         self._param_shardings = jax.tree.map(
             lambda a: mesh_lib.named_sharding(self.mesh, a, rules),
             axes,
@@ -237,6 +255,12 @@ class JaxTrainEngine(TrainEngine):
             )
         else:
             host_params = hf_io.load_hf_params(cfg.path, self.model_config)
+        if self.model_config.lora_rank:
+            # Adapters always start fresh (HF checkpoints carry the base);
+            # they are the ONLY trainable subtree — see _trainable_sub.
+            host_params["lora"] = init_lora_params(
+                self.model_config, jax.random.PRNGKey(2)
+            )
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(jnp.asarray(x), s),
             host_params,
@@ -252,8 +276,31 @@ class JaxTrainEngine(TrainEngine):
             opt_state = jax.jit(
                 self.optimizer.init,
                 out_shardings=self._opt_state_shardings(),
-            )(self.params)
+            )(self._trainable_sub(self.params))
             self.opt_state = opt_state
+
+    @property
+    def _lora(self) -> bool:
+        return bool(self.model_config and self.model_config.lora_rank)
+
+    def _trainable_sub(self, tree):
+        """The subtree gradients/optimizer apply to: the lora adapters when
+        LoRA is on (the frozen base rides under stop_gradient in the grad
+        step, so XLA never builds base weight gradients), else everything.
+        Works on params and on their sharding tree alike."""
+        return tree["lora"] if self._lora else tree
+
+    def _merge_trainable(self, params, new_trainable):
+        if self._lora:
+            return {**params, "lora": new_trainable}
+        return new_trainable
+
+    def _export_params(self):
+        """Params for save/push: lora deltas folded into the base kernels
+        (consumers — HF export, decode engines — serve plain kernels)."""
+        if self._lora:
+            return merge_lora(self.params, self.model_config)
+        return self.params
 
     def _opt_state_shardings(self):
         """Shard optimizer moments exactly like their parameters.
@@ -267,11 +314,13 @@ class JaxTrainEngine(TrainEngine):
         """
         if self._opt_shardings is not None:
             return self._opt_shardings
-        shape = jax.eval_shape(self.optimizer.init, self.params)
+        shape = jax.eval_shape(
+            self.optimizer.init, self._trainable_sub(self.params)
+        )
         param_paths = {
             tuple(str(k) for k in path): shard
             for path, shard in jax.tree_util.tree_leaves_with_path(
-                self._param_shardings
+                self._trainable_sub(self._param_shardings)
             )
         }
         replicated = mesh_lib.replicated(self.mesh)
@@ -362,7 +411,9 @@ class JaxTrainEngine(TrainEngine):
     # -- save / load ----------------------------------------------------
     def save(self, meta: SaveLoadMeta) -> None:
         if meta.weight_format == "hf":
-            hf_io.save_hf_params(self.params, self.model_config, meta.path)
+            hf_io.save_hf_params(
+                self._export_params(), self.model_config, meta.path
+            )
             # copy config.json for reload-ability
             if self.config.path and os.path.exists(
                 os.path.join(self.config.path, "config.json")
@@ -399,6 +450,16 @@ class JaxTrainEngine(TrainEngine):
             )
             return
         host_params = hf_io.load_hf_params(meta.path, self.model_config)
+        if self._lora:
+            # HF checkpoints carry the (possibly merged) base only; keep
+            # the CURRENT adapters if we have them, else fresh-init — the
+            # sharding tree includes the 'lora' subtree either way.
+            if self.params is not None and "lora" in self.params:
+                host_params["lora"] = self.params["lora"]
+            else:
+                host_params["lora"] = init_lora_params(
+                    self.model_config, jax.random.PRNGKey(2)
+                )
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(jnp.asarray(x), s),
             host_params,
@@ -496,11 +557,13 @@ class JaxTrainEngine(TrainEngine):
             # (fsdp_engine.py:298-401).
             assert self.rollout_engine is not None
             self.rollout_engine.update_weights_from_distributed(
-                meta, self.params, self.model_config
+                meta, self._export_params(), self.model_config
             )
         elif meta.type == "disk":
             start = time.monotonic()
-            hf_io.save_hf_params(self.params, self.model_config, meta.path)
+            hf_io.save_hf_params(
+                self._export_params(), self.model_config, meta.path
+            )
             # name_resolve timestamp handshake (fsdp_engine.py:403-425)
             update_name = names.update_weights_from_disk(
                 self.config.experiment_name,
@@ -535,7 +598,7 @@ class JaxTrainEngine(TrainEngine):
                         t,
                     )
                 )
-            casted = self._push_cast_fn(self.params)
+            casted = self._push_cast_fn(self._export_params())
             if jax.process_count() > 1:  # pragma: no cover - multi-host only
                 from jax.experimental import multihost_utils
 
@@ -665,15 +728,19 @@ class JaxTrainEngine(TrainEngine):
 
         model_cfg = self.model_config
         mesh = self.mesh
-        param_sh = self._param_shardings
+        param_sh = self._trainable_sub(self._param_shardings)
         use_aux = bool(
             model_cfg.num_experts and model_cfg.router_aux_loss_coef > 0
         )
 
         hidden_mode = self._wants_hidden(loss_fn)
         aux_mode = self._returns_aux(loss_fn)
+        lora_mode = self._lora
 
-        def loss_of(params, stacked, weights):
+        def loss_of(trainable, frozen, stacked, weights):
+            params = (
+                {**frozen, "lora": trainable} if lora_mode else trainable
+            )
             if hidden_mode:
                 per_mb_fn = lambda h, mb: loss_fn(  # noqa: E731
                     LMHead(h, params, model_cfg), mb
@@ -703,9 +770,16 @@ class JaxTrainEngine(TrainEngine):
             return total, (losses, stats)
 
         def pip_grad_step(params, stacked, weights):
+            if lora_mode:
+                trainable = params["lora"]
+                frozen = jax.lax.stop_gradient(
+                    {k: v for k, v in params.items() if k != "lora"}
+                )
+            else:
+                trainable, frozen = params, {}
             (_, (losses, stats)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
-            )(params, stacked, weights)
+            )(trainable, frozen, stacked, weights)
             grads = jax.lax.with_sharding_constraint(grads, param_sh)
             return losses, stats, grads
 
@@ -729,8 +803,12 @@ class JaxTrainEngine(TrainEngine):
 
         hidden_mode = self._wants_hidden(loss_fn)
         aux_mode = self._returns_aux(loss_fn)
+        lora_mode = self._lora
 
-        def loss_of(params, mb):
+        def loss_of(trainable, frozen, mb):
+            params = (
+                {**frozen, "lora": trainable} if lora_mode else trainable
+            )
             with_aux = bool(
                 model_cfg.num_experts and model_cfg.router_aux_loss_coef > 0
             )
@@ -752,11 +830,18 @@ class JaxTrainEngine(TrainEngine):
                 loss = loss + model_cfg.router_aux_loss_coef * aux
             return loss, stats
 
-        param_sh = self._param_shardings
+        param_sh = self._trainable_sub(self._param_shardings)
 
         def grad_step(params, acc, weight, mb):
+            if lora_mode:
+                trainable = params["lora"]
+                frozen = jax.lax.stop_gradient(
+                    {k: v for k, v in params.items() if k != "lora"}
+                )
+            else:
+                trainable, frozen = params, {}
             (loss, stats), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                params, mb
+                trainable, frozen, mb
             )
             # Pin gradients to their parameter's layout BEFORE accumulation:
             # left free, XLA may lay the backward's psum outputs out
@@ -810,7 +895,7 @@ class JaxTrainEngine(TrainEngine):
             apply_update,
             donate_argnums=(0, 1),
             out_shardings=(
-                self._param_shardings,
+                self._trainable_sub(self._param_shardings),
                 self._opt_state_shardings(),
                 mesh_lib.replicated(self.mesh),
             ),
@@ -824,9 +909,9 @@ class JaxTrainEngine(TrainEngine):
                 lambda p: jax.tree.map(
                     lambda x: jnp.zeros(x.shape, grad_dtype), p
                 ),
-                out_shardings=self._param_shardings,
+                out_shardings=self._trainable_sub(self._param_shardings),
             )
-        return self._zero_grads_fn(self.params)
+        return self._zero_grads_fn(self._trainable_sub(self.params))
 
     def train_batch(
         self,
@@ -864,20 +949,23 @@ class JaxTrainEngine(TrainEngine):
             grad_step = self._get_grad_step(loss_fn)
             acc = self._zero_grads()
             losses = []
-            stat_acc: dict[str, float] = {}
+            mb_stat_list: list[dict] = []
             for mb, w in zip(mb_list.mbs, weights):
                 dev_mb = self._device_mb(mb)
                 loss, mb_stats, acc = grad_step(self.params, acc, w, dev_mb)
                 losses.append(loss)
+                # keep device arrays — float() here would sync per
+                # micro-batch and serialize the accumulation pipeline
+                mb_stat_list.append(mb_stats)
+            for mb_stats, w in zip(mb_stat_list, weights):
                 for k, v in mb_stats.items():
-                    stat_acc[k] = stat_acc.get(k, 0.0) + float(v) * w
-            aux_stats = {
-                k: v / total_weight for k, v in stat_acc.items()
-            }
+                    aux_stats[k] = aux_stats.get(k, 0.0) + float(v) * w
+            aux_stats = {k: v / total_weight for k, v in aux_stats.items()}
         apply_update = self._get_apply_update()
-        self.params, self.opt_state, gnorm = apply_update(
-            self.params, self.opt_state, acc, total_weight
+        new_trainable, self.opt_state, gnorm = apply_update(
+            self._trainable_sub(self.params), self.opt_state, acc, total_weight
         )
+        self.params = self._merge_trainable(self.params, new_trainable)
         gnorm_f = float(gnorm)  # blocks until the step is done on device
         step_time = time.perf_counter() - t_start
         self._step_count += 1

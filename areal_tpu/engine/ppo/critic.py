@@ -14,6 +14,8 @@ from typing import Any
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from areal_tpu.api.cli_args import PPOCriticConfig
 from areal_tpu.api.engine_api import TrainEngine
 from areal_tpu.engine.jax_engine import JaxTrainEngine
@@ -29,6 +31,7 @@ class PPOCritic:
         self._loss_fn = functools.partial(
             critic_loss_fn, value_eps_clip=config.eps_clip
         )
+        self._loss_fn.returns_aux = True  # value_clip_ratio via engine stats
         self._value_hook = lambda values, mb: values
 
     # ------------------------------------------------------------------
@@ -75,15 +78,21 @@ class PPOCritic:
 
 def critic_loss_fn(values, mb: dict[str, Any], value_eps_clip: float):
     """Packed critic loss: clip the value update around the old values
-    (parity: critic.py loss fn)."""
-    loss, _stat = ppo_critic_loss_fn(
+    (parity: critic.py loss fn). Returns (loss, stats) — the engine
+    averages the clip fraction into the update stats."""
+    loss, stat = ppo_critic_loss_fn(
         value=values,
         old_value=mb["values"],
         target_value=mb["returns"],
         value_eps_clip=value_eps_clip,
         loss_mask=mb["loss_mask"],
     )
-    return loss
+    mask = mb["loss_mask"].astype(bool)
+    n = jnp.maximum(mask.sum(), 1)
+    stats = dict(
+        value_clip_ratio=(stat["clip_mask"] & mask).sum() / n,
+    )
+    return loss, stats
 
 
 class JaxPPOCritic(JaxTrainEngine):

@@ -137,6 +137,16 @@ class ModelConfig:
     # vocab chunk for the fused LM-head loss (ops/fused_xent.py): peak
     # logits transient is [tokens, loss_vocab_chunk]
     loss_vocab_chunk: int = 16384
+    # -- LoRA (parity: the reference's peft path, areal/engine/
+    # fsdp_engine.py:270 + TrainEngineConfig.use_lora/lora_rank/...).
+    # rank 0 = disabled. Adapters live in a SEPARATE top-level "lora"
+    # subtree (params["lora"]), so the engine can differentiate/optimize
+    # that subtree alone while the frozen base rides under stop_gradient —
+    # XLA then dead-code-eliminates the base weight-gradient matmuls.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    # HF-style target module names; mapped onto kernel leaves below.
+    lora_targets: tuple = ("q_proj", "v_proj")
 
     @property
     def head_dim_(self) -> int:
@@ -416,6 +426,197 @@ _LAYER_AXES = {
     "input_norm_bias": ("norm",),
     "post_attn_norm_bias": ("norm",),
 }
+
+# HF lora target name -> (layer subtree, kernel leaf)
+_LORA_TARGET_LEAVES = {
+    "q_proj": ("attn", "q_kernel"),
+    "k_proj": ("attn", "k_kernel"),
+    "v_proj": ("attn", "v_kernel"),
+    "o_proj": ("attn", "o_kernel"),
+    "gate_proj": ("mlp", "gate_kernel"),
+    "up_proj": ("mlp", "up_kernel"),
+    "down_proj": ("mlp", "down_kernel"),
+    "c_fc": ("mlp", "fc1_kernel"),
+    "c_proj_mlp": ("mlp", "fc2_kernel"),
+}
+
+
+def _lora_leaves(cfg: ModelConfig) -> dict[tuple[str, str], tuple]:
+    """{(subtree, kernel_leaf): (in_dim, out_shape...)} for enabled targets."""
+    if not cfg.lora_rank:
+        return {}
+    shapes = _layer_shapes(cfg)
+    out: dict[tuple[str, str], tuple] = {}
+    for t in cfg.lora_targets:
+        if t not in _LORA_TARGET_LEAVES:
+            raise ValueError(
+                f"lora target {t!r} not in {sorted(_LORA_TARGET_LEAVES)}"
+            )
+        sub, leaf = _LORA_TARGET_LEAVES[t]
+        if leaf not in shapes.get(sub, {}):
+            raise ValueError(
+                f"lora target {t!r} -> {sub}.{leaf} absent for this model "
+                f"(mlp_style={cfg.mlp_style!r})"
+            )
+        out[(sub, leaf)] = shapes[sub][leaf]
+    return out
+
+
+def lora_param_shapes(cfg: ModelConfig) -> dict:
+    """The params["lora"] subtree: per targeted kernel, a_kernel (in, r)
+    and b_kernel (r, *out) — stacked [L, ...] under scan_layers like the
+    base stack."""
+    leaves = _lora_leaves(cfg)
+    r = cfg.lora_rank
+    layer: dict = {}
+    for (sub, leaf), shape in leaves.items():
+        # kernel layout is (in, *out) for all targets except o_kernel,
+        # whose contraction is over the leading (heads, head_dim) dims
+        if leaf == "o_kernel":
+            a_shape = (shape[0] * shape[1], r)   # (nH*hd, r)
+            b_shape = (r, shape[2])
+        elif len(shape) == 3:                    # (H, n, hd) qkv
+            a_shape = (shape[0], r)
+            b_shape = (r, shape[1], shape[2])
+        else:                                    # (in, out)
+            a_shape = (shape[0], r)
+            b_shape = (r, shape[1])
+        layer.setdefault(sub, {})[f"{leaf}_lora_a"] = a_shape
+        layer.setdefault(sub, {})[f"{leaf}_lora_b"] = b_shape
+    if cfg.scan_layers:
+        L = cfg.num_hidden_layers
+        layer = jax.tree.map(
+            lambda sh: (L, *sh), layer, is_leaf=lambda x: isinstance(x, tuple)
+        )
+    return layer
+
+
+def lora_param_axes(cfg: ModelConfig) -> dict:
+    """Logical axes for the lora subtree: A contracts the input dim
+    ("embed"/"mlp"-side), B expands to the kernel's output axes; the tiny
+    rank dim stays unsharded."""
+    leaves = _lora_leaves(cfg)
+    layer: dict = {}
+    for (sub, leaf), _ in leaves.items():
+        if leaf == "o_kernel":
+            a_ax, b_ax = ("heads", None), (None, "embed")
+        elif leaf in ("q_kernel", "k_kernel", "v_kernel"):
+            kv = "kv_heads" if leaf in ("k_kernel", "v_kernel") else "heads"
+            a_ax, b_ax = ("embed", None), (None, kv, "head_dim")
+        elif leaf in ("down_kernel", "fc2_kernel"):
+            a_ax, b_ax = ("mlp", None), (None, "embed")
+        else:
+            a_ax, b_ax = ("embed", None), (None, "mlp")
+        layer.setdefault(sub, {})[f"{leaf}_lora_a"] = a_ax
+        layer.setdefault(sub, {})[f"{leaf}_lora_b"] = b_ax
+    if cfg.scan_layers:
+        layer = jax.tree.map(
+            lambda ax: ("layers", *ax),
+            layer,
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
+    return layer
+
+
+def init_lora_params(cfg: ModelConfig, key: jax.Array) -> dict:
+    """A ~ N(0, 1/r) fan-in scaled, B = 0 (delta starts at zero — the HF
+    peft convention), stored in param_dtype."""
+    shapes = lora_param_shapes(cfg)
+    dtype = jnp.dtype(cfg.param_dtype)
+    leaves, treedef = jax.tree.flatten(
+        shapes, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    keys = jax.random.split(key, max(len(leaves), 1))
+
+    def init_one(path_is_b, shape, k):
+        if path_is_b:
+            return jnp.zeros(shape, dtype=dtype)
+        fan_in = shape[-2] if len(shape) >= 2 else shape[0]
+        return (
+            jax.random.normal(k, shape, jnp.float32) / np.sqrt(max(fan_in, 1))
+        ).astype(dtype)
+
+    flat_paths = jax.tree_util.tree_flatten_with_path(
+        shapes, is_leaf=lambda x: isinstance(x, tuple)
+    )[0]
+    inited = [
+        init_one(path[-1].key.endswith("_lora_b"), shape, k)
+        for (path, shape), k in zip(flat_paths, keys)
+    ]
+    return jax.tree.unflatten(treedef, inited)
+
+
+def merge_lora(params: dict, cfg: ModelConfig) -> dict:
+    """Fold the lora deltas into the base kernels and drop the subtree —
+    used for HF export and weight push (the decode engine serves plain
+    kernels). W' = W + scale * A @ B with scale = alpha / r."""
+    if "lora" not in params:
+        return params
+    assert cfg.scan_layers, "lora requires scan_layers=True"
+    scale = cfg.lora_alpha / max(cfg.lora_rank, 1)
+    out = {k: v for k, v in params.items() if k != "lora"}
+
+    def merged_leaf(leaf, base, a, b):
+        if leaf == "o_kernel":
+            # base [L, nH, hd, H]; a [L, nH*hd, r]; b [L, r, H]
+            delta = jnp.einsum("lir,lrh->lih", a, b).reshape(base.shape)
+        elif leaf in ("q_kernel", "k_kernel", "v_kernel"):
+            # base [L, H, n, hd]; a [L, H, r]; b [L, r, n, hd]
+            delta = jnp.einsum("lhr,lrnd->lhnd", a, b)
+        else:
+            # base [L, i, o]; a [L, i, r]; b [L, r, o]
+            delta = jnp.einsum("lir,lro->lio", a, b)
+        return (
+            base.astype(jnp.float32) + scale * delta.astype(jnp.float32)
+        ).astype(base.dtype)
+
+    new_layers = dict(out["layers"])
+    for sub, leaves in params["lora"].items():
+        new_sub = dict(new_layers[sub])
+        for name in leaves:
+            if not name.endswith("_lora_a"):
+                continue
+            leaf = name[: -len("_lora_a")]
+            new_sub[leaf] = merged_leaf(
+                leaf,
+                new_layers[sub][leaf],
+                leaves[f"{leaf}_lora_a"],
+                leaves[f"{leaf}_lora_b"],
+            )
+        new_layers[sub] = new_sub
+    out["layers"] = new_layers
+    return out
+
+
+def combine_layers_with_lora(params: dict, cfg: ModelConfig) -> dict:
+    """The scanned layer stack with lora leaves riding alongside the base
+    kernels (layer_p["attn"]["q_kernel_lora_a"], ...). attention()/mlp()
+    apply the deltas to ACTIVATIONS (y += (x@A)@B·scale), never forming a
+    merged weight — so the backward builds only the small dA/dB, not a
+    full-size dW (the point of LoRA's memory story)."""
+    if not cfg.lora_rank or "lora" not in params:
+        return params["layers"]
+    base = params["layers"]
+    out = {k: v for k, v in base.items()}
+    for sub, leaves in params["lora"].items():
+        out[sub] = {**base[sub], **leaves}
+    return out
+
+
+def _lora_delta(layer_p: dict, leaf: str, x: jax.Array, cfg: ModelConfig):
+    """scale * (x @ A) @ B for `leaf`, or None when not adapted. Output
+    shape follows B's trailing dims ([..., n, hd] for qkv, [..., out]
+    otherwise)."""
+    a = layer_p.get(f"{leaf}_lora_a")
+    if a is None:
+        return None
+    b = layer_p[f"{leaf}_lora_b"]
+    scale = cfg.lora_alpha / max(cfg.lora_rank, 1)
+    xr = jnp.einsum("...i,ir->...r", x, a)
+    if b.ndim == 3:  # qkv: (r, n, hd)
+        return jnp.einsum("...r,rnd->...nd", xr, b) * scale
+    return jnp.einsum("...r,ro->...o", xr, b) * scale
+
 
 _MOE_MLP_AXES = {
     "router_kernel": ("embed", None),
@@ -762,6 +963,10 @@ def attention(
     q = jnp.einsum("th,hnd->tnd", x, layer_p["q_kernel"])
     k = jnp.einsum("th,hnd->tnd", x, layer_p["k_kernel"])
     v = jnp.einsum("th,hnd->tnd", x, layer_p["v_kernel"])
+    if cfg.lora_rank:
+        q = _with_lora(layer_p, "q_kernel", q, x, cfg)
+        k = _with_lora(layer_p, "k_kernel", k, x, cfg)
+        v = _with_lora(layer_p, "v_kernel", v, x, cfg)
     if cfg.qkv_bias:
         q = q + layer_p["q_bias"]
         k = k + layer_p["k_bias"]
@@ -800,33 +1005,41 @@ def attention(
         out = out.reshape(T, nH, hd)
     out = _cstr(out, "tokens", "act_heads", None)
     proj = jnp.einsum("tnd,ndh->th", out, layer_p["o_kernel"])
+    if cfg.lora_rank:
+        d = _lora_delta(
+            layer_p, "o_kernel", out.reshape(T, nH * hd), cfg
+        )
+        if d is not None:
+            proj = proj + d
     if cfg.attn_out_bias:
         proj = proj + layer_p["o_bias"]
     return _cstr(proj, "tokens", "act_embed")
 
 
+def _with_lora(layer_p, leaf, y, x, cfg):
+    if not cfg.lora_rank:
+        return y
+    d = _lora_delta(layer_p, leaf, x, cfg)
+    return y if d is None else y + d
+
+
 def mlp(layer_p: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     act = act_fn(cfg)
     if cfg.mlp_style == "fc":
-        h = act(
-            jnp.einsum("th,hm->tm", x, layer_p["fc1_kernel"])
-            + layer_p["fc1_bias"]
-        )
-        h = _cstr(h, "tokens", "act_mlp")
-        return _cstr(
-            jnp.einsum("tm,mh->th", h, layer_p["fc2_kernel"])
-            + layer_p["fc2_bias"],
-            "tokens",
-            "act_embed",
-        )
+        h1 = jnp.einsum("th,hm->tm", x, layer_p["fc1_kernel"])
+        h1 = _with_lora(layer_p, "fc1_kernel", h1, x, cfg)
+        h = _cstr(act(h1 + layer_p["fc1_bias"]), "tokens", "act_mlp")
+        out = jnp.einsum("tm,mh->th", h, layer_p["fc2_kernel"])
+        out = _with_lora(layer_p, "fc2_kernel", out, h, cfg)
+        return _cstr(out + layer_p["fc2_bias"], "tokens", "act_embed")
     gate = jnp.einsum("th,hm->tm", x, layer_p["gate_kernel"])
+    gate = _with_lora(layer_p, "gate_kernel", gate, x, cfg)
     up = jnp.einsum("th,hm->tm", x, layer_p["up_kernel"])
+    up = _with_lora(layer_p, "up_kernel", up, x, cfg)
     h = _cstr(act(gate) * up, "tokens", "act_mlp")
-    return _cstr(
-        jnp.einsum("tm,mh->th", h, layer_p["down_kernel"]),
-        "tokens",
-        "act_embed",
-    )
+    out = jnp.einsum("tm,mh->th", h, layer_p["down_kernel"])
+    out = _with_lora(layer_p, "down_kernel", out, h, cfg)
+    return _cstr(out, "tokens", "act_embed")
 
 
 def _moe_group_size(T: int, target: int) -> int:
@@ -1040,7 +1253,7 @@ def forward(
             return (h, aux_sum + aux), None
 
         (x, aux_total), _ = jax.lax.scan(
-            body, (x, jnp.float32(0.0)), params["layers"]
+            body, (x, jnp.float32(0.0)), combine_layers_with_lora(params, cfg)
         )
     else:
         aux_total = jnp.float32(0.0)
@@ -1141,7 +1354,7 @@ def forward_pipelined(
         ys, aux_total = pipeline_trunk(
             mesh,
             stage_fn,
-            params["layers"],
+            combine_layers_with_lora(params, cfg),
             x,
             (position_ids, segment_ids),
         )

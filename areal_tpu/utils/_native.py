@@ -29,6 +29,10 @@ _failed = False
 
 
 def _build() -> bool:
+    if not os.path.exists(_SRC):
+        # installed without the csrc/ tree: numpy fallback, no warning
+        logger.debug("native datapack source not present; using numpy")
+        return False
     cxx = os.environ.get("CXX", "g++")
     cmd = [
         cxx, "-O3", "-fPIC", "-shared", "-std=c++17", "-Wall", _SRC,

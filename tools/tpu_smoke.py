@@ -75,6 +75,41 @@ def run_case(T, nH, nKV, hd, seed=0):
             q, k, v, seg, sm_scale=sm_scale, interpret=False
         )
     )(q, k, v)
+
+    if T > 8192:
+        # Long-context mode: a [T, T] dense reference is infeasible (32k ->
+        # 4 GiB f32 per head), which is the point of running this case.
+        # Validate the full kernel fwd+bwd run and are finite, and check
+        # numerics on a 128-query slice against the full K/V (its rows
+        # attend over the whole prefix, covering the deepest accumulation).
+        qs = slice(pad_from - 128, pad_from)
+        scores = (
+            jnp.einsum(
+                "qkgd,skd->kgqs",
+                q[qs].astype(jnp.float32).reshape(128, nKV, nH // nKV, hd),
+                k.astype(jnp.float32),
+            )
+            * sm_scale
+        )
+        pos = jnp.arange(T)
+        m = (
+            (seg[qs][:, None] == seg[None, :])
+            & (pos[qs][:, None] >= pos[None, :])
+            & (seg[qs][:, None] != PADDING_SEGMENT)
+        )
+        scores = jnp.where(m[None, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        o_slice = jnp.einsum(
+            "kgqs,skd->qkgd", p, v.astype(jnp.float32)
+        ).reshape(128, nH, hd)
+        fwd_err = float(
+            jnp.max(jnp.abs(o_flash[qs].astype(jnp.float32) - o_slice))
+        )
+        g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        finite = all(bool(jnp.all(jnp.isfinite(g))) for g in g_flash)
+        bwd_err = 0.0 if finite else float("inf")
+        return fwd_err, bwd_err
+
     o_ref = dense_reference(q, k, v, seg, sm_scale)
     mask = np.asarray(seg != PADDING_SEGMENT)
     fwd_err = float(
@@ -148,6 +183,10 @@ def main():
         (512, 8, 8, 128),
         (130, 14, 2, 64),   # ragged packed length -> padded block path
         (2048, 16, 8, 64),
+        # 32k-class long context: the flash kernel's O(T) memory claim on
+        # hardware (a dense [32k, 32k] f32 score matrix would be 4 GiB per
+        # head — this must run in the online-softmax tiling instead)
+        (32768, 14, 2, 64),
     ]
     failures = 0
     for T, nH, nKV, hd in cases:
