@@ -451,15 +451,13 @@ class JaxTrainEngine(TrainEngine):
             return
         host_params = hf_io.load_hf_params(meta.path, self.model_config)
         if self._lora:
-            # HF checkpoints carry the (possibly merged) base only; keep
-            # the CURRENT adapters if we have them, else fresh-init — the
-            # sharding tree includes the 'lora' subtree either way.
-            if self.params is not None and "lora" in self.params:
-                host_params["lora"] = self.params["lora"]
-            else:
-                host_params["lora"] = init_lora_params(
-                    self.model_config, jax.random.PRNGKey(2)
-                )
+            # HF checkpoints carry merged kernels (save/_export_params
+            # folds the deltas in), so adapters restart at zero-delta —
+            # keeping the trained A,B would double-apply the delta on top
+            # of a base that already contains it.
+            host_params["lora"] = init_lora_params(
+                self.model_config, jax.random.PRNGKey(2)
+            )
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(jnp.asarray(x), s),
             host_params,
@@ -924,6 +922,8 @@ class JaxTrainEngine(TrainEngine):
         # with different strategies coexist in one process (actor + critic).
         mesh_lib.set_current_mesh(self.mesh)
         assert self.optimizer is not None, "engine has no optimizer"
+        from areal_tpu.utils.perf_tracer import annotate
+
         t_start = time.perf_counter()
         mb_list = split_padded_tensor_dict_into_mb_list(
             input_, self.config.mb_spec
@@ -931,6 +931,10 @@ class JaxTrainEngine(TrainEngine):
         weights = [float(loss_weight_fn(mb)) for mb in mb_list.mbs]
         total_weight = float(sum(weights)) or 1.0
         aux_stats: dict[str, float] = {}
+        # Manual enter/exit keeps the diff flat; an exception here aborts
+        # the step (and any active profile) anyway.
+        xprof = annotate("train_batch")
+        xprof.__enter__()
         if self._pp_size > 1:
             # pipelined path: all micro-batches stream through the pp
             # stages inside ONE jitted step (fill/steady/drain), one backward
@@ -967,6 +971,7 @@ class JaxTrainEngine(TrainEngine):
         )
         self.params = self._merge_trainable(self.params, new_trainable)
         gnorm_f = float(gnorm)  # blocks until the step is done on device
+        xprof.__exit__(None, None, None)
         step_time = time.perf_counter() - t_start
         self._step_count += 1
         lr = float(self.lr_schedule(self._step_count))

@@ -87,11 +87,9 @@ def critic_loss_fn(values, mb: dict[str, Any], value_eps_clip: float):
         value_eps_clip=value_eps_clip,
         loss_mask=mb["loss_mask"],
     )
-    mask = mb["loss_mask"].astype(bool)
-    n = jnp.maximum(mask.sum(), 1)
-    stats = dict(
-        value_clip_ratio=(stat["clip_mask"] & mask).sum() / n,
-    )
+    n = jnp.maximum(mb["loss_mask"].astype(bool).sum(), 1)
+    # clip_mask arrives pre-masked by ppo_critic_loss_fn
+    stats = dict(value_clip_ratio=stat["clip_mask"].sum() / n)
     return loss, stats
 
 

@@ -72,9 +72,16 @@ def main(argv: list[str] | None = None) -> None:
         p.error("--tokenizer-path (or --model-path) is required: without a "
                 "tokenizer every completion decodes to None and all rewards "
                 "score 0")
-    from transformers import AutoTokenizer
+    if tok_path == "synthetic-arith":
+        # offline smoke tokenizer (same dispatch as the example entry
+        # points) — lets the whole eval pipeline run air-gapped
+        from areal_tpu.dataset.arith import ArithTokenizer
 
-    tokenizer = AutoTokenizer.from_pretrained(tok_path)
+        tokenizer = ArithTokenizer()
+    else:
+        from transformers import AutoTokenizer
+
+        tokenizer = AutoTokenizer.from_pretrained(tok_path)
 
     if args.servers or (args.experiment_name and args.trial_name):
         from areal_tpu.core.remote_inf_engine import (
@@ -138,6 +145,10 @@ def main(argv: list[str] | None = None) -> None:
             all_metrics[name] = res.to_dict()
     finally:
         engine.destroy()
+    # top-level summary — the AutomaticEvaluator's per-checkpoint artifact
+    os.makedirs(args.output_path, exist_ok=True)
+    with open(os.path.join(args.output_path, "result.json"), "w") as f:
+        json.dump(all_metrics, f, indent=2)
     print(json.dumps(all_metrics, indent=2))
 
 

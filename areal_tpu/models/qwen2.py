@@ -453,6 +453,14 @@ def _lora_leaves(cfg: ModelConfig) -> dict[tuple[str, str], tuple]:
                 f"lora target {t!r} not in {sorted(_LORA_TARGET_LEAVES)}"
             )
         sub, leaf = _LORA_TARGET_LEAVES[t]
+        if sub == "mlp" and cfg.num_experts:
+            # moe_mlp routes tokens through stacked expert kernels and
+            # never reads adapter leaves — accepting the target would train
+            # a dead adapter and corrupt merge_lora's 2-D einsum
+            raise NotImplementedError(
+                f"lora target {t!r}: adapters on MoE expert MLPs are not "
+                "supported (attention targets are)"
+            )
         if leaf not in shapes.get(sub, {}):
             raise ValueError(
                 f"lora target {t!r} -> {sub}.{leaf} absent for this model "

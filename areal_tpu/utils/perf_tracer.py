@@ -135,3 +135,40 @@ def get() -> PerfTracer:
 
 def trace_scope(name: str, category: str = "compute", **args):
     return get().trace_scope(name, category, **args)
+
+
+# ---------------------------------------------------------------------------
+# XLA-level profiling (xprof). The catapult tracer above captures HOST-side
+# scheduling; device kernel timelines come from jax.profiler, which writes
+# tensorboard/xplane traces (the TPU counterpart of the reference's kineto/
+# perfetto CUDA kernel stats, realhf/base/monitor.py:428). Enable per-run
+# with AREAL_TPU_XPROF_DIR=/path or scoped via `xprof_trace()`.
+# ---------------------------------------------------------------------------
+
+
+@contextmanager
+def xprof_trace(log_dir: str | None = None):
+    """Capture a jax.profiler device trace around the enclosed block.
+
+    No-op when no directory is configured (arg or AREAL_TPU_XPROF_DIR) —
+    profiling stays opt-in and free when off."""
+    import jax
+
+    target = log_dir or os.environ.get("AREAL_TPU_XPROF_DIR")
+    if not target:
+        yield None
+        return
+    os.makedirs(target, exist_ok=True)
+    jax.profiler.start_trace(target)
+    try:
+        yield target
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named device-trace region (shows up in xprof timelines); safe and
+    ~free when no trace is active."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

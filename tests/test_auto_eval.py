@@ -1,0 +1,116 @@
+"""AutomaticEvaluator: checkpoint discovery, ordered publish, recovery.
+
+Reference behavior: realhf/scheduler/evaluator.py — one eval job per saved
+checkpoint, bounded concurrency, submit and publish in global-step order,
+pre-existing outputs treated as already logged after restart.
+"""
+
+import json
+import os
+import sys
+
+from areal_tpu.evaluation.auto import AutomaticEvaluator, EvalStatus
+
+
+def _fake_eval_cmd(fail_for=None):
+    # writes {"score": <globalstep from ckpt name>} as the result
+    code = (
+        "import json,os,sys\n"
+        "ckpt, out = sys.argv[1], sys.argv[2]\n"
+        "g = ckpt.rsplit('globalstep',1)[1]\n"
+        f"fail = {fail_for!r}\n"
+        "if fail is not None and g == str(fail): sys.exit(3)\n"
+        "os.makedirs(out, exist_ok=True)\n"
+        "json.dump({'score': int(g)}, open(os.path.join(out,'result.json'),'w'))\n"
+    )
+    return [sys.executable, "-c", code, "{ckpt}", "{out}"]
+
+
+def _make_ckpt(root, epoch, step, g):
+    d = os.path.join(root, f"epoch{epoch}epochstep{step}globalstep{g}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def test_discovers_evaluates_and_publishes_in_order(tmp_path):
+    ckpt_root = str(tmp_path / "ckpts")
+    out_root = str(tmp_path / "out")
+    published = []
+    ev = AutomaticEvaluator(
+        ckpt_root,
+        out_root,
+        eval_cmd=_fake_eval_cmd(),
+        publish=lambda g, r: published.append((g, r["score"])),
+        max_concurrent_jobs=2,
+    )
+    # checkpoints appear out of order
+    _make_ckpt(ckpt_root, 0, 5, 10)
+    _make_ckpt(ckpt_root, 0, 2, 4)
+    ev.drain(timeout=30)
+    _make_ckpt(ckpt_root, 1, 1, 12)
+    ev.drain(timeout=30)
+    assert published == [(4, 4), (10, 10), (12, 12)]
+    assert ev.statuses == {4: "logged", 10: "logged", 12: "logged"}
+    assert json.load(open(os.path.join(out_root, "globalstep4", "result.json")))
+
+
+def test_failed_job_does_not_block_later_steps(tmp_path):
+    ckpt_root = str(tmp_path / "ckpts")
+    out_root = str(tmp_path / "out")
+    published = []
+    ev = AutomaticEvaluator(
+        ckpt_root,
+        out_root,
+        eval_cmd=_fake_eval_cmd(fail_for=4),
+        publish=lambda g, r: published.append(g),
+    )
+    _make_ckpt(ckpt_root, 0, 2, 4)
+    _make_ckpt(ckpt_root, 0, 5, 10)
+    ev.drain(timeout=30)
+    assert published == [10]
+    assert ev.statuses[4] == "failed" and ev.statuses[10] == "logged"
+
+
+def test_restart_treats_existing_output_as_logged(tmp_path):
+    ckpt_root = str(tmp_path / "ckpts")
+    out_root = str(tmp_path / "out")
+    os.makedirs(os.path.join(out_root, "globalstep4"))
+    _make_ckpt(ckpt_root, 0, 2, 4)
+    published = []
+    ev = AutomaticEvaluator(
+        ckpt_root,
+        out_root,
+        eval_cmd=_fake_eval_cmd(),
+        publish=lambda g, r: published.append(g),
+    )
+    ev.drain(timeout=30)
+    assert published == []  # not re-evaluated after restart
+    assert ev.statuses == {4: "logged"}
+
+
+def test_concurrency_bound(tmp_path):
+    ckpt_root = str(tmp_path / "ckpts")
+    out_root = str(tmp_path / "out")
+    slow = [
+        sys.executable,
+        "-c",
+        (
+            "import json,os,sys,time\n"
+            "time.sleep(0.3)\n"
+            "os.makedirs(sys.argv[2], exist_ok=True)\n"
+            "json.dump({'score': 1}, open(os.path.join(sys.argv[2],'result.json'),'w'))\n"
+        ),
+        "{ckpt}",
+        "{out}",
+    ]
+    ev = AutomaticEvaluator(
+        ckpt_root, out_root, eval_cmd=slow, max_concurrent_jobs=1,
+        publish=lambda g, r: None,
+    )
+    for g in (1, 2, 3):
+        _make_ckpt(ckpt_root, 0, g, g)
+    ev.step()
+    running = [s for s in ev.statuses.values() if s == "running"]
+    assert len(running) == 1
+    ev.drain(timeout=30)
+    assert all(s == "logged" for s in ev.statuses.values())
