@@ -161,6 +161,21 @@ def _synthetic_arith(
             # possibly-truncated input_ids
             n = len(x["input_ids"])
             x["loss_mask"] = ([0] * n_prompt + [1] * max(0, n - n_prompt))[:n]
+    elif type == "rw":
+        # pairwise-preference view for reward-model training: chosen =
+        # well-formed correct answer, rejected = malformed (dangling
+        # operator after the digits) — the offline stand-in for hh-rlhf's
+        # (chosen, rejected) schema. Rejecting MALFORMED text (rather than
+        # a wrong number) keeps the preference learnable by the tiny smoke
+        # model without it having to do arithmetic.
+        tok = ArithTokenizer()
+        for x in items:
+            x["chosen_input_ids"] = tok.encode(x["prompt"] + x["answer"]) + [
+                tok.eos_token_id
+            ]
+            x["rejected_input_ids"] = tok.encode(
+                x["prompt"] + x["answer"] + "+"
+            ) + [tok.eos_token_id]
     return items
 
 

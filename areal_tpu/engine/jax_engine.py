@@ -922,56 +922,58 @@ class JaxTrainEngine(TrainEngine):
         # with different strategies coexist in one process (actor + critic).
         mesh_lib.set_current_mesh(self.mesh)
         assert self.optimizer is not None, "engine has no optimizer"
-        from areal_tpu.utils.perf_tracer import annotate
+        from areal_tpu.utils.perf_tracer import annotate, maybe_xprof_step
 
         t_start = time.perf_counter()
+        # env-gated device-trace window (AREAL_TPU_XPROF_DIR [+ _STEPS])
+        maybe_xprof_step(self._step_count)
         mb_list = split_padded_tensor_dict_into_mb_list(
             input_, self.config.mb_spec
         )
         weights = [float(loss_weight_fn(mb)) for mb in mb_list.mbs]
         total_weight = float(sum(weights)) or 1.0
         aux_stats: dict[str, float] = {}
-        # Manual enter/exit keeps the diff flat; an exception here aborts
-        # the step (and any active profile) anyway.
         xprof = annotate("train_batch")
         xprof.__enter__()
-        if self._pp_size > 1:
-            # pipelined path: all micro-batches stream through the pp
-            # stages inside ONE jitted step (fill/steady/drain), one backward
-            stacked = self._stack_mbs(mb_list.mbs)
-            pip_step = self._get_pipelined_grad_step(loss_fn)
-            losses, mb_stats, acc = pip_step(
-                self.params, stacked, jnp.asarray(weights, jnp.float32)
-            )
-            losses = list(np.asarray(losses))
-            w_arr = np.asarray(weights, np.float64)
-            for k, v in mb_stats.items():
-                aux_stats[k] = float(
-                    (np.asarray(v, np.float64) * w_arr).sum() / total_weight
+        try:
+            if self._pp_size > 1:
+                # pipelined path: all micro-batches stream through the pp
+                # stages inside ONE jitted step (fill/steady/drain), one backward
+                stacked = self._stack_mbs(mb_list.mbs)
+                pip_step = self._get_pipelined_grad_step(loss_fn)
+                losses, mb_stats, acc = pip_step(
+                    self.params, stacked, jnp.asarray(weights, jnp.float32)
                 )
-        else:
-            grad_step = self._get_grad_step(loss_fn)
-            acc = self._zero_grads()
-            losses = []
-            mb_stat_list: list[dict] = []
-            for mb, w in zip(mb_list.mbs, weights):
-                dev_mb = self._device_mb(mb)
-                loss, mb_stats, acc = grad_step(self.params, acc, w, dev_mb)
-                losses.append(loss)
-                # keep device arrays — float() here would sync per
-                # micro-batch and serialize the accumulation pipeline
-                mb_stat_list.append(mb_stats)
-            for mb_stats, w in zip(mb_stat_list, weights):
+                losses = list(np.asarray(losses))
+                w_arr = np.asarray(weights, np.float64)
                 for k, v in mb_stats.items():
-                    aux_stats[k] = aux_stats.get(k, 0.0) + float(v) * w
-            aux_stats = {k: v / total_weight for k, v in aux_stats.items()}
-        apply_update = self._get_apply_update()
-        new_trainable, self.opt_state, gnorm = apply_update(
-            self._trainable_sub(self.params), self.opt_state, acc, total_weight
-        )
-        self.params = self._merge_trainable(self.params, new_trainable)
-        gnorm_f = float(gnorm)  # blocks until the step is done on device
-        xprof.__exit__(None, None, None)
+                    aux_stats[k] = float(
+                        (np.asarray(v, np.float64) * w_arr).sum() / total_weight
+                    )
+            else:
+                grad_step = self._get_grad_step(loss_fn)
+                acc = self._zero_grads()
+                losses = []
+                mb_stat_list: list[dict] = []
+                for mb, w in zip(mb_list.mbs, weights):
+                    dev_mb = self._device_mb(mb)
+                    loss, mb_stats, acc = grad_step(self.params, acc, w, dev_mb)
+                    losses.append(loss)
+                    # keep device arrays — float() here would sync per
+                    # micro-batch and serialize the accumulation pipeline
+                    mb_stat_list.append(mb_stats)
+                for mb_stats, w in zip(mb_stat_list, weights):
+                    for k, v in mb_stats.items():
+                        aux_stats[k] = aux_stats.get(k, 0.0) + float(v) * w
+                aux_stats = {k: v / total_weight for k, v in aux_stats.items()}
+            apply_update = self._get_apply_update()
+            new_trainable, self.opt_state, gnorm = apply_update(
+                self._trainable_sub(self.params), self.opt_state, acc, total_weight
+            )
+            self.params = self._merge_trainable(self.params, new_trainable)
+            gnorm_f = float(gnorm)  # blocks until the step is done on device
+        finally:
+            xprof.__exit__(None, None, None)
         step_time = time.perf_counter() - t_start
         self._step_count += 1
         lr = float(self.lr_schedule(self._step_count))

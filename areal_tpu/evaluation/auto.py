@@ -11,8 +11,10 @@ checkpoint — no slurm image / install script indirection
 (the reference shells out to evaluation/sh/install_deps_and_eval.sh on a
 slurm cluster; here any machine with the package can score a checkpoint).
 Results land in `{output_root}/globalstep{G}/result.json` and are handed
-to the `publish` callback (stats_logger by default) min-step-first, exactly
-once per step.
+to the `publish` callback min-step-first, exactly once per step. The
+default publish is a structured log line; pass e.g.
+``lambda g, r: stats_logger.commit(...)`` to forward into a metrics
+backend.
 """
 
 from __future__ import annotations
@@ -148,25 +150,27 @@ class AutomaticEvaluator:
         running = sum(
             1 for s in self._steps.values() if s.status == EvalStatus.RUNNING
         )
-        if running >= self.max_concurrent_jobs:
-            return
-        pending = [
-            g for g, s in self._steps.items() if s.status == EvalStatus.PENDING
-        ]
-        if not pending:
-            return
-        step = self._steps[min(pending)]
-        os.makedirs(step.output_dir, exist_ok=True)
-        log_path = os.path.join(step.output_dir, "eval_job.log")
-        with open(log_path, "w") as log:
-            step.process = subprocess.Popen(
-                self._cmd(step), stdout=log, stderr=subprocess.STDOUT
+        while running < self.max_concurrent_jobs:
+            pending = [
+                g
+                for g, s in self._steps.items()
+                if s.status == EvalStatus.PENDING
+            ]
+            if not pending:
+                return
+            step = self._steps[min(pending)]
+            os.makedirs(step.output_dir, exist_ok=True)
+            log_path = os.path.join(step.output_dir, "eval_job.log")
+            with open(log_path, "w") as log:
+                step.process = subprocess.Popen(
+                    self._cmd(step), stdout=log, stderr=subprocess.STDOUT
+                )
+            step.status = EvalStatus.RUNNING
+            running += 1
+            logger.info(
+                f"submitted eval job for globalstep{step.global_step} "
+                f"(pid {step.process.pid})"
             )
-        step.status = EvalStatus.RUNNING
-        logger.info(
-            f"submitted eval job for globalstep{step.global_step} "
-            f"(pid {step.process.pid})"
-        )
 
     def _check_running(self) -> None:
         for s in self._steps.values():

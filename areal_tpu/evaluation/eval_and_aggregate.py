@@ -72,7 +72,9 @@ def main(argv: list[str] | None = None) -> None:
         p.error("--tokenizer-path (or --model-path) is required: without a "
                 "tokenizer every completion decodes to None and all rewards "
                 "score 0")
-    if tok_path == "synthetic-arith":
+    from areal_tpu.models.smoke import OFFLINE_SENTINELS
+
+    if tok_path in OFFLINE_SENTINELS:
         # offline smoke tokenizer (same dispatch as the example entry
         # points) — lets the whole eval pipeline run air-gapped
         from areal_tpu.dataset.arith import ArithTokenizer

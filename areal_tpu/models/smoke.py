@@ -20,7 +20,11 @@ SMOKE_MODEL_DICT = dict(
 )
 
 
-def smoke_model_config(dtype: str = "float32", vocab_size: int | None = None) -> ModelConfig:
+def smoke_model_config(
+    dtype: str = "float32",
+    vocab_size: int | None = None,
+    is_critic: bool = False,
+) -> ModelConfig:
     """The FIXED smoke geometry. `vocab_size` is validated, never enlarged:
     trainer and decode server must agree bit-for-bit on shapes for the DCN
     weight push, so the vocab cannot silently follow a tokenizer."""
@@ -32,4 +36,4 @@ def smoke_model_config(dtype: str = "float32", vocab_size: int | None = None) ->
             "supports the built-in character tokenizer; point actor.path / "
             "decode.model_path at a real checkpoint instead"
         )
-    return ModelConfig(**d, dtype=dtype, param_dtype=dtype)
+    return ModelConfig(**d, dtype=dtype, param_dtype=dtype, is_critic=is_critic)

@@ -172,3 +172,29 @@ def annotate(name: str):
     import jax
 
     return jax.profiler.TraceAnnotation(name)
+
+
+_xprof_state = {"active": False, "done": False}
+
+
+def maybe_xprof_step(step: int) -> None:
+    """Env-gated capture window for training loops: with
+    AREAL_TPU_XPROF_DIR set, starts a jax.profiler trace at the first step
+    of AREAL_TPU_XPROF_STEPS (default "2-4", inclusive, after warmup
+    compiles) and stops it after the last. Called by the train engine at
+    the top of every train_batch; free when the env var is unset."""
+    import jax
+
+    target = os.environ.get("AREAL_TPU_XPROF_DIR")
+    if not target or _xprof_state["done"]:
+        return
+    lo, _, hi = os.environ.get("AREAL_TPU_XPROF_STEPS", "2-4").partition("-")
+    lo, hi = int(lo), int(hi or lo)
+    if not _xprof_state["active"] and lo <= step <= hi:
+        os.makedirs(target, exist_ok=True)
+        jax.profiler.start_trace(target)
+        _xprof_state["active"] = True
+    elif _xprof_state["active"] and step > hi:
+        jax.profiler.stop_trace()
+        _xprof_state["active"] = False
+        _xprof_state["done"] = True
