@@ -24,6 +24,21 @@ def register_dataset(name: str):
     return deco
 
 
+def load_tokenizer(path: str):
+    """Tokenizer dispatch shared by the example entry points and the eval
+    CLI: offline sentinels get the built-in arith tokenizer, anything else
+    goes to AutoTokenizer."""
+    from areal_tpu.models.smoke import OFFLINE_SENTINELS
+
+    if path in OFFLINE_SENTINELS:
+        from areal_tpu.dataset.arith import ArithTokenizer
+
+        return ArithTokenizer()
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(path)
+
+
 def get_custom_dataset(
     path: str,
     split: str = "train",
@@ -170,12 +185,14 @@ def _synthetic_arith(
         # model without it having to do arithmetic.
         tok = ArithTokenizer()
         for x in items:
-            x["chosen_input_ids"] = tok.encode(x["prompt"] + x["answer"]) + [
+            chosen = tok.encode(x["prompt"] + x["answer"]) + [tok.eos_token_id]
+            rejected = tok.encode(x["prompt"] + x["answer"] + "+") + [
                 tok.eos_token_id
             ]
-            x["rejected_input_ids"] = tok.encode(
-                x["prompt"] + x["answer"] + "+"
-            ) + [tok.eos_token_id]
+            if max_length:
+                chosen, rejected = chosen[:max_length], rejected[:max_length]
+            x["chosen_input_ids"] = chosen
+            x["rejected_input_ids"] = rejected
     return items
 
 

@@ -177,6 +177,15 @@ def annotate(name: str):
 _xprof_state = {"active": False, "done": False}
 
 
+def _xprof_flush() -> None:
+    if _xprof_state["active"]:
+        import jax
+
+        jax.profiler.stop_trace()
+        _xprof_state["active"] = False
+        _xprof_state["done"] = True
+
+
 def maybe_xprof_step(step: int) -> None:
     """Env-gated capture window for training loops: with
     AREAL_TPU_XPROF_DIR set, starts a jax.profiler trace at the first step
@@ -194,7 +203,8 @@ def maybe_xprof_step(step: int) -> None:
         os.makedirs(target, exist_ok=True)
         jax.profiler.start_trace(target)
         _xprof_state["active"] = True
+        # short runs (or a crash mid-window) never see a step > hi call;
+        # flush at exit so the capture is not silently lost
+        atexit.register(_xprof_flush)
     elif _xprof_state["active"] and step > hi:
-        jax.profiler.stop_trace()
-        _xprof_state["active"] = False
-        _xprof_state["done"] = True
+        _xprof_flush()

@@ -35,7 +35,11 @@ honor_jax_platforms_env()  # make JAX_PLATFORMS=cpu smoke runs stay on CPU
 from areal_tpu.api.alloc_mode import AllocationMode, AllocationType
 from areal_tpu.api.cli_args import GRPOConfig, load_expr_config, save_config
 from areal_tpu.api.io_struct import FinetuneSpec, StepInfo, WeightUpdateMeta
-from areal_tpu.dataset import SimpleDataLoader, get_custom_dataset
+from areal_tpu.dataset import (
+    SimpleDataLoader,
+    get_custom_dataset,
+    load_tokenizer,
+)
 from areal_tpu.engine.ppo.actor import JaxPPOActor
 from areal_tpu.utils import seeding, stats_tracker
 from areal_tpu.utils.evaluator import Evaluator
@@ -51,17 +55,6 @@ def gsm8k_reward_fn(prompt, completion, prompt_ids, completion_ids, **data):
     return math_verify_reward(prompt, completion, prompt_ids, completion_ids, **data)
 
 
-def load_tokenizer(path: str):
-    """HF tokenizer, or the built-in character tokenizer for offline runs."""
-    from areal_tpu.models.smoke import OFFLINE_SENTINELS
-
-    if path in OFFLINE_SENTINELS:
-        from areal_tpu.dataset.arith import ArithTokenizer
-
-        return ArithTokenizer()
-    from transformers import AutoTokenizer
-
-    return AutoTokenizer.from_pretrained(path)
 
 
 def pick_reward_fn(dataset_path: str):

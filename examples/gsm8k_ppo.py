@@ -57,15 +57,11 @@ def main(args):
             vocab_size=getattr(tokenizer, "vocab_size", None),
         )
     if not config.critic.path:
-        import dataclasses
-
         from areal_tpu.models.smoke import smoke_model_config
 
-        critic.model_config = dataclasses.replace(
-            smoke_model_config(
-                dtype=config.critic.dtype,
-                vocab_size=getattr(tokenizer, "vocab_size", None),
-            ),
+        critic.model_config = smoke_model_config(
+            dtype=config.critic.dtype,
+            vocab_size=getattr(tokenizer, "vocab_size", None),
             is_critic=True,
         )
     actor.create_process_group(alloc.train)

@@ -27,7 +27,11 @@ import numpy as np
 from areal_tpu.api.alloc_mode import AllocationMode
 from areal_tpu.api.cli_args import SFTConfig, load_expr_config, save_config
 from areal_tpu.api.io_struct import FinetuneSpec, StepInfo
-from areal_tpu.dataset import SimpleDataLoader, get_custom_dataset
+from areal_tpu.dataset import (
+    SimpleDataLoader,
+    get_custom_dataset,
+    load_tokenizer,
+)
 from areal_tpu.engine.sft.lm_engine import JaxLMEngine
 from areal_tpu.utils import seeding, stats_tracker
 from areal_tpu.utils.data import pad_sequences_to_tensors
@@ -37,16 +41,6 @@ from areal_tpu.utils.saver import Saver
 from areal_tpu.utils.stats_logger import StatsLogger
 
 
-def load_tokenizer(path: str):
-    from areal_tpu.models.smoke import OFFLINE_SENTINELS
-
-    if path in OFFLINE_SENTINELS:
-        from areal_tpu.dataset.arith import ArithTokenizer
-
-        return ArithTokenizer()
-    from transformers import AutoTokenizer
-
-    return AutoTokenizer.from_pretrained(path)
 
 
 def to_batch(items) -> dict:

@@ -1,0 +1,85 @@
+"""Example entry-point smoke: every offline config must launch, train a
+few steps, and exit 0 (ref: areal/tests/test_examples.py — example configs
+are part of the product surface, and config-tree drift breaks them first).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(script, config, *overrides, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO
+    # examples must run on the CPU mesh exactly as documented
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(_REPO, "examples", script),
+            "--config",
+            os.path.join(_REPO, "examples", "configs", config),
+            *overrides,
+        ],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+    )
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+    return r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_sft_example_smoke(tmp_path):
+    out = _run_example(
+        "gsm8k_sft.py",
+        "arith_sft_smoke.yaml",
+        "total_train_steps=3",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=sft-smoke-test",
+    )
+    assert "loss" in out
+
+
+@pytest.mark.slow
+def test_rw_example_smoke(tmp_path):
+    out = _run_example(
+        "hhrlhf_rw.py",
+        "arith_rw_smoke.yaml",
+        "total_train_steps=3",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=rw-smoke-test",
+    )
+    assert "rw_loss" in out
+
+
+@pytest.mark.slow
+def test_grpo_example_smoke(tmp_path):
+    out = _run_example(
+        "gsm8k_grpo.py",
+        "arith_grpo_smoke.yaml",
+        "total_train_steps=2",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=grpo-smoke-test",
+    )
+    assert "grpo_actor/loss" in out
+
+
+@pytest.mark.slow
+def test_sft_lora_example_smoke(tmp_path):
+    out = _run_example(
+        "gsm8k_sft.py",
+        "arith_sft_smoke.yaml",
+        "total_train_steps=3",
+        "model.use_lora=true",
+        "model.lora_rank=4",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=sft-lora-smoke-test",
+    )
+    assert "loss" in out

@@ -1,0 +1,90 @@
+"""PerfTracer catapult emitter + xprof hooks (ref: areal/tests/
+test_perf_tracer.py over areal/utils/perf_tracer.py)."""
+
+import json
+import os
+
+from areal_tpu.utils import perf_tracer
+from areal_tpu.utils.perf_tracer import PerfTracer
+
+
+def test_scopes_async_and_instant_round_trip(tmp_path):
+    out = str(tmp_path / "t.json")
+    tr = PerfTracer(rank=3, save_path=out)
+    with tr.trace_scope("fwd", "compute", step=1):
+        pass
+    tr.atrace_begin("rollout", "r1")
+    tr.atrace_end("rollout", "r1")
+    tr.instant("weights_pushed", "comm", version=2)
+    with tr.trace_scope("oddcat", "not-a-category"):
+        pass
+    assert tr.save() == out
+    events = json.load(open(out))["traceEvents"]
+    by_ph = {}
+    for e in events:
+        by_ph.setdefault(e["ph"], []).append(e)
+    assert len(by_ph["X"]) == 2 and by_ph["X"][0]["name"] == "fwd"
+    assert by_ph["X"][0]["args"] == {"step": 1}
+    assert by_ph["X"][1]["cat"] == "misc"  # unknown category folded
+    assert [e["ph"] for e in by_ph["b"] + by_ph["e"]] == ["b", "e"]
+    assert by_ph["i"][0]["args"]["version"] == 2
+    assert all(e["pid"] == 3 for e in events)
+
+
+def test_disabled_tracer_is_free_and_saves_nothing(tmp_path):
+    tr = PerfTracer(rank=0, save_path=str(tmp_path / "x.json"), enabled=False)
+    with tr.trace_scope("a"):
+        pass
+    tr.instant("b")
+    assert tr.save() is None
+    assert not os.path.exists(tmp_path / "x.json")
+
+
+def test_merge_ranks(tmp_path):
+    files = []
+    for r in (0, 1):
+        tr = PerfTracer(rank=r, save_path=str(tmp_path / f"r{r}.json"))
+        with tr.trace_scope(f"work{r}"):
+            pass
+        files.append(tr.save())
+    merged = PerfTracer.merge(
+        files + [str(tmp_path / "missing.json")], str(tmp_path / "m.json")
+    )
+    events = json.load(open(merged))["traceEvents"]
+    assert {e["pid"] for e in events} == {0, 1}
+
+
+def test_init_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("AREAL_TPU_PERF_TRACE", "1")
+    monkeypatch.setenv("AREAL_TPU_PERF_TRACE_DIR", str(tmp_path))
+    tr = perf_tracer.init_from_env(rank=5)
+    assert tr.enabled and tr.save_path.endswith("trace-rank5.json")
+    monkeypatch.setenv("AREAL_TPU_PERF_TRACE", "0")
+    tr = perf_tracer.init_from_env(rank=5)
+    assert not tr.enabled
+
+
+def test_xprof_trace_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("AREAL_TPU_XPROF_DIR", raising=False)
+    with perf_tracer.xprof_trace() as t:
+        assert t is None
+
+
+def test_maybe_xprof_step_window(tmp_path, monkeypatch):
+    """The env-gated window starts at the first configured step and stops
+    exactly once after the last — captured via the real jax profiler."""
+    import glob
+
+    monkeypatch.setenv("AREAL_TPU_XPROF_DIR", str(tmp_path))
+    monkeypatch.setenv("AREAL_TPU_XPROF_STEPS", "1-2")
+    monkeypatch.setitem(perf_tracer._xprof_state, "active", False)
+    monkeypatch.setitem(perf_tracer._xprof_state, "done", False)
+    import jax
+    import jax.numpy as jnp
+
+    for step in range(5):
+        perf_tracer.maybe_xprof_step(step)
+        jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.ones(8)))
+    assert perf_tracer._xprof_state["done"]
+    assert not perf_tracer._xprof_state["active"]
+    assert glob.glob(str(tmp_path) + "/**/*.xplane.pb", recursive=True)
