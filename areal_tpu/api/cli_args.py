@@ -435,6 +435,13 @@ class GRPOConfig(BaseExperimentConfig):
     rollout: InferenceEngineConfig = field(default_factory=InferenceEngineConfig)
     actor: PPOActorConfig = field(default_factory=PPOActorConfig)
     ref: PPOActorConfig = field(default_factory=PPOActorConfig)
+    # Which rollout workflow drives episodes: single-shot verifiable reward,
+    # the self-correction loop (ref: examples/multi-turn-math/train.py), or
+    # the VLM variant (ref: examples/vlm/clevr_count_70k_grpo.py).
+    workflow: str = "rlvr"  # "rlvr" | "multi_turn" | "vision_rlvr"
+    # multi_turn knobs (ref: areal/workflow/multi_turn.py)
+    max_turns: int = 3
+    turn_discount: float = 0.9
 
 
 @dataclass

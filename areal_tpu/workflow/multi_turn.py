@@ -38,6 +38,7 @@ class MultiTurnWorkflow(RolloutWorkflow):
         turn_discount: float = 0.9,
         feedback_text: str = DEFAULT_FEEDBACK,
         reward_timeout_seconds: float = 15.0,
+        dump_dir: str | None = None,
     ):
         self.reward_fn = AsyncRewardWrapper(
             reward_fn, timeout_seconds=reward_timeout_seconds
@@ -47,6 +48,7 @@ class MultiTurnWorkflow(RolloutWorkflow):
         self.max_turns = max_turns
         self.turn_discount = turn_discount
         self.feedback_text = feedback_text
+        self.dump_dir = dump_dir
 
     def _encode_prompt(self, data: dict[str, Any]) -> list[int]:
         if "input_ids" in data:
@@ -99,6 +101,24 @@ class MultiTurnWorkflow(RolloutWorkflow):
             versions += [-1] * len(feedback_ids)
             discount *= self.turn_discount
 
+        if self.dump_dir is not None:
+            # rollout dump mirroring RLVRWorkflow._dump (per-version dirs)
+            import json
+            import os
+
+            d = os.path.join(self.dump_dir, str(max(versions + [0])))
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, f"{uuid.uuid4().hex}.jsonl"), "w") as f:
+                f.write(
+                    json.dumps(
+                        dict(
+                            text=self.tokenizer.decode(seq),
+                            reward=float(reward) * discount,
+                            turns=turn + 1,
+                        )
+                    )
+                    + "\n"
+                )
         row = dict(
             input_ids=np.array(seq, dtype=np.int32),
             loss_mask=np.array(loss_mask, dtype=np.int32),

@@ -58,6 +58,15 @@ def gsm8k_reward_fn(prompt, completion, prompt_ids, completion_ids, **data):
 
 
 def pick_reward_fn(dataset_path: str):
+    name = dataset_path.split("/")[-1].lower()
+    if name == "clevr_count_70k":
+        from areal_tpu.reward.vqa import clevr_count_reward
+
+        return clevr_count_reward
+    if name == "geometry3k":
+        from areal_tpu.reward.vqa import geometry3k_reward
+
+        return geometry3k_reward
     if dataset_path.split("/")[-1].lower() == "synthetic-arith":
         from areal_tpu.dataset.arith import arith_reward_fn
 
@@ -169,19 +178,56 @@ def main(args):
     if getattr(tokenizer, "eos_token_id", None) is not None:
         if tokenizer.eos_token_id not in config.gconfig.stop_token_ids:
             config.gconfig.stop_token_ids.append(tokenizer.eos_token_id)
-    workflow = RLVRWorkflow(
-        reward_fn=reward_fn,
-        gconfig=config.gconfig,
-        tokenizer=tokenizer,
+    if config.workflow not in ("rlvr", "multi_turn", "vision_rlvr"):
+        raise ValueError(
+            f"workflow={config.workflow!r} not in "
+            "('rlvr', 'multi_turn', 'vision_rlvr')"
+        )
+    processor = None
+    if config.workflow == "vision_rlvr":
+        from transformers import AutoProcessor
+
+        processor = AutoProcessor.from_pretrained(config.tokenizer_path)
+
+    def make_workflow(gconfig, dump_dir=None):
+        if config.workflow == "multi_turn":
+            # self-correction loop: wrong answer -> feedback prompt ->
+            # retry, rewards discounted per extra turn (ref:
+            # examples/multi-turn-math/train.py)
+            from areal_tpu.workflow.multi_turn import MultiTurnWorkflow
+
+            return MultiTurnWorkflow(
+                reward_fn=reward_fn,
+                gconfig=gconfig,
+                tokenizer=tokenizer,
+                max_turns=config.max_turns,
+                turn_discount=config.turn_discount,
+                dump_dir=dump_dir,
+            )
+        if config.workflow == "vision_rlvr":
+            from areal_tpu.workflow.vision_rlvr import VisionRLVRWorkflow
+
+            return VisionRLVRWorkflow(
+                reward_fn=reward_fn,
+                gconfig=gconfig,
+                tokenizer=tokenizer,
+                processor=processor,
+                dump_dir=dump_dir,
+            )
+        return RLVRWorkflow(
+            reward_fn=reward_fn,
+            gconfig=gconfig,
+            tokenizer=tokenizer,
+            dump_dir=dump_dir,
+        )
+
+    workflow = make_workflow(
+        config.gconfig,
         dump_dir=os.path.join(
             StatsLogger.get_log_path(config.stats_logger), "generated"
         ),
     )
-    eval_workflow = RLVRWorkflow(
-        reward_fn=reward_fn,
-        gconfig=config.gconfig.new(temperature=0.6),
-        tokenizer=tokenizer,
-    )
+    eval_workflow = make_workflow(config.gconfig.new(temperature=0.6))
 
     saver = Saver(config.saver, ft_spec)
     stats_logger = StatsLogger(config.stats_logger, ft_spec)

@@ -72,6 +72,18 @@ def test_grpo_example_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_grpo_multiturn_example_smoke(tmp_path):
+    out = _run_example(
+        "gsm8k_grpo.py",
+        "arith_grpo_multiturn_smoke.yaml",
+        "total_train_steps=2",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=grpo-mt-smoke-test",
+    )
+    assert "grpo_actor/loss" in out
+
+
+@pytest.mark.slow
 def test_sft_lora_example_smoke(tmp_path):
     out = _run_example(
         "gsm8k_sft.py",
