@@ -1,0 +1,114 @@
+"""Worker for the 2-process distributed-trainer test.
+
+Launched twice by tests/test_multiprocess.py (the TPU-shaped counterpart
+of the reference's torchrun-subprocess distributed tests, areal/tests/
+torchrun/ + realhf StandaloneTestingProcess): each process owns 4 virtual
+CPU devices, joins one 8-device global mesh via jax.distributed, feeds the
+IDENTICAL global batch (the dist_rollout contract: every process converges
+on the same batch after host all-gather), and trains — the engine's jit
+programs then run as true multi-process SPMD, exercising the same
+cross-process collectives a multi-host TPU pod uses.
+
+Prints one line per step: LOSS <step> <value>; the parent asserts both
+ranks emit identical, decreasing values.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    coord = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.distributed.initialize(coord, num_processes=2, process_id=pid)
+    assert jax.process_count() == 2 and jax.device_count() == 8
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.sft.lm_engine import JaxLMEngine
+    from areal_tpu.models.qwen2 import ModelConfig
+    from areal_tpu.utils.data import pad_sequences_to_tensors
+
+    cfg = TrainEngineConfig(
+        experiment_name="mp",
+        trial_name="t",
+        path="",
+        init_from_scratch=True,
+        dtype="float32",
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=128),
+        optimizer=OptimizerConfig(
+            lr=5e-3,
+            warmup_steps_proportion=0.0,
+            lr_scheduler_type="constant",
+            gradient_clipping=1.0,
+        ),
+    )
+    eng = JaxLMEngine(cfg)
+    eng.model_config = ModelConfig(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        dtype="float32",
+        param_dtype="float32",
+    )
+    # dp spans BOTH processes (4 local devices each), tp within-process
+    eng.create_process_group(
+        ParallelStrategy(data_parallel_size=4, tensor_parallel_size=2)
+    )
+    assert eng.data_parallel_rank == pid
+    assert eng.data_parallel_world_size == 2
+    eng.initialize(None, FinetuneSpec(1, 50, 4))
+
+    rng = np.random.RandomState(0)  # same seed -> identical global batch
+    seqs = []
+    for L in (11, 9, 13, 7):
+        ids = rng.randint(1, 64, (L,))
+        mask = np.zeros(L, dtype=np.int32)
+        mask[1:] = 1
+        seqs.append(dict(input_ids=ids, loss_mask=mask))
+    batch = pad_sequences_to_tensors(seqs)
+
+    for step in range(4):
+        stats = eng.train_lm(batch)
+        print(f"LOSS {step} {stats['loss']:.6f}", flush=True)
+
+    # Drive the ENGINE's dcn weight push: both ranks join the
+    # process_allgather collective inside update_weights; only process 0
+    # streams to the (stub) rollout engine.
+    from areal_tpu.api.io_struct import WeightUpdateMeta
+
+    pushed = {}
+
+    class _StubRollout:
+        def update_weights_from_tensor(self, named, version, chunk_mb=512):
+            pushed["n_tensors"] = len(named)
+
+    eng.rollout_engine = _StubRollout()
+    eng.update_weights(WeightUpdateMeta(type="dcn"))
+    if pid == 0:
+        assert pushed["n_tensors"] > 0, pushed
+        print(f"GATHERED {pushed['n_tensors']}", flush=True)
+    else:
+        assert not pushed
+        print("GATHERED participated", flush=True)
+    eng.destroy()
+
+
+if __name__ == "__main__":
+    main()
