@@ -196,6 +196,60 @@ def _synthetic_arith(
     return items
 
 
+@register_dataset("countdown")
+def _countdown(
+    path: str, split: str, type: str, tokenizer=None, max_length=None, **kw
+):
+    """Offline countdown problems (parity: /root/reference/examples/
+    countdown/countdown.py) — pick numbers, build a random +-*/ expression
+    over ALL of them, and use its (integer) value as the target, so every
+    problem is solvable by construction. Items carry `target` and
+    `numbers`, which flow into countdown_reward via reward_kwargs."""
+    import numpy as np
+
+    rng = np.random.RandomState(
+        kw.get("seed", 0) + (1_000_003 if split != "train" else 0)
+    )
+    n_items = kw.get("n_items", 2048)
+    items = []
+    while len(items) < n_items:
+        k = int(rng.randint(3, 5))
+        nums = [int(rng.randint(1, 20)) for _ in range(k)]
+        # random left-to-right expression over a shuffled copy
+        order = list(rng.permutation(k))
+        expr = str(nums[order[0]])
+        val = float(nums[order[0]])
+        ok = True
+        for i in order[1:]:
+            op = str(rng.choice(["+", "-", "*", "/"]))
+            b = nums[i]  # always >= 1
+            if op == "/" and val % b != 0:
+                op = "+"  # keep targets integral
+            expr = f"({expr} {op} {b})"
+            val = {"+": val + b, "-": val - b, "*": val * b, "/": val / b}[op]
+            if abs(val) > 10_000:
+                ok = False
+                break
+        if not ok or val != int(val):
+            continue
+        target = int(val)
+        prompt = (
+            f"Using the numbers {nums}, create an equation that equals "
+            f"{target}. You can use basic arithmetic operations (+, -, *, /) "
+            "and each number can only be used once. Show your work and "
+            "return the final equation in <answer> </answer> tags."
+        )
+        item = dict(
+            messages=[{"role": "user", "content": prompt}],
+            prompt=prompt,
+            target=target,
+            numbers=nums,
+            solution=expr,
+        )
+        items.append(item)  # RLVR workflows tokenize prompts themselves
+    return items
+
+
 @register_dataset("hh-rlhf")
 def _hh_rlhf(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
     """Anthropic HH-RLHF pairwise preferences for reward-model training

@@ -1,0 +1,93 @@
+"""Countdown task reward: reach a target with given numbers.
+
+Parity: /root/reference/examples/countdown/reward_score.py — extract the
+`<answer>equation</answer>` from the completion, require every provided
+number be used exactly once, evaluate, and score 1.0 on hitting the
+target, 0.1 for a well-formed-but-wrong equation (format score), 0
+otherwise.
+
+Implementation difference: the equation is evaluated by walking a
+restricted AST (+, -, *, / over integer literals) instead of the
+reference's regex-guarded `eval` — no code execution surface at all.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+FORMAT_SCORE = 0.1
+SCORE = 1.0
+
+_ANSWER_RE = re.compile(r"<answer>(.*?)</answer>", re.DOTALL)
+
+
+def extract_equation(completion: str) -> str | None:
+    matches = _ANSWER_RE.findall(completion)
+    return matches[-1].strip() if matches else None
+
+
+_ALLOWED_CHARS = re.compile(r"[\d+\-*/().\s]+")
+
+
+def _safe_eval(expr: str) -> float | None:
+    """Evaluate an arithmetic expression via a whitelisted AST walk.
+
+    The character whitelist runs FIRST (like the reference's regex guard):
+    python literal syntax is richer than countdown arithmetic — e.g. `3_4`
+    parses as the int 34 while its digits still pass the uses-each-number
+    check, a concatenation exploit an RL policy would find."""
+    if not _ALLOWED_CHARS.fullmatch(expr):
+        return None
+    try:
+        tree = ast.parse(expr, mode="eval")
+    except SyntaxError:
+        return None
+
+    def walk(node) -> float:
+        if isinstance(node, ast.Expression):
+            return walk(node.body)
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Add, ast.Sub, ast.Mult, ast.Div)
+        ):
+            a, b = walk(node.left), walk(node.right)
+            if isinstance(node.op, ast.Add):
+                return a + b
+            if isinstance(node.op, ast.Sub):
+                return a - b
+            if isinstance(node.op, ast.Mult):
+                return a * b
+            if b == 0:
+                raise ZeroDivisionError
+            return a / b
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+            return -walk(node.operand)
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return float(node.value)
+        raise ValueError(f"disallowed node {type(node).__name__}")
+
+    try:
+        return walk(tree)
+    except (ValueError, ZeroDivisionError, RecursionError):
+        return None
+
+
+def _uses_numbers_exactly(expr: str, numbers: list[int]) -> bool:
+    used = sorted(int(n) for n in re.findall(r"\d+", expr))
+    return used == sorted(int(n) for n in numbers)
+
+
+def countdown_reward(
+    prompt, completion, prompt_ids, completion_ids, *, target, numbers, **kw
+) -> float:
+    """1.0 for a valid equation hitting `target`, 0.1 for a present-but-
+    wrong equation, 0.0 otherwise."""
+    equation = extract_equation(completion or "")
+    if equation is None:
+        return 0.0
+    if not _uses_numbers_exactly(equation, list(numbers)):
+        return FORMAT_SCORE
+    value = _safe_eval(equation)
+    if value is None:
+        return FORMAT_SCORE
+    return SCORE if abs(value - float(target)) < 1e-5 else FORMAT_SCORE

@@ -59,6 +59,10 @@ def gsm8k_reward_fn(prompt, completion, prompt_ids, completion_ids, **data):
 
 def pick_reward_fn(dataset_path: str):
     name = dataset_path.split("/")[-1].lower()
+    if name == "countdown":
+        from areal_tpu.reward.countdown import countdown_reward
+
+        return countdown_reward
     if name == "clevr_count_70k":
         from areal_tpu.reward.vqa import clevr_count_reward
 

@@ -1,0 +1,81 @@
+"""Countdown task: reward semantics + offline dataset solvability
+(ref: /root/reference/examples/countdown/reward_score.py scoring rules)."""
+
+import numpy as np
+
+from areal_tpu.dataset import get_custom_dataset
+from areal_tpu.reward.countdown import (
+    FORMAT_SCORE,
+    SCORE,
+    _safe_eval,
+    countdown_reward,
+    extract_equation,
+)
+
+
+def _r(completion, target, numbers):
+    return countdown_reward(None, completion, [], [], target=target,
+                            numbers=numbers)
+
+
+def test_scoring_rules():
+    # correct equation, each number once
+    assert _r("thinking... <answer>(3 + 4) * 2</answer>", 14, [2, 3, 4]) == SCORE
+    # wrong value but well-formed -> format score
+    assert _r("<answer>(3 + 4) + 2</answer>", 14, [2, 3, 4]) == FORMAT_SCORE
+    # number reused -> format score (validation failure)
+    assert _r("<answer>(3 * 3) + 2</answer>", 11, [2, 3, 4]) == FORMAT_SCORE
+    # missing answer tag -> zero
+    assert _r("the answer is (3+4)*2", 14, [2, 3, 4]) == 0.0
+    # last tag wins
+    assert (
+        _r("<answer>1</answer> no wait <answer>(3 + 4) * 2</answer>",
+           14, [2, 3, 4])
+        == SCORE
+    )
+
+
+def test_safe_eval_rejects_non_arithmetic():
+    assert _safe_eval("__import__('os')") is None
+    assert _safe_eval("(lambda: 1)()") is None
+    assert _safe_eval("2 ** 10") is None  # pow not in the countdown op set
+    assert _safe_eval("1 / 0") is None
+    assert _safe_eval("(3 + 4) * 2") == 14.0
+    assert _safe_eval("-3 + 4") == 1.0
+
+
+def test_extract_equation():
+    assert extract_equation("x <answer> 1+1 </answer> y") == "1+1"
+    assert extract_equation("no tags") is None
+
+
+def test_offline_dataset_is_solvable_by_construction():
+    items = get_custom_dataset(path="countdown", split="train", n_items=64)
+    assert len(items) == 64
+    for x in items:
+        # the generator's own solution must score 1.0 under the reward
+        got = countdown_reward(
+            None,
+            f"<answer>{x['solution']}</answer>",
+            [],
+            [],
+            target=x["target"],
+            numbers=x["numbers"],
+        )
+        assert got == SCORE, x
+        assert str(x["target"]) in x["prompt"]
+    # train/test splits differ
+    test_items = get_custom_dataset(path="countdown", split="test", n_items=8)
+    assert test_items[0]["prompt"] != items[0]["prompt"]
+
+
+def test_dataset_deterministic():
+    a = get_custom_dataset(path="countdown", split="train", n_items=8)
+    b = get_custom_dataset(path="countdown", split="train", n_items=8)
+    assert [x["prompt"] for x in a] == [x["prompt"] for x in b]
+
+
+def test_reward_rejects_digit_concatenation_exploit():
+    # '3_4' is a python int literal (34) whose digits still pass the
+    # uses-each-number check — must score format-only, not 1.0
+    assert _r("<answer>3_4 * 1</answer>", 34, [3, 4, 1]) == FORMAT_SCORE
