@@ -438,10 +438,13 @@ class GRPOConfig(BaseExperimentConfig):
     # Which rollout workflow drives episodes: single-shot verifiable reward,
     # the self-correction loop (ref: examples/multi-turn-math/train.py), or
     # the VLM variant (ref: examples/vlm/clevr_count_70k_grpo.py).
-    workflow: str = "rlvr"  # "rlvr" | "multi_turn" | "vision_rlvr"
+    workflow: str = "rlvr"  # "rlvr" | "multi_turn" | "vision_rlvr" | "tir"
     # multi_turn knobs (ref: areal/workflow/multi_turn.py)
     max_turns: int = 3
     turn_discount: float = 0.9
+    # tir knobs (ref: examples/tir/tir_workflow.py)
+    max_tool_calls: int = 4
+    tool_timeout_seconds: float = 8.0
 
 
 @dataclass

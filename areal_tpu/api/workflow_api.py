@@ -25,3 +25,18 @@ class RolloutWorkflow(abc.ABC):
         executor decrements running without incrementing accepted.
         """
         raise NotImplementedError()
+
+
+def encode_prompt(tokenizer, data: dict, enable_thinking: bool = False) -> list:
+    """Shared prompt encoding for workflows: pre-tokenized input_ids win,
+    else chat-template messages, else raw prompt text."""
+    import numpy as np
+
+    if "input_ids" in data:
+        return list(np.asarray(data["input_ids"]).reshape(-1))
+    if "messages" in data:
+        kw = dict(add_generation_prompt=True, tokenize=True)
+        if enable_thinking:
+            kw["enable_thinking"] = True
+        return tokenizer.apply_chat_template(data["messages"], **kw)
+    return tokenizer.encode(data["prompt"])

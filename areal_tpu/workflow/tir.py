@@ -1,0 +1,237 @@
+"""Tool-integrated reasoning (TIR) workflow: generation ⇄ code execution.
+
+Parity: /root/reference/examples/tir/{tir_workflow,tool_manager}.py — the
+model reasons in text, opens a ```python fence when it wants to compute,
+the runtime executes the code in a sandbox and splices a ```output block
+back into the context, and generation resumes; the final answer is scored
+by the task's verifiable reward.
+
+TPU/decode-engine shape: rounds are driven by the engine's stop-string
+support (generation halts on the closing fence), executed code runs in a
+killed-on-timeout subprocess with rlimits (same isolation model as
+reward/_code_runner.py), and tool outputs enter the sequence as
+loss-masked context tokens — the policy is never trained to imitate tool
+output, exactly like the multi-turn workflow's feedback tokens.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import uuid
+from typing import Any, Callable
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.api.reward_api import AsyncRewardWrapper
+from areal_tpu.api.reward_api import reward_kwargs as _reward_kwargs
+from areal_tpu.api.workflow_api import RolloutWorkflow
+from areal_tpu.utils import logging
+from areal_tpu.utils.data import pad_sequences_to_tensors
+
+logger = logging.getLogger("tir")
+
+CODE_START = "```python\n"
+CODE_END = "```\n"
+OUTPUT_TEMPLATE = "```output\n{out}```\n"
+
+
+def _tool_rlimits(cpu_seconds: float, memory_mb: int = 1024):
+    """preexec_fn applying the same class of rlimits the reward sandbox
+    uses (reward/_code_runner.py): CPU, address space, process count."""
+    import resource
+
+    def apply():
+        os.setsid()  # own process group: the killer reaps grandchildren too
+        cpu = max(1, int(cpu_seconds) + 1)
+        resource.setrlimit(resource.RLIMIT_CPU, (cpu, cpu))
+        mem = memory_mb * 1024 * 1024
+        resource.setrlimit(resource.RLIMIT_AS, (mem, mem))
+        try:
+            resource.setrlimit(resource.RLIMIT_NPROC, (64, 64))
+        except (ValueError, OSError):
+            pass
+
+    return apply
+
+
+def run_python_tool(
+    code: str, timeout_seconds: float = 8.0, max_output_chars: int = 2000
+) -> str:
+    """Execute `code` in a fresh python subprocess under rlimits (CPU,
+    memory, nproc) in its own session; the whole process GROUP is killed on
+    timeout, so spawned grandchildren holding the output pipe cannot stall
+    the rollout loop past the deadline. Returns stdout+stderr, truncated."""
+    proc = None
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-E", "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            preexec_fn=_tool_rlimits(timeout_seconds),
+        )
+        out, _ = proc.communicate(timeout=timeout_seconds)
+    except subprocess.TimeoutExpired:
+        out = f"TimeoutError: code did not finish in {timeout_seconds}s\n"
+    except Exception as e:  # noqa: BLE001 — tool failure is model feedback
+        out = f"{type(e).__name__}: {e}\n"
+    finally:
+        if proc is not None and proc.poll() is None:
+            import signal
+
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+    if len(out) > max_output_chars:
+        out = out[:max_output_chars] + "...(truncated)\n"
+    if not out.endswith("\n"):
+        out += "\n"
+    return out
+
+
+def extract_last_code_block(text: str) -> str | None:
+    """The trailing ```python ...``` block if `text` ends at a closing
+    fence (the state the stop string leaves us in)."""
+    if not text.rstrip().endswith("```"):
+        return None
+    start = text.rfind(CODE_START)
+    if start < 0:
+        return None
+    body = text[start + len(CODE_START):]
+    end = body.rfind("```")
+    if end < 0:
+        return None
+    return body[:end]
+
+
+class TIRWorkflow(RolloutWorkflow):
+    def __init__(
+        self,
+        reward_fn: Callable[..., float],
+        gconfig: GenerationHyperparameters,
+        tokenizer: Any,
+        max_tool_calls: int = 4,
+        tool_timeout_seconds: float = 8.0,
+        reward_timeout_seconds: float = 15.0,
+        tool_fn: Callable[[str], str] | None = None,
+        dump_dir: str | None = None,
+    ):
+        self.reward_fn = AsyncRewardWrapper(
+            reward_fn, timeout_seconds=reward_timeout_seconds
+        )
+        self.gconfig = gconfig
+        self.tokenizer = tokenizer
+        self.max_tool_calls = max_tool_calls
+        self.tool_timeout_seconds = tool_timeout_seconds
+        self.dump_dir = dump_dir
+        self._tool = tool_fn or (
+            lambda code: run_python_tool(code, self.tool_timeout_seconds)
+        )
+
+    async def _one_sample(self, engine, data, prompt_ids):
+        import asyncio
+
+        seq = list(prompt_ids)
+        loss_mask = [0] * len(seq)
+        logprobs = [0.0] * len(seq)
+        versions = [-1] * len(seq)
+        # `remaining` budgets NEW tokens of all kinds — generated AND
+        # spliced tool output — so a request can never outgrow the decode
+        # engine's context_length through tool-output growth alone
+        remaining = self.gconfig.max_new_tokens
+        stops = list(self.gconfig.stop or []) + [CODE_END]
+
+        tool_calls = 0
+        while remaining > 0:
+            req = ModelRequest(
+                rid=str(uuid.uuid4()),
+                input_ids=list(seq),
+                gconfig=self.gconfig.new(
+                    n_samples=1, max_new_tokens=remaining, stop=stops
+                ),
+                tokenizer=self.tokenizer,
+            )
+            resp = await engine.agenerate(req)
+            seq += resp.output_tokens
+            loss_mask += [1] * resp.output_len
+            logprobs += resp.output_logprobs
+            versions += resp.output_versions
+            remaining -= resp.output_len
+            if remaining <= 0 or resp.stop_reason != "stop":
+                break
+            text = self.tokenizer.decode(resp.output_tokens)
+            code = extract_last_code_block(text)
+            if code is None:
+                break  # genuine stop (eos / task stop string)
+            if tool_calls >= self.max_tool_calls:
+                break  # budget spent: no further sandbox runs
+            tool_calls += 1
+            # off the event loop: a slow tool must not stall the other
+            # samples/rollouts sharing the loop
+            tool_out = await asyncio.to_thread(self._tool, code)
+            tool_ids = self.tokenizer.encode(
+                OUTPUT_TEMPLATE.format(out=tool_out)
+            )
+            tool_ids = tool_ids[: max(remaining - 1, 0)]
+            remaining -= len(tool_ids)
+            # tool output is CONTEXT, not behavior: never trained on
+            seq += tool_ids
+            loss_mask += [0] * len(tool_ids)
+            logprobs += [0.0] * len(tool_ids)
+            versions += [-1] * len(tool_ids)
+
+        completion_str = self.tokenizer.decode(seq[len(prompt_ids):])
+        reward = await self.reward_fn(
+            None,
+            completion_str,
+            prompt_ids,
+            seq[len(prompt_ids):],
+            **_reward_kwargs(data),
+        )
+        return dict(
+            input_ids=np.array(seq, dtype=np.int32),
+            loss_mask=np.array(loss_mask, dtype=np.int32),
+            logprobs=np.array(logprobs, dtype=np.float32),
+            versions=np.array(versions, dtype=np.int32),
+            rewards=np.float32(float(reward)),
+            begin_of_answer=np.int32(len(prompt_ids)),
+        )
+
+    async def arun_episode(self, engine, data: dict[str, Any]):
+        import asyncio
+
+        from areal_tpu.api.workflow_api import encode_prompt
+
+        prompt_ids = encode_prompt(self.tokenizer, data)
+        rows = await asyncio.gather(
+            *[
+                self._one_sample(engine, data, prompt_ids)
+                for _ in range(self.gconfig.n_samples)
+            ]
+        )
+        if self.dump_dir is not None:
+            import json
+
+            version = int(
+                max((int(np.asarray(r["versions"]).max()) for r in rows), default=0)
+            )
+            d = os.path.join(self.dump_dir, str(max(version, 0)))
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, f"{uuid.uuid4().hex}.jsonl"), "w") as f:
+                for r in rows:
+                    f.write(
+                        json.dumps(
+                            dict(
+                                text=self.tokenizer.decode(r["input_ids"]),
+                                reward=float(r["rewards"]),
+                            )
+                        )
+                        + "\n"
+                    )
+        return pad_sequences_to_tensors(list(rows))

@@ -182,10 +182,10 @@ def main(args):
     if getattr(tokenizer, "eos_token_id", None) is not None:
         if tokenizer.eos_token_id not in config.gconfig.stop_token_ids:
             config.gconfig.stop_token_ids.append(tokenizer.eos_token_id)
-    if config.workflow not in ("rlvr", "multi_turn", "vision_rlvr"):
+    if config.workflow not in ("rlvr", "multi_turn", "vision_rlvr", "tir"):
         raise ValueError(
             f"workflow={config.workflow!r} not in "
-            "('rlvr', 'multi_turn', 'vision_rlvr')"
+            "('rlvr', 'multi_turn', 'vision_rlvr', 'tir')"
         )
     processor = None
     if config.workflow == "vision_rlvr":
@@ -206,6 +206,19 @@ def main(args):
                 tokenizer=tokenizer,
                 max_turns=config.max_turns,
                 turn_discount=config.turn_discount,
+                dump_dir=dump_dir,
+            )
+        if config.workflow == "tir":
+            # tool-integrated reasoning: ```python blocks execute in a
+            # sandbox mid-generation (ref: examples/tir/tir_workflow.py)
+            from areal_tpu.workflow.tir import TIRWorkflow
+
+            return TIRWorkflow(
+                reward_fn=reward_fn,
+                gconfig=gconfig,
+                tokenizer=tokenizer,
+                max_tool_calls=config.max_tool_calls,
+                tool_timeout_seconds=config.tool_timeout_seconds,
                 dump_dir=dump_dir,
             )
         if config.workflow == "vision_rlvr":

@@ -1,0 +1,149 @@
+"""TIR workflow: fence parsing, sandboxed tool, and the generate ⇄ execute
+loop against a scripted engine (ref: examples/tir/tir_workflow.py)."""
+
+import asyncio
+
+import numpy as np
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.api.io_struct import ModelResponse
+from areal_tpu.workflow.tir import (
+    TIRWorkflow,
+    extract_last_code_block,
+    run_python_tool,
+)
+
+
+class _CharTok:
+    """Character tokenizer over raw codepoints (deterministic round-trip)."""
+
+    eos_token_id = 0
+    pad_token_id = 0
+
+    def encode(self, text, **kw):
+        return [ord(c) % 1000 + 1 for c in text]
+
+    def decode(self, ids, **kw):
+        return "".join(chr((i - 1) % 1000) for i in np.asarray(ids).reshape(-1))
+
+    def apply_chat_template(self, messages, **kw):
+        return self.encode("\n".join(m["content"] for m in messages))
+
+
+class _ScriptedEngine:
+    """Returns pre-scripted generations; records the prompts it saw."""
+
+    def __init__(self, tok, outputs):
+        self.tok = tok
+        self.outputs = list(outputs)
+        self.seen_prompts = []
+
+    async def agenerate(self, req):
+        self.seen_prompts.append(self.tok.decode(req.input_ids))
+        text, stop_reason = self.outputs.pop(0)
+        ids = self.tok.encode(text)
+        if len(ids) > req.gconfig.max_new_tokens:  # engines honor the cap
+            ids = ids[: req.gconfig.max_new_tokens]
+            stop_reason = "length"
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            output_tokens=ids,
+            output_logprobs=[-0.5] * len(ids),
+            output_versions=[0] * len(ids),
+            stop_reason=stop_reason,
+        )
+
+
+def test_extract_last_code_block():
+    assert extract_last_code_block("x ```python\nprint(1)\n```") == "print(1)\n"
+    assert extract_last_code_block("no fence") is None
+    assert extract_last_code_block("```python\nopen block") is None
+
+
+def test_run_python_tool_sandbox():
+    assert run_python_tool("print(6*7)") == "42\n"
+    out = run_python_tool("import time; time.sleep(60)", timeout_seconds=1.0)
+    assert "TimeoutError" in out
+    out = run_python_tool("print('x' * 10000)", max_output_chars=100)
+    assert out.endswith("...(truncated)\n")
+    assert "NameError" in run_python_tool("nope()")
+
+
+def test_tool_output_budgeted_against_max_new_tokens():
+    tok = _CharTok()
+    block = ("```python\nprint(1)\n```\n", "stop")
+    eng = _ScriptedEngine(tok, [block, ("done", "length")])
+    wf = TIRWorkflow(
+        reward_fn=lambda p, c, pi, ci, **kw: 0.0,
+        gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=40),
+        tokenizer=tok,
+        tool_fn=lambda code: "x" * 500,  # huge tool output
+    )
+    traj = asyncio.run(wf.arun_episode(eng, dict(prompt="q")))
+    total_new = int(np.asarray(traj["attention_mask"]).sum()) - len("q")
+    # generated + spliced tool tokens never exceed the new-token budget
+    assert total_new <= 40
+
+
+def test_tool_loop_executes_code_and_masks_output():
+    tok = _CharTok()
+    # round 1: model writes a code block and halts on the closing fence;
+    # round 2: model answers and hits eos
+    eng = _ScriptedEngine(
+        tok,
+        [
+            ("I'll compute. ```python\nprint(2+3)\n```\n", "stop"),
+            ("So the answer is 5.", "length"),
+        ],
+    )
+    wf = TIRWorkflow(
+        reward_fn=lambda p, c, pi, ci, **kw: 1.0 if "5" in c else 0.0,
+        gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=256),
+        tokenizer=tok,
+    )
+    traj = asyncio.run(
+        wf.arun_episode(eng, dict(prompt="what is 2+3?"))
+    )
+    assert float(np.asarray(traj["rewards"]).reshape(-1)[0]) == 1.0
+    # the second request's prompt must contain the REAL tool output
+    assert "```output\n5\n```" in eng.seen_prompts[1]
+    # tool-output tokens are loss-masked; generated tokens are not
+    ids = np.asarray(traj["input_ids"]).reshape(-1)
+    mask = np.asarray(traj["loss_mask"]).reshape(-1)
+    text = tok.decode(ids[: int(np.asarray(traj["attention_mask"]).sum())])
+    out_start = text.index("```output")
+    out_end = text.index("So the answer")
+    assert mask[out_start:out_end].sum() == 0
+    assert mask[out_end:].sum() > 0
+
+
+def test_no_code_block_means_single_round():
+    tok = _CharTok()
+    eng = _ScriptedEngine(tok, [("just an answer: 7", "stop")])
+    wf = TIRWorkflow(
+        reward_fn=lambda p, c, pi, ci, **kw: 0.0,
+        gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=64),
+        tokenizer=tok,
+    )
+    asyncio.run(
+        wf.arun_episode(eng, dict(prompt="q"))
+    )
+    assert len(eng.seen_prompts) == 1
+
+
+def test_tool_call_budget_bounds_rounds_and_executions():
+    tok = _CharTok()
+    block = ("```python\nprint(1)\n```\n", "stop")
+    eng = _ScriptedEngine(tok, [block] * 3 + [("done", "stop")])
+    executed = []
+    wf = TIRWorkflow(
+        reward_fn=lambda p, c, pi, ci, **kw: 0.0,
+        gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=2048),
+        tokenizer=tok,
+        max_tool_calls=2,
+        tool_fn=lambda code: executed.append(code) or "1\n",
+    )
+    asyncio.run(wf.arun_episode(eng, dict(prompt="q")))
+    # budget of 2 means exactly 2 sandbox executions and 3 generation rounds
+    assert len(executed) == 2
+    assert len(eng.seen_prompts) == 3
