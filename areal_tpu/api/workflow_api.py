@@ -27,16 +27,21 @@ class RolloutWorkflow(abc.ABC):
         raise NotImplementedError()
 
 
-def encode_prompt(tokenizer, data: dict, enable_thinking: bool = False) -> list:
+def encode_prompt(
+    tokenizer, data: dict, enable_thinking: bool | None = None
+) -> list:
     """Shared prompt encoding for workflows: pre-tokenized input_ids win,
-    else chat-template messages, else raw prompt text."""
+    else chat-template messages, else raw prompt text. `enable_thinking`
+    is forwarded to the chat template whenever set (False matters: Qwen3
+    templates default thinking ON); None omits the kwarg entirely."""
     import numpy as np
 
     if "input_ids" in data:
         return list(np.asarray(data["input_ids"]).reshape(-1))
+    assert tokenizer is not None, "need a tokenizer to encode messages/prompt"
     if "messages" in data:
         kw = dict(add_generation_prompt=True, tokenize=True)
-        if enable_thinking:
-            kw["enable_thinking"] = True
+        if enable_thinking is not None:
+            kw["enable_thinking"] = enable_thinking
         return tokenizer.apply_chat_template(data["messages"], **kw)
     return tokenizer.encode(data["prompt"])

@@ -51,13 +51,9 @@ class MultiTurnWorkflow(RolloutWorkflow):
         self.dump_dir = dump_dir
 
     def _encode_prompt(self, data: dict[str, Any]) -> list[int]:
-        if "input_ids" in data:
-            return list(np.asarray(data["input_ids"]).reshape(-1))
-        if "messages" in data:
-            return self.tokenizer.apply_chat_template(
-                data["messages"], add_generation_prompt=True, tokenize=True
-            )
-        return self.tokenizer.encode(data["prompt"])
+        from areal_tpu.api.workflow_api import encode_prompt
+
+        return encode_prompt(self.tokenizer, data)
 
     async def arun_episode(self, engine, data: dict[str, Any]):
         prompt_ids = self._encode_prompt(data)

@@ -47,17 +47,11 @@ class RLVRWorkflow(RolloutWorkflow):
         self.dump_dir = dump_dir
 
     def _encode_prompt(self, data: dict[str, Any]) -> list[int]:
-        if "input_ids" in data:
-            return list(np.asarray(data["input_ids"]).reshape(-1))
-        assert self.tokenizer is not None, "need tokenizer to encode messages"
-        if "messages" in data:
-            return self.tokenizer.apply_chat_template(
-                data["messages"],
-                add_generation_prompt=True,
-                tokenize=True,
-                enable_thinking=self.enable_thinking,
-            )
-        return self.tokenizer.encode(data["prompt"])
+        from areal_tpu.api.workflow_api import encode_prompt
+
+        return encode_prompt(
+            self.tokenizer, data, enable_thinking=self.enable_thinking
+        )
 
     def _build_request(
         self, data: dict[str, Any], prompt_ids: list[int]

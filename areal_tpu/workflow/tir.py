@@ -121,6 +121,7 @@ class TIRWorkflow(RolloutWorkflow):
         reward_timeout_seconds: float = 15.0,
         tool_fn: Callable[[str], str] | None = None,
         dump_dir: str | None = None,
+        enable_thinking: bool = False,
     ):
         self.reward_fn = AsyncRewardWrapper(
             reward_fn, timeout_seconds=reward_timeout_seconds
@@ -130,6 +131,7 @@ class TIRWorkflow(RolloutWorkflow):
         self.max_tool_calls = max_tool_calls
         self.tool_timeout_seconds = tool_timeout_seconds
         self.dump_dir = dump_dir
+        self.enable_thinking = enable_thinking
         self._tool = tool_fn or (
             lambda code: run_python_tool(code, self.tool_timeout_seconds)
         )
@@ -145,10 +147,17 @@ class TIRWorkflow(RolloutWorkflow):
         # spliced tool output — so a request can never outgrow the decode
         # engine's context_length through tool-output growth alone
         remaining = self.gconfig.max_new_tokens
-        stops = list(self.gconfig.stop or []) + [CODE_END]
+        task_stops = list(self.gconfig.stop or [])
 
+        # Two-phase fence state machine (reference tir_workflow.py:269-277):
+        # outside a code block, generation halts only on the OPENING
+        # ```python fence (a bare markdown fence in the answer is not a
+        # tool call and must not end the episode); inside one, it halts on
+        # the closing fence, which triggers execution.
+        in_code = False
         tool_calls = 0
         while remaining > 0:
+            stops = task_stops + ([CODE_END] if in_code else [CODE_START])
             req = ModelRequest(
                 rid=str(uuid.uuid4()),
                 input_ids=list(seq),
@@ -166,9 +175,17 @@ class TIRWorkflow(RolloutWorkflow):
             if remaining <= 0 or resp.stop_reason != "stop":
                 break
             text = self.tokenizer.decode(resp.output_tokens)
-            code = extract_last_code_block(text)
+            if not in_code:
+                if not text.endswith(CODE_START):
+                    break  # genuine stop (eos / task stop string)
+                in_code = True
+                continue
+            in_code = False
+            code = extract_last_code_block(
+                self.tokenizer.decode(seq[len(prompt_ids):])
+            )
             if code is None:
-                break  # genuine stop (eos / task stop string)
+                break  # closing fence without an opener: treat as done
             if tool_calls >= self.max_tool_calls:
                 break  # budget spent: no further sandbox runs
             tool_calls += 1
@@ -176,7 +193,8 @@ class TIRWorkflow(RolloutWorkflow):
             # samples/rollouts sharing the loop
             tool_out = await asyncio.to_thread(self._tool, code)
             tool_ids = self.tokenizer.encode(
-                OUTPUT_TEMPLATE.format(out=tool_out)
+                OUTPUT_TEMPLATE.format(out=tool_out),
+                add_special_tokens=False,  # no stray BOS mid-sequence
             )
             tool_ids = tool_ids[: max(remaining - 1, 0)]
             remaining -= len(tool_ids)
@@ -208,7 +226,9 @@ class TIRWorkflow(RolloutWorkflow):
 
         from areal_tpu.api.workflow_api import encode_prompt
 
-        prompt_ids = encode_prompt(self.tokenizer, data)
+        prompt_ids = encode_prompt(
+            self.tokenizer, data, enable_thinking=self.enable_thinking
+        )
         rows = await asyncio.gather(
             *[
                 self._one_sample(engine, data, prompt_ids)

@@ -71,8 +71,11 @@ def test_run_python_tool_sandbox():
 
 def test_tool_output_budgeted_against_max_new_tokens():
     tok = _CharTok()
-    block = ("```python\nprint(1)\n```\n", "stop")
-    eng = _ScriptedEngine(tok, [block, ("done", "length")])
+    eng = _ScriptedEngine(
+        tok,
+        [("x ```python\n", "stop"), ("print(1)\n```\n", "stop"),
+         ("done", "length")],
+    )
     wf = TIRWorkflow(
         reward_fn=lambda p, c, pi, ci, **kw: 0.0,
         gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=40),
@@ -92,7 +95,10 @@ def test_tool_loop_executes_code_and_masks_output():
     eng = _ScriptedEngine(
         tok,
         [
-            ("I'll compute. ```python\nprint(2+3)\n```\n", "stop"),
+            # phase A: halts on the OPENING fence
+            ("I'll compute. ```python\n", "stop"),
+            # phase B: code body, halts on the closing fence
+            ("print(2+3)\n```\n", "stop"),
             ("So the answer is 5.", "length"),
         ],
     )
@@ -105,8 +111,8 @@ def test_tool_loop_executes_code_and_masks_output():
         wf.arun_episode(eng, dict(prompt="what is 2+3?"))
     )
     assert float(np.asarray(traj["rewards"]).reshape(-1)[0]) == 1.0
-    # the second request's prompt must contain the REAL tool output
-    assert "```output\n5\n```" in eng.seen_prompts[1]
+    # the post-execution request's prompt must contain the REAL tool output
+    assert "```output\n5\n```" in eng.seen_prompts[2]
     # tool-output tokens are loss-masked; generated tokens are not
     ids = np.asarray(traj["input_ids"]).reshape(-1)
     mask = np.asarray(traj["loss_mask"]).reshape(-1)
@@ -133,8 +139,11 @@ def test_no_code_block_means_single_round():
 
 def test_tool_call_budget_bounds_rounds_and_executions():
     tok = _CharTok()
-    block = ("```python\nprint(1)\n```\n", "stop")
-    eng = _ScriptedEngine(tok, [block] * 3 + [("done", "stop")])
+    open_f = ("```python\n", "stop")
+    close_f = ("print(1)\n```\n", "stop")
+    eng = _ScriptedEngine(
+        tok, [open_f, close_f] * 3 + [("done", "stop")]
+    )
     executed = []
     wf = TIRWorkflow(
         reward_fn=lambda p, c, pi, ci, **kw: 0.0,
@@ -144,6 +153,26 @@ def test_tool_call_budget_bounds_rounds_and_executions():
         tool_fn=lambda code: executed.append(code) or "1\n",
     )
     asyncio.run(wf.arun_episode(eng, dict(prompt="q")))
-    # budget of 2 means exactly 2 sandbox executions and 3 generation rounds
+    # budget of 2 -> exactly 2 sandbox executions; the loop ends when the
+    # third block closes with no budget left
     assert len(executed) == 2
-    assert len(eng.seen_prompts) == 3
+    assert len(eng.seen_prompts) == 6
+
+
+def test_bare_markdown_fence_does_not_end_episode():
+    tok = _CharTok()
+    # a plain ``` fence in prose is NOT a tool call: phase A only stops on
+    # the ```python opener, so the answer generates through to its end
+    eng = _ScriptedEngine(
+        tok, [("table:\n```\n1 2 3\n```\nanswer is 6", "length")]
+    )
+    executed = []
+    wf = TIRWorkflow(
+        reward_fn=lambda p, c, pi, ci, **kw: 1.0 if "answer is 6" in c else 0.0,
+        gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=256),
+        tokenizer=tok,
+        tool_fn=lambda code: executed.append(code) or "x\n",
+    )
+    traj = asyncio.run(wf.arun_episode(eng, dict(prompt="q")))
+    assert not executed
+    assert float(np.asarray(traj["rewards"]).reshape(-1)[0]) == 1.0
