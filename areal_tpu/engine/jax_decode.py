@@ -1108,8 +1108,8 @@ class JaxDecodeEngine(InferenceEngine):
                 continue
             if s.ttft == float("inf"):
                 s.ttft = time.monotonic() - s.start_time
-            s.tokens.extend(int(t) for t in toks[:, i])
-            s.logprobs.extend(float(x) for x in logps[:, i])
+            s.tokens.extend(toks[:, i].tolist())
+            s.logprobs.extend(logps[:, i].tolist())
             s.versions.extend([version_at_chunk] * n_chunk)
             self._truncate_at_stop(s)
             if s.stop_reason is not None:

@@ -76,11 +76,20 @@ def enable_compilation_cache(path: str | None = None) -> None:
     Safe to call multiple times; AREAL_JAX_CACHE_DIR overrides the path."""
     import jax
 
+    # Key the default path by the requested platform: XLA:CPU AOT entries
+    # record the COMPILE machine's features, and loading them on a
+    # different host (or mixing relay-compiled TPU entries with local CPU
+    # ones) warns about possible SIGILL. Separate dirs sidestep it without
+    # initializing a backend here.
+    plat = (
+        os.environ.get("JAX_PLATFORMS", "default").replace(",", "_") or
+        "default"
+    )
     cache = (
         path
         or os.environ.get("AREAL_JAX_CACHE_DIR")
         or os.path.join(
-            os.environ.get("TMPDIR", "/tmp"), "areal_tpu_jax_cache"
+            os.environ.get("TMPDIR", "/tmp"), f"areal_tpu_jax_cache_{plat}"
         )
     )
     try:

@@ -95,21 +95,6 @@ def run_python_tool(
     return out
 
 
-def extract_last_code_block(text: str) -> str | None:
-    """The trailing ```python ...``` block if `text` ends at a closing
-    fence (the state the stop string leaves us in)."""
-    if not text.rstrip().endswith("```"):
-        return None
-    start = text.rfind(CODE_START)
-    if start < 0:
-        return None
-    body = text[start + len(CODE_START):]
-    end = body.rfind("```")
-    if end < 0:
-        return None
-    return body[:end]
-
-
 class TIRWorkflow(RolloutWorkflow):
     def __init__(
         self,
@@ -155,6 +140,7 @@ class TIRWorkflow(RolloutWorkflow):
         # tool call and must not end the episode); inside one, it halts on
         # the closing fence, which triggers execution.
         in_code = False
+        code_buf = ""  # code-body chars accumulated across phase-B rounds
         tool_calls = 0
         while remaining > 0:
             stops = task_stops + ([CODE_END] if in_code else [CODE_START])
@@ -174,18 +160,24 @@ class TIRWorkflow(RolloutWorkflow):
             remaining -= resp.output_len
             if remaining <= 0 or resp.stop_reason != "stop":
                 break
+            # NOTE the engine's stop-string cut lands on a TOKEN boundary:
+            # with BPE tokenizers the retained text can extend a few chars
+            # past the fence (e.g. "```python\nimport"), so match by
+            # position, never by exact endswith.
             text = self.tokenizer.decode(resp.output_tokens)
             if not in_code:
-                if not text.endswith(CODE_START):
+                idx = text.rfind(CODE_START)
+                if idx < 0:
                     break  # genuine stop (eos / task stop string)
                 in_code = True
+                code_buf = text[idx + len(CODE_START):]  # boundary overshoot
                 continue
             in_code = False
-            code = extract_last_code_block(
-                self.tokenizer.decode(seq[len(prompt_ids):])
-            )
-            if code is None:
-                break  # closing fence without an opener: treat as done
+            end = text.rfind("```")
+            if end < 0:
+                break  # a task stop matched inside the block: episode over
+            code = code_buf + text[:end]
+            code_buf = ""
             if tool_calls >= self.max_tool_calls:
                 break  # budget spent: no further sandbox runs
             tool_calls += 1

@@ -7,11 +7,7 @@ import numpy as np
 
 from areal_tpu.api.cli_args import GenerationHyperparameters
 from areal_tpu.api.io_struct import ModelResponse
-from areal_tpu.workflow.tir import (
-    TIRWorkflow,
-    extract_last_code_block,
-    run_python_tool,
-)
+from areal_tpu.workflow.tir import TIRWorkflow, run_python_tool
 
 
 class _CharTok:
@@ -52,12 +48,6 @@ class _ScriptedEngine:
             output_versions=[0] * len(ids),
             stop_reason=stop_reason,
         )
-
-
-def test_extract_last_code_block():
-    assert extract_last_code_block("x ```python\nprint(1)\n```") == "print(1)\n"
-    assert extract_last_code_block("no fence") is None
-    assert extract_last_code_block("```python\nopen block") is None
 
 
 def test_run_python_tool_sandbox():
@@ -176,3 +166,27 @@ def test_bare_markdown_fence_does_not_end_episode():
     traj = asyncio.run(wf.arun_episode(eng, dict(prompt="q")))
     assert not executed
     assert float(np.asarray(traj["rewards"]).reshape(-1)[0]) == 1.0
+
+
+def test_bpe_boundary_overshoot_joins_code_correctly():
+    """The engine's stop-string cut is token-aligned: retained text can run
+    a few chars past the fence. The state machine must stitch the code body
+    across the overshoot instead of aborting (review regression)."""
+    tok = _CharTok()
+    eng = _ScriptedEngine(
+        tok,
+        [
+            ("ok ```python\nimp", "stop"),            # overshoot into code
+            ("ort math\nprint(7)\n```\nSo", "stop"),  # overshoot past fence
+            (" the answer is 7", "length"),
+        ],
+    )
+    executed = []
+    wf = TIRWorkflow(
+        reward_fn=lambda p, c, pi, ci, **kw: 0.0,
+        gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=256),
+        tokenizer=tok,
+        tool_fn=lambda code: executed.append(code) or "7\n",
+    )
+    asyncio.run(wf.arun_episode(eng, dict(prompt="q")))
+    assert executed == ["import math\nprint(7)\n"]
