@@ -13,7 +13,6 @@ reference's regex-guarded `eval` — no code execution surface at all.
 
 from __future__ import annotations
 
-import ast
 import re
 
 FORMAT_SCORE = 0.1
@@ -27,49 +26,12 @@ def extract_equation(completion: str) -> str | None:
     return matches[-1].strip() if matches else None
 
 
-_ALLOWED_CHARS = re.compile(r"[\d+\-*/().\s]+")
-
-
 def _safe_eval(expr: str) -> float | None:
-    """Evaluate an arithmetic expression via a whitelisted AST walk.
+    """Integer-only arithmetic evaluation (utils/arith_eval.py): floats
+    and digit-grouping literals are scoring exploits here, not numbers."""
+    from areal_tpu.utils.arith_eval import safe_eval_arithmetic
 
-    The character whitelist runs FIRST (like the reference's regex guard):
-    python literal syntax is richer than countdown arithmetic — e.g. `3_4`
-    parses as the int 34 while its digits still pass the uses-each-number
-    check, a concatenation exploit an RL policy would find."""
-    if not _ALLOWED_CHARS.fullmatch(expr):
-        return None
-    try:
-        tree = ast.parse(expr, mode="eval")
-    except SyntaxError:
-        return None
-
-    def walk(node) -> float:
-        if isinstance(node, ast.Expression):
-            return walk(node.body)
-        if isinstance(node, ast.BinOp) and isinstance(
-            node.op, (ast.Add, ast.Sub, ast.Mult, ast.Div)
-        ):
-            a, b = walk(node.left), walk(node.right)
-            if isinstance(node.op, ast.Add):
-                return a + b
-            if isinstance(node.op, ast.Sub):
-                return a - b
-            if isinstance(node.op, ast.Mult):
-                return a * b
-            if b == 0:
-                raise ZeroDivisionError
-            return a / b
-        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
-            return -walk(node.operand)
-        if isinstance(node, ast.Constant) and isinstance(node.value, int):
-            return float(node.value)
-        raise ValueError(f"disallowed node {type(node).__name__}")
-
-    try:
-        return walk(tree)
-    except (ValueError, ZeroDivisionError, RecursionError):
-        return None
+    return safe_eval_arithmetic(expr, allow_float=False)
 
 
 def _uses_numbers_exactly(expr: str, numbers: list[int]) -> bool:

@@ -16,10 +16,12 @@ output, exactly like the multi-turn workflow's feedback tokens.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import subprocess
 import sys
 import uuid
+from dataclasses import dataclass
 from typing import Any, Callable
 
 import numpy as np
@@ -95,6 +97,93 @@ def run_python_tool(
     return out
 
 
+@dataclass
+class Tool:
+    """One invocable tool: the model opens `start_marker`, writes the
+    tool's input, closes with `end_marker`; `fn(input) -> output` runs in
+    the workflow and `output_template.format(out=...)` re-enters the
+    context (parity: examples/tir/tools/base.py ToolDescription)."""
+
+    name: str
+    start_marker: str
+    end_marker: str
+    fn: Callable[[str], str]
+    output_template: str = "<result>\n{out}</result>\n"
+
+
+def python_tool(timeout_seconds: float = 8.0) -> Tool:
+    return Tool(
+        name="python",
+        start_marker=CODE_START,
+        end_marker=CODE_END,
+        fn=lambda code: run_python_tool(code, timeout_seconds),
+        output_template=OUTPUT_TEMPLATE,
+    )
+
+
+def calculator_tool() -> Tool:
+    """<calculator>expr</calculator> — arithmetic via the restricted AST
+    evaluator (utils/arith_eval.py; no code execution at all; parity:
+    examples/tir/tools/calculator_tool.py)."""
+    from areal_tpu.utils.arith_eval import safe_eval_arithmetic
+
+    def calc(expr: str) -> str:
+        v = safe_eval_arithmetic(expr.strip())
+        if v is None:
+            return "error: invalid expression\n"
+        # integers render exactly — %g's 6 significant digits would feed
+        # the model rounded arithmetic
+        if float(v).is_integer() and abs(v) < 1e15:
+            return f"{int(v)}\n"
+        return f"{v!r}\n"
+
+    return Tool(
+        name="calculator",
+        start_marker="<calculator>",
+        end_marker="</calculator>",
+        fn=calc,
+    )
+
+
+def search_tool(corpus: list[str], top_k: int = 3) -> Tool:
+    """<search>query</search> over an in-memory corpus, scored by term
+    overlap weighted by inverse document frequency — the air-gapped
+    stand-in for the reference search-agent's retrieval service
+    (examples/search-agent/tongyi_deepresearch/tool_search.py)."""
+    import math
+    import re as _re
+
+    def terms(text: str) -> list[str]:
+        return _re.findall(r"[a-z0-9]+", text.lower())
+
+    doc_terms = [set(terms(d)) for d in corpus]
+    n = max(len(corpus), 1)
+    df: dict[str, int] = {}
+    for ts in doc_terms:
+        for t in ts:
+            df[t] = df.get(t, 0) + 1
+
+    def search(query: str) -> str:
+        q = set(terms(query))
+        scored = []
+        for i, ts in enumerate(doc_terms):
+            score = sum(
+                math.log(1 + n / df[t]) for t in q & ts
+            )
+            if score > 0:
+                scored.append((score, i))
+        scored.sort(reverse=True)
+        if not scored:
+            return "no results\n"
+        return "".join(
+            f"[{rank + 1}] {corpus[i][:400]}\n"
+            for rank, (_, i) in enumerate(scored[:top_k])
+        )
+
+    return Tool(name="search", start_marker="<search>",
+                end_marker="</search>", fn=search)
+
+
 class TIRWorkflow(RolloutWorkflow):
     def __init__(
         self,
@@ -107,6 +196,7 @@ class TIRWorkflow(RolloutWorkflow):
         tool_fn: Callable[[str], str] | None = None,
         dump_dir: str | None = None,
         enable_thinking: bool = False,
+        tools: list[Tool] | None = None,
     ):
         self.reward_fn = AsyncRewardWrapper(
             reward_fn, timeout_seconds=reward_timeout_seconds
@@ -117,9 +207,17 @@ class TIRWorkflow(RolloutWorkflow):
         self.tool_timeout_seconds = tool_timeout_seconds
         self.dump_dir = dump_dir
         self.enable_thinking = enable_thinking
-        self._tool = tool_fn or (
-            lambda code: run_python_tool(code, self.tool_timeout_seconds)
-        )
+        if tools is None:
+            tools = [python_tool(tool_timeout_seconds)]
+        if tool_fn is not None:
+            # back-compat/test seam: override the python tool's executor
+            tools = [
+                dataclasses.replace(t, fn=tool_fn)
+                if t.name == "python"
+                else t
+                for t in tools
+            ]
+        self.tools = tools
 
     async def _one_sample(self, engine, data, prompt_ids):
         import asyncio
@@ -134,16 +232,20 @@ class TIRWorkflow(RolloutWorkflow):
         remaining = self.gconfig.max_new_tokens
         task_stops = list(self.gconfig.stop or [])
 
-        # Two-phase fence state machine (reference tir_workflow.py:269-277):
-        # outside a code block, generation halts only on the OPENING
-        # ```python fence (a bare markdown fence in the answer is not a
-        # tool call and must not end the episode); inside one, it halts on
-        # the closing fence, which triggers execution.
-        in_code = False
-        code_buf = ""  # code-body chars accumulated across phase-B rounds
+        # Two-phase marker state machine (reference tir_workflow.py:
+        # 269-277): outside a tool block, generation halts only on a
+        # tool's OPENING marker (a bare markdown fence in the answer is
+        # not a tool call and must not end the episode); inside one, it
+        # halts on THAT tool's closing marker, which triggers execution.
+        active: Tool | None = None
+        code_buf = ""  # tool-input chars accumulated across phase-B rounds
         tool_calls = 0
         while remaining > 0:
-            stops = task_stops + ([CODE_END] if in_code else [CODE_START])
+            stops = task_stops + (
+                [active.end_marker]
+                if active is not None
+                else [t.start_marker for t in self.tools]
+            )
             req = ModelRequest(
                 rid=str(uuid.uuid4()),
                 input_ids=list(seq),
@@ -165,16 +267,27 @@ class TIRWorkflow(RolloutWorkflow):
             # past the fence (e.g. "```python\nimport"), so match by
             # position, never by exact endswith.
             text = self.tokenizer.decode(resp.output_tokens)
-            if not in_code:
-                idx = text.rfind(CODE_START)
-                if idx < 0:
+            if active is None:
+                best = max(
+                    ((text.rfind(t.start_marker), t) for t in self.tools),
+                    key=lambda x: x[0],
+                )
+                if best[0] < 0:
                     break  # genuine stop (eos / task stop string)
-                in_code = True
-                code_buf = text[idx + len(CODE_START):]  # boundary overshoot
+                active = best[1]
+                # boundary overshoot chars already belong to the input
+                code_buf = text[best[0] + len(active.start_marker):]
                 continue
-            in_code = False
-            end = text.rfind("```")
-            if end < 0:
+            tool = active
+            active = None
+            needle = tool.end_marker.strip()
+            end = text.rfind(needle)
+            # The close must sit at the END of the round's text (modulo one
+            # token of stop-cut overshoot) — a marker-lookalike earlier in
+            # the tool input (e.g. a bare \`\`\` inside a string literal)
+            # followed by a TASK stop must end the episode, not execute
+            # truncated input.
+            if end < 0 or len(text) - (end + len(needle)) > 24:
                 break  # a task stop matched inside the block: episode over
             code = code_buf + text[:end]
             code_buf = ""
@@ -183,9 +296,9 @@ class TIRWorkflow(RolloutWorkflow):
             tool_calls += 1
             # off the event loop: a slow tool must not stall the other
             # samples/rollouts sharing the loop
-            tool_out = await asyncio.to_thread(self._tool, code)
+            tool_out = await asyncio.to_thread(tool.fn, code)
             tool_ids = self.tokenizer.encode(
-                OUTPUT_TEMPLATE.format(out=tool_out),
+                tool.output_template.format(out=tool_out),
                 add_special_tokens=False,  # no stray BOS mid-sequence
             )
             tool_ids = tool_ids[: max(remaining - 1, 0)]

@@ -190,3 +190,94 @@ def test_bpe_boundary_overshoot_joins_code_correctly():
     )
     asyncio.run(wf.arun_episode(eng, dict(prompt="q")))
     assert executed == ["import math\nprint(7)\n"]
+
+
+def test_calculator_and_search_tools_dispatch():
+    """Multi-tool registry: each opening marker routes to its own tool
+    (ref: examples/tir/tool_manager.py + search-agent's retrieval)."""
+    from areal_tpu.workflow.tir import calculator_tool, search_tool
+
+    tok = _CharTok()
+    corpus = [
+        "The Eiffel Tower is in Paris France",
+        "Mount Everest is the tallest mountain on Earth",
+        "Paris is the capital of France",
+    ]
+    eng = _ScriptedEngine(
+        tok,
+        [
+            ("let me compute <calculator>", "stop"),
+            ("(3 + 4) * 2</calculator>", "stop"),
+            ("now look up <search>", "stop"),
+            ("eiffel tower paris</search>", "stop"),
+            ("answer: 14, Paris", "length"),
+        ],
+    )
+    wf = TIRWorkflow(
+        reward_fn=lambda p, c, pi, ci, **kw: 0.0,
+        gconfig=GenerationHyperparameters(n_samples=1, max_new_tokens=512),
+        tokenizer=tok,
+        tools=[calculator_tool(), search_tool(corpus, top_k=2)],
+    )
+    asyncio.run(wf.arun_episode(eng, dict(prompt="q")))
+    # calculator result spliced into request 3's prompt
+    assert "14" in eng.seen_prompts[2]
+    # search results spliced into request 5's prompt, best match first
+    assert "Eiffel Tower" in eng.seen_prompts[4]
+
+
+def test_search_tool_ranking_and_misses():
+    from areal_tpu.workflow.tir import search_tool
+
+    t = search_tool(
+        ["alpha beta gamma", "alpha only here", "unrelated text"], top_k=2
+    )
+    out = t.fn("alpha beta")
+    assert out.startswith("[1] alpha beta gamma")
+    assert "[2] alpha only here" in out
+    assert "unrelated" not in out
+    assert t.fn("zzz qqq") == "no results\n"
+
+
+def test_calculator_tool_safe():
+    from areal_tpu.workflow.tir import calculator_tool
+
+    t = calculator_tool()
+    assert t.fn(" (3 + 4) * 2 ") == "14\n"
+    assert "error" in t.fn("__import__('os')")
+
+
+def test_task_stop_inside_tool_block_ends_episode():
+    """A marker-lookalike inside the tool input followed by a TASK stop
+    must end the episode rather than execute truncated input (review
+    regression: phase-B proximity guard)."""
+    tok = _CharTok()
+    eng = _ScriptedEngine(
+        tok,
+        [
+            ("```python\n", "stop"),
+            # bare ``` inside a string literal, then the task stop fires
+            # far past it
+            ('s = "``` fake" ' + "x" * 60 + "</answer>", "stop"),
+        ],
+    )
+    executed = []
+    wf = TIRWorkflow(
+        reward_fn=lambda p, c, pi, ci, **kw: 0.0,
+        gconfig=GenerationHyperparameters(
+            n_samples=1, max_new_tokens=512, stop=["</answer>"]
+        ),
+        tokenizer=tok,
+        tool_fn=lambda code: executed.append(code) or "never\n",
+    )
+    asyncio.run(wf.arun_episode(eng, dict(prompt="q")))
+    assert not executed
+    assert len(eng.seen_prompts) == 2
+
+
+def test_calculator_exact_large_integers():
+    from areal_tpu.workflow.tir import calculator_tool
+
+    t = calculator_tool()
+    assert t.fn("1234567*2") == "2469134\n"
+    assert t.fn("3.5*2") == "7\n"  # integral float renders exactly
