@@ -340,11 +340,22 @@ async def _serve(args: argparse.Namespace) -> None:
         )
         server.engine.set_model(init_params(mc, _jax.random.PRNGKey(args.seed)), mc)
     await server.start(args.host, args.port)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
     if args.experiment_name and args.trial_name:
         server.register(
             args.experiment_name, args.trial_name, args.server_id or server.addr
         )
-    stop = asyncio.Event()
+        # Self-terminate when the trainer broadcasts a terminal status —
+        # servers must not linger after the experiment ends (reference:
+        # ExpStatus watch, realhf master_worker.py:485-495).
+        from areal_tpu.utils.experiment import watch_until_terminal
+
+        watch_until_terminal(
+            args.experiment_name,
+            args.trial_name,
+            lambda status: loop.call_soon_threadsafe(stop.set),
+        )
     try:
         await stop.wait()
     finally:

@@ -14,7 +14,9 @@ import re
 _ALLOWED_CHARS = re.compile(r"[\d+\-*/().\s]+")
 
 
-def safe_eval_arithmetic(expr: str, allow_float: bool = True) -> float | None:
+def safe_eval_arithmetic(
+    expr: str, allow_float: bool = True
+) -> int | float | None:
     """Evaluate `expr`; None on any syntax/operator/value violation.
 
     The character whitelist runs FIRST: python literal syntax is richer
@@ -27,7 +29,9 @@ def safe_eval_arithmetic(expr: str, allow_float: bool = True) -> float | None:
     except SyntaxError:
         return None
 
-    def walk(node) -> float:
+    # ints stay ints through +,-,* (beyond-2^53 arithmetic must be exact
+    # for the calculator tool); only division coerces to float
+    def walk(node):
         if isinstance(node, ast.Expression):
             return walk(node.body)
         if isinstance(node, ast.BinOp) and isinstance(
@@ -46,14 +50,15 @@ def safe_eval_arithmetic(expr: str, allow_float: bool = True) -> float | None:
         if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
             return -walk(node.operand)
         if isinstance(node, ast.Constant):
-            ok = isinstance(node.value, int) or (
-                allow_float and isinstance(node.value, float)
-            )
-            if ok:
-                return float(node.value)
+            if isinstance(node.value, int) and not isinstance(
+                node.value, bool
+            ):
+                return node.value
+            if allow_float and isinstance(node.value, float):
+                return node.value
         raise ValueError(f"disallowed node {type(node).__name__}")
 
     try:
         return walk(tree)
-    except (ValueError, ZeroDivisionError, RecursionError):
+    except (ValueError, ZeroDivisionError, RecursionError, OverflowError):
         return None

@@ -1,0 +1,122 @@
+"""Experiment status broadcast over name_resolve.
+
+Parity: the reference's `ExpStatus` key (realhf/system/master_worker.py:
+485-495) — the trainer publishes RUNNING while the loop is alive and a
+terminal status on exit, and rollout-side processes (decode servers)
+watch the key to self-terminate instead of lingering after the trainer
+is gone.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+
+from areal_tpu.utils import logging, name_resolve, names
+
+logger = logging.getLogger("experiment")
+
+
+class ExpStatus(str, enum.Enum):
+    RUNNING = "RUNNING"
+    COMPLETE = "COMPLETE"
+    ABORTED = "ABORTED"
+
+
+def publish_status(
+    experiment_name: str, trial_name: str, status: ExpStatus | str
+) -> None:
+    # delete_on_exit=False: a TERMINAL status must outlive the trainer
+    # process — watchers read it precisely after the publisher is gone
+    name_resolve.add(
+        names.experiment_status(experiment_name, trial_name),
+        str(getattr(status, "value", status)),
+        replace=True,
+        delete_on_exit=False,
+    )
+
+
+def get_status(experiment_name: str, trial_name: str) -> ExpStatus | None:
+    try:
+        raw = name_resolve.get(
+            names.experiment_status(experiment_name, trial_name)
+        )
+    except Exception:  # noqa: BLE001 — absent key/backend: unknown status
+        return None
+    try:
+        return ExpStatus(raw)
+    except ValueError:
+        return None
+
+
+def watch_until_terminal(
+    experiment_name: str,
+    trial_name: str,
+    on_terminal,
+    poll_interval: float = 5.0,
+    stop_event: threading.Event | None = None,
+) -> threading.Thread:
+    """Background thread: poll the status key; invoke `on_terminal(status)`
+    once when it becomes COMPLETE/ABORTED (then exit).
+
+    A missing key is NOT terminal — the trainer may simply not have
+    started. And because terminal records deliberately persist across
+    runs, a terminal status only counts AFTER this watcher has seen the
+    current run's RUNNING: a relaunched fleet must not read the previous
+    run's COMPLETE and kill itself at boot."""
+    stop_event = stop_event or threading.Event()
+
+    def loop():
+        seen_running = False
+        while not stop_event.wait(poll_interval):
+            status = get_status(experiment_name, trial_name)
+            if status == ExpStatus.RUNNING:
+                seen_running = True
+            elif (
+                seen_running
+                and status in (ExpStatus.COMPLETE, ExpStatus.ABORTED)
+            ):
+                logger.info(
+                    f"experiment status {status.value}; notifying watcher"
+                )
+                try:
+                    on_terminal(status)
+                finally:
+                    return
+
+    t = threading.Thread(target=loop, daemon=True, name="exp-status-watch")
+    t.stop_event = stop_event  # type: ignore[attr-defined]
+    t.start()
+    return t
+
+
+def run_with_status(main_fn, argv) -> None:
+    """Example entry-point wrapper: publish RUNNING before `main_fn(argv)`
+    and COMPLETE/ABORTED after, on the name_resolve backend the config
+    (+ CLI overrides) selects — decode servers watch this key to
+    self-terminate with the experiment."""
+    from areal_tpu.api.cli_args import NameResolveConfig, parse_cli_args
+
+    cfg_dict, kv = parse_cli_args(argv)
+    over = dict(kv)
+    expr = (
+        over.get("experiment_name") or cfg_dict.get("experiment_name", ""),
+        over.get("trial_name") or cfg_dict.get("trial_name", ""),
+    )
+    if all(expr):
+        nr = dict((cfg_dict.get("cluster") or {}).get("name_resolve") or {})
+        for k, v in kv:
+            if k.startswith("cluster.name_resolve."):
+                nr[k.rsplit(".", 1)[1]] = v
+        name_resolve.reconfigure(NameResolveConfig(**nr))
+    try:
+        if all(expr):
+            publish_status(*expr, ExpStatus.RUNNING)
+        main_fn(argv)
+    except BaseException:
+        if all(expr):
+            publish_status(*expr, ExpStatus.ABORTED)
+        raise
+    else:
+        if all(expr):
+            publish_status(*expr, ExpStatus.COMPLETE)

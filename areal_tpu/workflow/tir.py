@@ -131,9 +131,11 @@ def calculator_tool() -> Tool:
         v = safe_eval_arithmetic(expr.strip())
         if v is None:
             return "error: invalid expression\n"
-        # integers render exactly — %g's 6 significant digits would feed
-        # the model rounded arithmetic
-        if float(v).is_integer() and abs(v) < 1e15:
+        # the evaluator keeps ints exact (arbitrary precision); floats
+        # render via repr so nothing is silently rounded
+        if isinstance(v, int) or (
+            isinstance(v, float) and v.is_integer() and abs(v) < 1e15
+        ):
             return f"{int(v)}\n"
         return f"{v!r}\n"
 

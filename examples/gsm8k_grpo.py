@@ -350,4 +350,6 @@ def main(args):
 
 
 if __name__ == "__main__":
-    main(sys.argv[1:])
+    from areal_tpu.utils.experiment import run_with_status
+
+    run_with_status(main, sys.argv[1:])

@@ -1,0 +1,67 @@
+"""Experiment status broadcast + decode-server self-termination watch
+(ref: realhf/system/master_worker.py:485-495 ExpStatus)."""
+
+import threading
+import time
+
+from areal_tpu.api.cli_args import NameResolveConfig
+from areal_tpu.utils import name_resolve
+from areal_tpu.utils.experiment import (
+    ExpStatus,
+    get_status,
+    publish_status,
+    watch_until_terminal,
+)
+
+
+def setup_function(_fn):
+    name_resolve.reconfigure(NameResolveConfig(type="memory"))
+
+
+def test_publish_get_round_trip():
+    assert get_status("e", "t") is None
+    publish_status("e", "t", ExpStatus.RUNNING)
+    assert get_status("e", "t") == ExpStatus.RUNNING
+    publish_status("e", "t", "COMPLETE")
+    assert get_status("e", "t") == ExpStatus.COMPLETE
+
+
+def test_watcher_fires_once_on_terminal_status():
+    fired = []
+    t = watch_until_terminal(
+        "e2", "t2", lambda s: fired.append(s), poll_interval=0.05
+    )
+    publish_status("e2", "t2", ExpStatus.RUNNING)
+    time.sleep(0.2)
+    assert fired == []  # RUNNING is not terminal
+    publish_status("e2", "t2", ExpStatus.ABORTED)
+    t.join(timeout=5)
+    assert fired == [ExpStatus.ABORTED]
+    assert not t.is_alive()
+
+
+def test_watcher_stop_event():
+    ev = threading.Event()
+    t = watch_until_terminal(
+        "e3", "t3", lambda s: None, poll_interval=0.05, stop_event=ev
+    )
+    ev.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_stale_terminal_ignored_until_running_seen():
+    """A relaunched fleet must not die on the PREVIOUS run's persistent
+    terminal status (review regression)."""
+    publish_status("e4", "t4", ExpStatus.COMPLETE)  # stale, previous run
+    fired = []
+    t = watch_until_terminal(
+        "e4", "t4", lambda s: fired.append(s), poll_interval=0.05
+    )
+    time.sleep(0.25)
+    assert fired == []  # stale COMPLETE ignored
+    publish_status("e4", "t4", ExpStatus.RUNNING)
+    time.sleep(0.2)
+    publish_status("e4", "t4", ExpStatus.COMPLETE)
+    t.join(timeout=5)
+    assert fired == [ExpStatus.COMPLETE]

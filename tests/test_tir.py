@@ -281,3 +281,5 @@ def test_calculator_exact_large_integers():
     t = calculator_tool()
     assert t.fn("1234567*2") == "2469134\n"
     assert t.fn("3.5*2") == "7\n"  # integral float renders exactly
+    # beyond-2^53 integer arithmetic stays exact (int-preserving walk)
+    assert t.fn("123456789123456789+1") == "123456789123456790\n"
