@@ -1,0 +1,102 @@
+"""Generate docs/CONFIG.md from the cli_args dataclass tree.
+
+The reference documents its ~35 config dataclasses through cli_args.py
+metadata; here the dataclass tree IS the schema, so the reference doc is
+generated from it: every experiment config class, every field with type
+and default, nested dataclasses linked. Run after config changes:
+
+    python tools/gen_config_doc.py
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import typing
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from areal_tpu.api import cli_args  # noqa: E402
+
+ROOTS = [
+    "GRPOConfig",
+    "PPOConfig",
+    "SFTConfig",
+    "RWConfig",
+]
+
+
+def _default_repr(f: dataclasses.Field):
+    if f.default is not dataclasses.MISSING:
+        return repr(f.default)
+    if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+        try:
+            return repr(f.default_factory())
+        except Exception:  # noqa: BLE001
+            return f"{getattr(f.default_factory, '__name__', '…')}()"
+    return "—"
+
+
+def _type_name(tp) -> str:
+    return (
+        typing.get_type_hints.__doc__
+        and str(tp).replace("typing.", "").replace("areal_tpu.api.cli_args.", "")
+        .replace("<class '", "").replace("'>", "")
+    )
+
+
+def _collect(cls, seen: dict):
+    if cls.__name__ in seen or not dataclasses.is_dataclass(cls):
+        return
+    seen[cls.__name__] = cls
+    for f in dataclasses.fields(cls):
+        tp = f.type if not isinstance(f.type, str) else getattr(
+            cli_args, f.type, None
+        )
+        # resolve string annotations of nested dataclasses
+        name = str(f.type)
+        for cand in dir(cli_args):
+            obj = getattr(cli_args, cand)
+            if dataclasses.is_dataclass(obj) and cand in name:
+                _collect(obj, seen)
+
+
+def main() -> None:
+    seen: dict = {}
+    for root in ROOTS:
+        _collect(getattr(cli_args, root), seen)
+    lines = [
+        "# Configuration reference",
+        "",
+        "Generated from `areal_tpu/api/cli_args.py` by "
+        "`tools/gen_config_doc.py` — do not edit by hand.",
+        "",
+        "Every experiment script takes `--config file.yaml key=value ...`;",
+        "keys follow the nesting below (e.g. `actor.optimizer.lr=1e-6`).",
+        "",
+    ]
+    for name, cls in sorted(seen.items()):
+        doc = (cls.__doc__ or "").strip().split("\n")[0]
+        lines += [f"## {name}", ""]
+        if doc and not doc.startswith(name + "("):
+            lines += [doc, ""]
+        lines += ["| field | type | default |", "|---|---|---|"]
+        for f in dataclasses.fields(cls):
+            lines.append(
+                f"| `{f.name}` | `{_type_name(f.type)}` |"
+                f" `{_default_repr(f)}` |"
+            )
+        lines.append("")
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs",
+        "CONFIG.md",
+    )
+    with open(out, "w") as fh:
+        fh.write("\n".join(lines))
+    print(f"wrote {out}: {len(seen)} config classes")
+
+
+if __name__ == "__main__":
+    main()
