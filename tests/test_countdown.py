@@ -1,8 +1,6 @@
 """Countdown task: reward semantics + offline dataset solvability
 (ref: /root/reference/examples/countdown/reward_score.py scoring rules)."""
 
-import numpy as np
-
 from areal_tpu.dataset import get_custom_dataset
 from areal_tpu.reward.countdown import (
     FORMAT_SCORE,
