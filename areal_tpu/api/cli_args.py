@@ -475,16 +475,25 @@ def parse_cli_args(argv: list[str]):
     return cfg_dict, kv
 
 
-def load_expr_config(argv: list[str], config_cls):
+def load_expr_config(argv: list[str], config_cls, ignore_unknown: bool = False):
     """Load a structured experiment config from CLI argv.
 
     Returns (config, config_file_dict) like the reference's
-    `load_expr_config` (cli_args.py:1280).
+    `load_expr_config` (cli_args.py:1280). `ignore_unknown` lets a
+    subset-view consumer (the launcher) parse a subclass's YAML.
     """
     cfg_dict, overrides = parse_cli_args(argv)
-    config = structured.from_dict(config_cls, cfg_dict)
+    config = structured.from_dict(
+        config_cls, cfg_dict, ignore_unknown=ignore_unknown
+    )
     for k, v in overrides:
-        structured.apply_override(config, k, v)
+        try:
+            structured.apply_override(config, k, v)
+        except structured.UnknownFieldError:
+            # subset view: subclass-only fields are fine to skip; bad
+            # VALUES for known fields still raise below
+            if not ignore_unknown:
+                raise
     # propagate experiment/trial names into nested configs that need them
     for attr in ("saver", "evaluator", "stats_logger", "recover"):
         sub = getattr(config, attr, None)

@@ -321,7 +321,11 @@ def main(argv: list[str] | None = None) -> None:
         "usage: python -m areal_tpu.launcher.local entry.py --config cfg.yaml"
     )
     entry = argv[0]
-    config, _ = load_expr_config(argv[1:], BaseExperimentConfig)
+    # subset view: the launcher only consumes cluster/allocation/launcher
+    # fields; the trainer subprocess re-parses the full subclass config
+    config, _ = load_expr_config(
+        argv[1:], BaseExperimentConfig, ignore_unknown=True
+    )
     max_restarts = (
         config.recover.retries
         if config.recover.mode in ("auto", "fault")

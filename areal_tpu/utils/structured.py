@@ -45,8 +45,13 @@ def to_dict(obj: Any) -> Any:
     return obj
 
 
-def from_dict(cls, data: dict | None):
-    """Build dataclass `cls` from a nested dict, validating field names."""
+def from_dict(cls, data: dict | None, ignore_unknown: bool = False):
+    """Build dataclass `cls` from a nested dict, validating field names.
+
+    `ignore_unknown=True` drops unrecognized keys instead of raising —
+    for consumers that read a SUBSET view of a richer config (the local
+    launcher parses experiment YAMLs as BaseExperimentConfig while the
+    trainer subprocess parses the full subclass)."""
     if data is None:
         return cls()
     if not is_dataclass_type(cls):
@@ -55,11 +60,13 @@ def from_dict(cls, data: dict | None):
     kwargs = {}
     for key, value in data.items():
         if key not in field_map:
+            if ignore_unknown:
+                continue
             raise ValueError(f"unknown config field {cls.__name__}.{key}")
         f = field_map[key]
         tp, _ = _unwrap_optional(f.type if not isinstance(f.type, str) else _resolve(cls, f.name))
         if is_dataclass_type(tp) and isinstance(value, dict):
-            kwargs[key] = from_dict(tp, value)
+            kwargs[key] = from_dict(tp, value, ignore_unknown=ignore_unknown)
         else:
             kwargs[key] = value
     return cls(**kwargs)
@@ -71,11 +78,19 @@ def _resolve(cls, field_name: str):
     return hints[field_name]
 
 
+class UnknownFieldError(ValueError):
+    """An override names a field the target config class does not have —
+    the ONLY override failure a subset-view consumer may ignore (bad
+    VALUES for known fields must still fail loudly)."""
+
+
 def apply_override(obj: Any, dotted_key: str, raw_value: str) -> None:
     """Apply one `a.b.c=value` override in place, coercing to the field type."""
     parts = dotted_key.split(".")
     target = obj
     for part in parts[:-1]:
+        if not hasattr(target, part):
+            raise UnknownFieldError(f"unknown config field {dotted_key!r}")
         nxt = getattr(target, part)
         if nxt is None:
             # Instantiate Optional nested configs on demand.
@@ -89,7 +104,7 @@ def apply_override(obj: Any, dotted_key: str, raw_value: str) -> None:
         target = nxt
     leaf = parts[-1]
     if not hasattr(target, leaf):
-        raise ValueError(f"unknown config field {dotted_key!r}")
+        raise UnknownFieldError(f"unknown config field {dotted_key!r}")
     hints = typing.get_type_hints(type(target))
     tp, optional = _unwrap_optional(hints[leaf])
     setattr(target, leaf, coerce(raw_value, tp, optional))

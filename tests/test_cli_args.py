@@ -93,3 +93,38 @@ def test_save_config_roundtrip(tmp_path):
     path = save_config(cfg, str(tmp_path))
     loaded = yaml.safe_load(open(path))
     assert loaded["actor"]["group_size"] == 16
+
+
+def test_subset_view_parsing_ignores_subclass_fields(tmp_path):
+    """The launcher parses subclass YAMLs as BaseExperimentConfig with
+    ignore_unknown=True: subclass keys (nested included) are dropped, but
+    bad VALUES for known fields still fail loudly."""
+    import pytest
+
+    from areal_tpu.api.cli_args import BaseExperimentConfig, load_expr_config
+
+    cfg_file = tmp_path / "c.yaml"
+    cfg_file.write_text(
+        "experiment_name: e\n"
+        "trial_name: t\n"
+        "async_training: true\n"          # GRPOConfig-only
+        "actor:\n  group_size: 4\n"        # GRPOConfig-only subtree
+        "cluster:\n  n_nodes: 3\n"
+    )
+    config, _ = load_expr_config(
+        ["--config", str(cfg_file), "gconfig.n_samples=8",
+         "cluster.n_accelerators_per_node=4"],
+        BaseExperimentConfig,
+        ignore_unknown=True,
+    )
+    assert config.experiment_name == "e"
+    assert config.cluster.n_nodes == 3
+    assert config.cluster.n_accelerators_per_node == 4  # known override applied
+
+    with pytest.raises(ValueError):
+        # known field, malformed value: must NOT be swallowed
+        load_expr_config(
+            ["--config", str(cfg_file), "cluster.n_nodes=3x"],
+            BaseExperimentConfig,
+            ignore_unknown=True,
+        )
