@@ -99,9 +99,7 @@ def test_subset_view_parsing_ignores_subclass_fields(tmp_path):
     """The launcher parses subclass YAMLs as BaseExperimentConfig with
     ignore_unknown=True: subclass keys (nested included) are dropped, but
     bad VALUES for known fields still fail loudly."""
-    import pytest
-
-    from areal_tpu.api.cli_args import BaseExperimentConfig, load_expr_config
+    from areal_tpu.api.cli_args import BaseExperimentConfig
 
     cfg_file = tmp_path / "c.yaml"
     cfg_file.write_text(

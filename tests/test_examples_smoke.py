@@ -84,6 +84,18 @@ def test_grpo_multiturn_example_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_ppo_example_smoke(tmp_path):
+    out = _run_example(
+        "gsm8k_ppo.py",
+        "arith_ppo_smoke.yaml",
+        "total_train_steps=2",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=ppo-smoke-test",
+    )
+    assert "ppo_critic" in out
+
+
+@pytest.mark.slow
 def test_sft_lora_example_smoke(tmp_path):
     out = _run_example(
         "gsm8k_sft.py",
