@@ -80,6 +80,12 @@ class ModelConfig:
     qkv_bias: bool = True
     # Qwen3: per-head RMSNorm on q and k.
     qk_norm: bool = False
+    # Sliding-window attention (Mistral v0.1-class): each token attends at
+    # most `sliding_window` positions back within its segment. None = full
+    # causal. Served by the dense/prefill/decode paths; the Pallas
+    # flash/ring kernels reject it loudly rather than silently attending
+    # globally.
+    sliding_window: int | None = None
     # MLP activation: "silu" (SwiGLU families) | "gelu_pytorch_tanh" /
     # "gelu_new" / "gelu" (Gemma's GeGLU, GPT-2's fc MLP).
     hidden_act: str = "silu"
@@ -177,6 +183,30 @@ class ModelConfig:
                 "hidden_act", hf.get("activation_function", "gelu_new")
             )
             hf.setdefault("tie_word_embeddings", True)
+        sw_kw: dict = {}
+        if model_type in ("mistral", "mixtral") and hf.get("sliding_window"):
+            sw_kw = dict(sliding_window=int(hf["sliding_window"]))
+        elif model_type in (
+            "qwen2", "qwen2_moe", "qwen3", "qwen3_moe"
+        ) and hf.get("use_sliding_window"):
+            # HF windows only layers with layer_idx >= max_window_layers:
+            # mwl >= L means NO layer is windowed (the shape Qwen2.5 ships,
+            # e.g. 28/28); mwl == 0 windows every layer; anything between
+            # is a mixed stack that breaks scan-over-layers uniformity.
+            # A missing key defaults to "no window" — conservative-correct
+            # for stock configs.
+            L = hf["num_hidden_layers"]
+            mwl = hf.get("max_window_layers", L)
+            if mwl is None or mwl >= L:
+                pass  # no layer windowed
+            elif mwl == 0:
+                sw_kw = dict(sliding_window=int(hf["sliding_window"]))
+            else:
+                raise NotImplementedError(
+                    "use_sliding_window with 0 < max_window_layers < "
+                    "num_hidden_layers (mixed full/window layers) is not "
+                    "supported"
+                )
         # Llama/Mistral-family checkpoints share the qwen2 decoder layout
         # and tensor names exactly (RMSNorm + SwiGLU + RoPE GQA, biasless
         # qkv); what distinguishes Llama-3.x is its RoPE frequency scaling,
@@ -228,6 +258,7 @@ class ModelConfig:
             # running silu.
             hidden_act=hf.get("hidden_act", "silu"),
             **rope_kw,
+            **sw_kw,
         )
         if model_type == "qwen3_moe":
             kw.update(
@@ -920,12 +951,28 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     )
 
 
-def segment_causal_mask(segment_ids: jax.Array) -> jax.Array:
-    """[T, T] bool mask: attend iff same segment AND causal AND not padding."""
+def _window_band(T: int, sliding_window: int | None) -> jax.Array | None:
+    """[T, T] bool band: q attends k iff q_idx - k_idx < window (the HF
+    Mistral convention). None when unwindowed."""
+    if sliding_window is None:
+        return None
+    idx = jnp.arange(T)
+    return idx[:, None] - idx[None, :] < sliding_window
+
+
+def segment_causal_mask(
+    segment_ids: jax.Array, sliding_window: int | None = None
+) -> jax.Array:
+    """[T, T] bool mask: attend iff same segment AND causal AND not padding
+    (AND within `sliding_window` positions — same-segment tokens are
+    contiguous in the pack, so index distance equals position distance)."""
+    T = segment_ids.shape[0]
     seg_q = segment_ids[:, None]
     seg_k = segment_ids[None, :]
-    causal = jnp.tril(jnp.ones((segment_ids.shape[0],) * 2, dtype=bool))
-    return (seg_q == seg_k) & causal & (seg_q != PADDING_SEGMENT)
+    causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+    m = (seg_q == seg_k) & causal & (seg_q != PADDING_SEGMENT)
+    band = _window_band(T, sliding_window)
+    return m if band is None else m & band
 
 
 _ATTN_IMPLS = ("auto", "flash", "dense", "ring")
@@ -937,6 +984,16 @@ def resolve_attn_impl(cfg: ModelConfig) -> str:
             f"attn_impl={cfg.attn_impl!r} not in {_ATTN_IMPLS} "
             "(engine configs may also say 'pallas'/'xla' for flash/dense)"
         )
+    if cfg.sliding_window is not None:
+        # the Pallas flash/ring kernels have no window support yet —
+        # attending globally would be silently wrong, so force/require
+        # the dense mask path
+        if cfg.attn_impl in ("flash", "ring"):
+            raise NotImplementedError(
+                f"attn_impl={cfg.attn_impl!r} does not support "
+                "sliding_window; use attn_impl='dense'"
+            )
+        return "dense"
     if cfg.attn_impl != "auto":
         return cfg.attn_impl
     if jax.default_backend() != "tpu":
@@ -1003,7 +1060,7 @@ def attention(
         # GQA: broadcast kv heads to query heads via grouped einsum.
         group = nH // nKV
         if mask is None:
-            mask = segment_causal_mask(segment_ids)
+            mask = segment_causal_mask(segment_ids, cfg.sliding_window)
         qg = q.reshape(T, nKV, group, hd)
         scores = jnp.einsum("tkgd,skd->kgts", qg, k).astype(jnp.float32)
         scores = scores / np.sqrt(hd)
@@ -1247,7 +1304,7 @@ def forward(
     # Dense path: build the [T,T] mask ONCE here (outside the per-layer remat
     # region); flash/ring never materialise it.
     mask = (
-        segment_causal_mask(segment_ids)
+        segment_causal_mask(segment_ids, cfg.sliding_window)
         if resolve_attn_impl(cfg) == "dense"
         else None
     )
@@ -1489,6 +1546,9 @@ def prefill(
         cos, sin = rope_table(position_ids, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)
     T = input_ids.shape[0]
     causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+    band = _window_band(T, cfg.sliding_window)
+    if band is not None:
+        causal = causal & band
     nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
     group = nH // nKV
 
@@ -1574,6 +1634,10 @@ def decode_step(
     rope_pos = positions if rope_offset is None else positions + rope_offset
     cos, sin = rope_table(rope_pos, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)  # [R, hd/2]
     valid = jnp.arange(S)[None, :] <= positions[:, None]  # [R, S]
+    if cfg.sliding_window is not None:
+        valid = valid & (
+            jnp.arange(S)[None, :] > positions[:, None] - cfg.sliding_window
+        )
 
     def write(cache_l, new):  # [R, S, nKV, hd] <- [R, nKV, hd]
         onehot = (jnp.arange(S)[None, :] == positions[:, None]).astype(

@@ -242,3 +242,61 @@ def test_gemma2_rejected():
                 "num_attention_heads": 4,
             }
         )
+
+
+def test_mistral_sliding_window_parity(tmp_path):
+    """Mistral v0.1-class sliding window: parity vs HF at T > window, the
+    regime where ignoring the window is silently wrong; decode/prefill
+    must agree with forward; flash impl must reject loudly."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    hf_cfg = MistralConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        sliding_window=8,
+        max_position_embeddings=128,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = MistralForCausalLM(hf_cfg).eval().float()
+    model_dir = _save_tiny(model, tmp_path, "mistral")
+    cfg, params = _parity(model, model_dir, 96, T=24)
+    assert cfg.sliding_window == 8
+    _decode_consistency(cfg, params, T=24)
+
+    from areal_tpu.models.qwen2 import resolve_attn_impl
+
+    with pytest.raises(NotImplementedError):
+        resolve_attn_impl(
+            ModelConfig(sliding_window=8, attn_impl="flash")
+        )
+    # auto resolves to the dense mask path
+    assert resolve_attn_impl(
+        ModelConfig(sliding_window=8, attn_impl="auto")
+    ) == "dense"
+
+
+def test_qwen2_max_window_layers_semantics():
+    """HF windows layers with layer_idx >= max_window_layers: the stock
+    Qwen2.5 shape (mwl == L) must mean NO window (review regression)."""
+    base = dict(
+        model_type="qwen2", vocab_size=96, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=4, num_attention_heads=4,
+        use_sliding_window=True, sliding_window=8,
+    )
+    # mwl == L (stock shape): no layer windowed
+    cfg = ModelConfig.from_hf_config({**base, "max_window_layers": 4})
+    assert cfg.sliding_window is None
+    # key absent: conservative no-window
+    cfg = ModelConfig.from_hf_config(dict(base))
+    assert cfg.sliding_window is None
+    # mwl == 0: every layer windowed
+    cfg = ModelConfig.from_hf_config({**base, "max_window_layers": 0})
+    assert cfg.sliding_window == 8
+    # mixed stack: loud rejection
+    with pytest.raises(NotImplementedError):
+        ModelConfig.from_hf_config({**base, "max_window_layers": 2})
