@@ -283,3 +283,19 @@ def test_calculator_exact_large_integers():
     assert t.fn("3.5*2") == "7\n"  # integral float renders exactly
     # beyond-2^53 integer arithmetic stays exact (int-preserving walk)
     assert t.fn("123456789123456789+1") == "123456789123456790\n"
+
+
+def test_python_tool_reaps_grandchildren():
+    """A spawned grandchild holding the stdout pipe must not stall the
+    call past its deadline (process-group kill)."""
+    import time
+
+    t0 = time.monotonic()
+    out = run_python_tool(
+        "import subprocess\n"
+        "subprocess.Popen(['sleep', '100'])\n"
+        "print('spawned')\n",
+        timeout_seconds=3.0,
+    )
+    assert time.monotonic() - t0 < 10.0
+    assert "spawned" in out or "TimeoutError" in out
