@@ -158,7 +158,10 @@ class TrainEngineConfig:
     experiment_name: str = ""
     trial_name: str = ""
     path: str = ""  # HF model path or local checkpoint dir
-    attn_impl: str = "auto"  # "auto" | "pallas" | "xla"
+    # "auto" | "pallas" (flash kernel) | "xla" (dense mask) | "chunked"
+    # (XLA online-softmax over KV chunks — the O(T)-memory path sliding-
+    # window models resolve to) | "ring" (context-parallel)
+    attn_impl: str = "auto"
     init_from_scratch: bool = False
     is_critic: bool = False
     mb_spec: MicroBatchSpec = field(default_factory=MicroBatchSpec)

@@ -975,7 +975,7 @@ def segment_causal_mask(
     return m if band is None else m & band
 
 
-_ATTN_IMPLS = ("auto", "flash", "dense", "ring")
+_ATTN_IMPLS = ("auto", "flash", "dense", "ring", "chunked")
 
 
 def resolve_attn_impl(cfg: ModelConfig) -> str:
@@ -986,14 +986,15 @@ def resolve_attn_impl(cfg: ModelConfig) -> str:
         )
     if cfg.sliding_window is not None:
         # the Pallas flash/ring kernels have no window support yet —
-        # attending globally would be silently wrong, so force/require
-        # the dense mask path
+        # attending globally would be silently wrong. The XLA chunked
+        # online-softmax path applies the window at O(T·chunk) memory
+        # (dense stays available for tiny tests).
         if cfg.attn_impl in ("flash", "ring"):
             raise NotImplementedError(
                 f"attn_impl={cfg.attn_impl!r} does not support "
-                "sliding_window; use attn_impl='dense'"
+                "sliding_window; use 'chunked' (O(T) memory) or 'dense'"
             )
-        return "dense"
+        return "chunked" if cfg.attn_impl == "auto" else cfg.attn_impl
     if cfg.attn_impl != "auto":
         return cfg.attn_impl
     if jax.default_backend() != "tpu":
@@ -1056,6 +1057,12 @@ def attention(
         from areal_tpu.ops.ring_attention import ring_flash_attention
 
         out = ring_flash_attention(q, k, v, segment_ids)
+    elif impl == "chunked":
+        from areal_tpu.ops.chunked_attention import chunked_attention
+
+        out = chunked_attention(
+            q, k, v, segment_ids, sliding_window=cfg.sliding_window
+        )
     else:
         # GQA: broadcast kv heads to query heads via grouped einsum.
         group = nH // nKV

@@ -1,0 +1,105 @@
+"""Chunked (online-softmax) attention in pure XLA — no Pallas.
+
+The flash-attention trick expressed as a `lax.scan` over KV chunks:
+running max / normalizer / weighted accumulator per query, O(T · chunk)
+live memory instead of the dense path's O(T²) score matrix. XLA fuses the
+per-chunk einsums onto the MXU; no custom lowering, so it runs on any
+backend and composes with GSPMD sharding like any jnp program.
+
+Role in the impl lineup (models/qwen2.py::resolve_attn_impl):
+- "flash" (Pallas) — fastest on TPU, no sliding-window support;
+- "chunked" (this) — long-context path for SLIDING-WINDOW models
+  (Mistral-class) and a hardware-independent O(T) fallback;
+- "dense" — [T, T] mask, short packs / tiny tests.
+
+Causality, segment isolation and the sliding-window band are applied per
+chunk; the backward comes from autodiff through the scan with the chunk
+body checkpointed (logits recomputed per chunk, as in ops/fused_xent.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+PADDING_SEGMENT = -1
+
+
+def chunked_attention(
+    q: jax.Array,  # [T, nH, hd]
+    k: jax.Array,  # [T, nKV, hd]
+    v: jax.Array,  # [T, nKV, hd]
+    segment_ids: jax.Array,  # [T]
+    sm_scale: float | None = None,
+    sliding_window: int | None = None,
+    kv_chunk: int = 512,
+) -> jax.Array:
+    """Packed causal-within-segment attention, O(T·kv_chunk) memory."""
+    T, nH, hd = q.shape
+    nKV = k.shape[1]
+    group = nH // nKV
+    scale = sm_scale if sm_scale is not None else hd**-0.5
+
+    cs = int(min(kv_chunk, T))
+    n_pad = (-T) % cs
+    if n_pad:
+        k = jnp.pad(k, ((0, n_pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, n_pad), (0, 0), (0, 0)))
+        seg_k_full = jnp.pad(
+            segment_ids, (0, n_pad), constant_values=PADDING_SEGMENT
+        )
+    else:
+        seg_k_full = segment_ids
+    n_chunks = (T + n_pad) // cs
+
+    qg = (q * scale).reshape(T, nKV, group, hd)
+    q_idx = jnp.arange(T)
+
+    k_chunks = k.reshape(n_chunks, cs, nKV, hd)
+    v_chunks = v.reshape(n_chunks, cs, nKV, hd)
+    seg_chunks = seg_k_full.reshape(n_chunks, cs)
+    off_chunks = jnp.arange(n_chunks, dtype=jnp.int32) * cs
+
+    def body(carry, chunk):
+        m, denom, acc = carry
+        kc, vc, seg_c, off = chunk
+        # [nKV, group, T, cs] scores in f32
+        s = jnp.einsum(
+            "tkgd,skd->kgts", qg, kc, preferred_element_type=jnp.float32
+        )
+        k_idx = off + jnp.arange(cs)
+        mask = (
+            (segment_ids[:, None] == seg_c[None, :])
+            & (q_idx[:, None] >= k_idx[None, :])
+            & (segment_ids[:, None] != PADDING_SEGMENT)
+        )
+        if sliding_window is not None:
+            mask = mask & (q_idx[:, None] - k_idx[None, :] < sliding_window)
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # fully-masked rows keep m == -inf; exp(-inf - -inf) would be NaN
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe_m[..., None])
+        p = jnp.where(mask[None, None], p, 0.0)
+        rescale = jnp.where(
+            jnp.isneginf(m), 0.0, jnp.exp(m - safe_m)
+        )
+        denom = denom * rescale + p.sum(axis=-1)
+        acc = acc * rescale[..., None] + jnp.einsum(
+            "kgts,skd->kgtd", p, vc, preferred_element_type=jnp.float32
+        )
+        return (m_new, denom, acc), None
+
+    init = (
+        jnp.full((nKV, group, T), -jnp.inf, jnp.float32),
+        jnp.zeros((nKV, group, T), jnp.float32),
+        jnp.zeros((nKV, group, T, hd), jnp.float32),
+    )
+    (m, denom, acc), _ = jax.lax.scan(
+        jax.checkpoint(body, prevent_cse=False),
+        init,
+        (k_chunks, v_chunks, seg_chunks, off_chunks),
+    )
+    out = acc / jnp.maximum(denom, 1e-30)[..., None]
+    # [nKV, group, T, hd] -> [T, nH, hd]
+    return out.transpose(2, 0, 1, 3).reshape(T, nH, hd).astype(q.dtype)

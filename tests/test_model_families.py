@@ -274,10 +274,10 @@ def test_mistral_sliding_window_parity(tmp_path):
         resolve_attn_impl(
             ModelConfig(sliding_window=8, attn_impl="flash")
         )
-    # auto resolves to the dense mask path
+    # auto resolves to the O(T)-memory chunked online-softmax path
     assert resolve_attn_impl(
         ModelConfig(sliding_window=8, attn_impl="auto")
-    ) == "dense"
+    ) == "chunked"
 
 
 def test_qwen2_max_window_layers_semantics():
