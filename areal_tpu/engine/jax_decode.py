@@ -82,6 +82,9 @@ class _Slot:
     tokens: list[int] = field(default_factory=list)
     logprobs: list[float] = field(default_factory=list)
     versions: list[int] = field(default_factory=list)
+    # per-token inter-token latency; chunked decode can only observe the
+    # chunk wall clock, so each token in a chunk gets chunk_dt / n_chunk
+    itl: list[float] = field(default_factory=list)
     start_time: float = field(default_factory=time.monotonic)
     ttft: float = float("inf")
     stop_reason: str | None = None
@@ -133,9 +136,26 @@ class JaxDecodeEngine(InferenceEngine):
         # Requests popped from the queue that found no capacity; consulted
         # before the queue so admission order is preserved.
         self._overflow: list[_Slot] = []
+        # Cross-request prefix-KV sharing (the radix-cache property the
+        # reference inherits from SGLang, areal/engine/sglang_remote.py:22):
+        # GRPO submits group_size requests with the SAME prompt; the first
+        # admission prefills it, later ones fork the donor slot's prompt-KV
+        # rows with a device memcpy instead of re-running the transformer.
+        # _prefix_lookup maps the covered prefix (prompt[:-1] as a tuple) to
+        # a donor slot whose KV rows [0, covered) hold exactly those tokens;
+        # _slot_prefix is the inverse, for invalidation when a slot's rows
+        # are overwritten (new prefill/fork) or weights change.
+        self._prefix_lookup: dict[tuple[int, ...], int] = {}
+        self._slot_prefix: list[tuple[int, ...] | None] = []
+        # counters surfaced via get_metrics(): prefill vs prefix-sharing mix
+        self._n_prefills = 0
+        self._n_prefix_forks = 0
+        self._n_prefix_inplace = 0
+        self._gen_token_count = 0  # total tokens generated since init
         self._rng = None
         self._chunk_fns: dict[bool, Callable] = {}
         self._prefill_fns: dict[int, Callable] = {}
+        self._fork_fns: dict[int, Callable] = {}
         self._write_fns: dict[int, Callable] = {}
         # GQA-under-tp: kv heads repeated _kv_repeat times at install
         # (_maybe_repeat_kv_heads); original config kept for HF reloads.
@@ -224,6 +244,8 @@ class JaxDecodeEngine(InferenceEngine):
         self._slot_rope_delta = np.zeros(R, dtype=np.int32)
         self._slot_used_freq = np.zeros(R, dtype=bool)
         self._slots = [None] * R
+        self._prefix_lookup = {}
+        self._slot_prefix = [None] * R
         self._rng = jax.random.PRNGKey(self.config.random_seed)
 
         from areal_tpu.core.workflow_executor import WorkflowExecutor
@@ -256,6 +278,8 @@ class JaxDecodeEngine(InferenceEngine):
         self._embed_prefill_fns.clear()
         self._chunk_fns.clear()
         self._prefill_fns.clear()
+        self._fork_fns.clear()
+        self._prefix_lookup.clear()
 
     def _maybe_load_vision_tower(self, model_path: str) -> None:
         """VLM checkpoints (config.json carries "vision_config") also load
@@ -710,6 +734,55 @@ class JaxDecodeEngine(InferenceEngine):
             )
         return self._prefill_fns[bucket]
 
+    def _get_fork_fn(self, bucket: int):
+        """Copy `bucket` KV rows from a donor slot to a destination slot.
+
+        A pure HBM memcpy (dynamic_slice + dynamic_update_slice over the
+        slot axis) — orders of magnitude cheaper than re-running the
+        transformer prefill it replaces. Rows past the covered prefix may
+        carry the donor's generated tokens; harmless, because the
+        destination's slot length is set to the covered count and decode
+        only ever attends rows below the length before overwriting them."""
+        if bucket not in self._fork_fns:
+
+            def fork(kc, vc, src, dst):
+                L, _, _, nkv, hd = kc.shape
+                k_rows = jax.lax.dynamic_slice(
+                    kc, (0, src, 0, 0, 0), (L, 1, bucket, nkv, hd)
+                )
+                v_rows = jax.lax.dynamic_slice(
+                    vc, (0, src, 0, 0, 0), (L, 1, bucket, nkv, hd)
+                )
+                kc = jax.lax.dynamic_update_slice(kc, k_rows, (0, dst, 0, 0, 0))
+                vc = jax.lax.dynamic_update_slice(vc, v_rows, (0, dst, 0, 0, 0))
+                return kc, vc
+
+            self._fork_fns[bucket] = jax.jit(fork, donate_argnums=(0, 1))
+        return self._fork_fns[bucket]
+
+    # -- prefix-KV registry --------------------------------------------
+    def _unregister_prefix(self, slot_idx: int) -> None:
+        key = self._slot_prefix[slot_idx]
+        if key is not None:
+            self._slot_prefix[slot_idx] = None
+            if self._prefix_lookup.get(key) == slot_idx:
+                self._prefix_lookup.pop(key, None)
+
+    def _register_prefix(self, slot_idx: int, covered: list[int]) -> None:
+        self._unregister_prefix(slot_idx)
+        if not covered:
+            return
+        key = tuple(covered)
+        self._slot_prefix[slot_idx] = key
+        self._prefix_lookup[key] = slot_idx
+
+    def _invalidate_prefixes(self) -> None:
+        """Weight installs recompute nothing in place: any KV produced by
+        the old weights must not seed a request generating under the new
+        ones (same reasoning as _invalidate_parked)."""
+        self._prefix_lookup.clear()
+        self._slot_prefix = [None] * len(self._slot_prefix)
+
     # -- scheduler ------------------------------------------------------
     def _free_slots(self) -> list[int]:
         parked = {slot for slot, _, _ in self._parked.values()}
@@ -791,7 +864,16 @@ class JaxDecodeEngine(InferenceEngine):
                 if P > 1
                 else 0
             )
-            if did_prefill and needs_prefill_bucket > prefill_budget:
+            # Prefix-KV lookup (decided once, here, so the budget gate can
+            # wave forks through: a fork is a memcpy, not prefill work).
+            # Image requests are excluded — their KV depends on pixel data
+            # the token-tuple key cannot see.
+            donor = (
+                self._prefix_lookup.get(tuple(prompt[:-1]))
+                if P > 1 and not item.image_data
+                else None
+            )
+            if did_prefill and donor is None and needs_prefill_bucket > prefill_budget:
                 # budget exhausted for this pass; run the decode chunk first
                 self._overflow.insert(0, item)
                 break
@@ -821,11 +903,36 @@ class JaxDecodeEngine(InferenceEngine):
                     # full-buffer copy on device)
                     self._freq_counts = self._freq_counts.at[slot_idx].set(0.0)
                     self._slot_used_freq[slot_idx] = False
-            if resumed is None and P > 1:
+            if resumed is None and P <= 1:
+                # no prefill: the decode loop writes KV from row 0, which
+                # invalidates whatever prefix this slot may have donated
+                self._unregister_prefix(slot_idx)
+            if resumed is None and P > 1 and donor is not None:
+                # Prefix-KV hit (the GRPO group case: group_size requests
+                # share one prompt). The donor slot's rows [0, P-1) already
+                # hold this prefix — fork them with a device memcpy instead
+                # of re-running transformer prefill. When the chosen slot IS
+                # the donor (a retired slot re-admitted with the same
+                # prompt), the rows are already in place and nothing moves.
+                bucket = min(_next_bucket(P - 1), self.config.context_length)
+                if donor != slot_idx:
+                    self._unregister_prefix(slot_idx)
+                    fn = self._get_fork_fn(bucket)
+                    with self._weight_lock:
+                        self._k_cache, self._v_cache = fn(
+                            self._k_cache, self._v_cache, donor, slot_idx
+                        )
+                    self._register_prefix(slot_idx, list(prompt[:-1]))
+                    self._n_prefix_forks += 1
+                else:
+                    self._n_prefix_inplace += 1
+            elif resumed is None and P > 1:
                 pre = P - 1
                 bucket = min(_next_bucket(pre), self.config.context_length)
                 prefill_budget -= bucket
                 did_prefill = True
+                self._n_prefills += 1
+                self._unregister_prefix(slot_idx)
                 ids = np.zeros(bucket, dtype=np.int32)
                 ids[:pre] = prompt[:-1]
                 positions = np.arange(bucket, dtype=np.int32)
@@ -863,6 +970,7 @@ class JaxDecodeEngine(InferenceEngine):
                             slot_idx,
                             pre,
                         )
+                    self._register_prefix(slot_idx, list(prompt[:-1]))
             self._slots[slot_idx] = item
             self._slot_lengths[slot_idx] = P - 1
             admitted = True
@@ -925,12 +1033,14 @@ class JaxDecodeEngine(InferenceEngine):
             del item.tokens[cut:]
             del item.logprobs[cut:]
             del item.versions[cut:]
+            del item.itl[cut:]
             item.stop_reason = "stop"
             return
         if len(item.tokens) >= g.max_new_tokens:
             del item.tokens[g.max_new_tokens :]
             del item.logprobs[g.max_new_tokens :]
             del item.versions[g.max_new_tokens :]
+            del item.itl[g.max_new_tokens :]
             item.stop_reason = "length"
 
     def _retire(self, slot_idx: int) -> None:
@@ -959,6 +1069,7 @@ class JaxDecodeEngine(InferenceEngine):
             stop_reason=stop_reason,  # type: ignore[arg-type]
             latency=time.monotonic() - item.start_time,
             ttft=item.ttft,
+            itl=list(item.itl),
             tokenizer=self.tokenizer,
         )
         if item.future is not None and not item.future.done():
@@ -1050,6 +1161,7 @@ class JaxDecodeEngine(InferenceEngine):
         )
         chunk_fn = self._get_chunk_fn(use_topp, use_freq)
         version_at_chunk = self._version
+        chunk_t0 = time.monotonic()
         with self._weight_lock:
             self._rng, sub = jax.random.split(self._rng)
             args = [
@@ -1103,6 +1215,10 @@ class JaxDecodeEngine(InferenceEngine):
         logps = np.asarray(logps)
         self._slot_lengths = np.asarray(lengths_out).copy()
         n_chunk = toks.shape[0]
+        # np.asarray above blocked on the device work, so this wall time
+        # covers the whole chunk; amortize it per token for ITL
+        per_tok_s = (time.monotonic() - chunk_t0) / max(n_chunk, 1)
+        self._gen_token_count += int(self._active_mask().sum()) * n_chunk
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -1111,6 +1227,7 @@ class JaxDecodeEngine(InferenceEngine):
             s.tokens.extend(toks[:, i].tolist())
             s.logprobs.extend(logps[:, i].tolist())
             s.versions.extend([version_at_chunk] * n_chunk)
+            s.itl.extend([per_tok_s] * n_chunk)
             self._truncate_at_stop(s)
             if s.stop_reason is not None:
                 # rewind the slot length to the true end: KV rows cover
@@ -1260,6 +1377,8 @@ class JaxDecodeEngine(InferenceEngine):
             slot, _, _ = self._parked.pop(rid)
             self._parked_tokens.pop(rid, None)
             self._slot_lengths[slot] = 0
+        # same staleness argument applies to the prefix-KV registry
+        self._invalidate_prefixes()
 
     def init_weights_update_group(self, meta: WeightUpdateMeta):
         pass
@@ -1383,4 +1502,38 @@ class JaxDecodeEngine(InferenceEngine):
 
     def get_version(self) -> int:
         return self._version
+
+    # -- observability --------------------------------------------------
+    def get_metrics(self) -> dict:
+        """Live load/latency counters for the decode server's /metrics and
+        the router's least-token-usage policy (parity: the per-server token
+        accounting of realhf/system/gserver_manager.py:261-339)."""
+        active_tokens = 0
+        running = 0
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                running += 1
+                active_tokens += int(self._slot_lengths[i]) + 1
+        # queued work is load too: a router that only saw running slots
+        # would dogpile a server whose queue is deep (its slot count
+        # saturates at max_running_requests). Snapshot iteration over the
+        # queue's deque is racy-but-safe: both containers only ever hold
+        # _Slot items, and metrics tolerate an off-by-a-request snapshot.
+        queued_tokens = 0
+        queued = 0
+        for item in list(self._request_q.queue) + list(self._overflow):
+            queued += 1
+            queued_tokens += len(item.prompt) + item.gconfig.max_new_tokens
+        return {
+            "running_requests": running,
+            "queued_requests": queued,
+            "queued_tokens": queued_tokens,
+            "active_tokens": active_tokens,
+            "generated_tokens_total": self._gen_token_count,
+            "prefills_total": self._n_prefills,
+            "prefix_forks_total": self._n_prefix_forks,
+            "prefix_inplace_total": self._n_prefix_inplace,
+            "weight_version": self._version,
+            "paused": self._gen_paused.is_set(),
+        }
 

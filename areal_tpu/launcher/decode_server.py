@@ -117,8 +117,21 @@ class DecodeServer:
                 "stop_reason": resp.stop_reason,
                 "latency": resp.latency,
                 "ttft": resp.ttft,
+                "itl": resp.itl,
             }
         )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        """Live engine load counters (running/queued requests, active KV
+        tokens, generated-token totals, prefix-cache hit mix). The router's
+        least_token_usage policy polls this — parity with the per-server
+        token accounting of realhf/system/gserver_manager.py:261-339."""
+        get = getattr(self.engine, "get_metrics", None)
+        if get is None:
+            # 404, not {}: the router must fall back to its own estimates
+            # rather than record a phantom zero load
+            raise web.HTTPNotFound(reason="engine exports no metrics")
+        return web.json_response(get())
 
     async def _pause(self, request: web.Request) -> web.Response:
         try:
@@ -248,6 +261,7 @@ class DecodeServer:
         app = web.Application(client_max_size=1024**3)
         app.router.add_get("/health", self._health)
         app.router.add_get("/info", self._info)
+        app.router.add_get("/metrics", self._metrics)
         app.router.add_post("/generate", self._generate)
         app.router.add_post("/pause_generation", self._pause)
         app.router.add_post("/continue_generation", self._continue)

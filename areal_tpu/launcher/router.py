@@ -68,6 +68,11 @@ class DecodeRouter:
         self._rr = 0
         self._request_counts: dict[str, int] = defaultdict(int)
         self._token_usage: dict[str, float] = defaultdict(float)
+        # least_token_usage inputs: the servers' own /metrics active-token
+        # counts (measured, refreshed each poll) plus the estimated cost of
+        # requests routed since that poll (not yet visible in the metrics).
+        self._measured_tokens: dict[str, float] = {}
+        self._est_since_poll: dict[str, float] = defaultdict(float)
         self._qid_to_server: dict[str, str] = {}
         self._qid_cost: dict[str, float] = {}
         # one qid may carry several in-flight requests (a GRPO group shares
@@ -100,19 +105,58 @@ class DecodeRouter:
         while True:
             try:
                 servers = self._discover()
-                versions = {}
-                for s in servers:
+
+                async def probe(s: str):
+                    """health + metrics for one server, with the since-poll
+                    estimate snapshotted at fetch time — requests routed
+                    AFTER the snapshot are invisible to this measurement
+                    and must survive the later subtraction."""
                     try:
                         data = await arequest_with_retry(
                             s, "/health", method="GET", timeout=5,
                             max_retries=1,
                         )
-                        versions[s] = int(data.get("version", 0))
+                        version = int(data.get("version", 0))
                     except Exception:  # noqa: BLE001 — dead server drops out
                         logger.warning(f"server {s} failed health poll")
+                        return s, None, None, 0.0
+                    est_snapshot = self._est_since_poll[s]
+                    try:
+                        m = await arequest_with_retry(
+                            s, "/metrics", method="GET", timeout=5,
+                            max_retries=1,
+                        )
+                        # a server without real metrics answers {} — treat
+                        # it as "no measurement" so the estimate fallback
+                        # engages instead of a phantom zero load
+                        load = (
+                            float(m["active_tokens"])
+                            + float(m.get("queued_tokens", 0.0))
+                            if "active_tokens" in m
+                            else None
+                        )
+                    except Exception:  # noqa: BLE001 — metrics optional
+                        load = None
+                    return s, version, load, est_snapshot
+
+                # fan out: one hung server must not stale the whole fleet's
+                # measurements for its full timeout
+                probes = await asyncio.gather(*(probe(s) for s in servers))
                 async with self._lock:
+                    versions = {
+                        s: v for s, v, _, _ in probes if v is not None
+                    }
                     self.servers = [s for s in servers if s in versions]
                     self._versions = versions
+                    for s, v, load, est_snapshot in probes:
+                        if v is None or load is None:
+                            continue
+                        self._measured_tokens[s] = load
+                        # subtract only what the measurement could have
+                        # seen; later routings keep their estimated cost
+                        self._est_since_poll[s] = max(
+                            0.0, self._est_since_poll[s] - est_snapshot
+                        )
             except Exception as e:  # noqa: BLE001 — keep the loop alive
                 logger.warning(f"router poll loop error: {e!r}")
             await asyncio.sleep(self.health_poll_interval)
@@ -140,6 +184,15 @@ class DecodeRouter:
         return expected > self.max_head_offpolicyness + self.fleet_version
 
     # -- scheduling -----------------------------------------------------
+    def _token_load(self, s: str) -> float:
+        """Current token load of a server: its last /metrics active-token
+        count plus the estimated cost of requests routed there since that
+        poll. Servers that never reported metrics fall back to the router's
+        own full estimate (pre-/metrics behaviour)."""
+        if s in self._measured_tokens:
+            return self._measured_tokens[s] + self._est_since_poll[s]
+        return self._token_usage[s]
+
     def _pick(self, req: dict[str, Any]) -> str:
         if not self.servers:
             raise web.HTTPServiceUnavailable(reason="no decode servers")
@@ -162,7 +215,7 @@ class DecodeRouter:
         elif self.schedule_policy == "least_requests":
             addr = min(self.servers, key=lambda s: self._request_counts[s])
         elif self.schedule_policy == "least_token_usage":
-            addr = min(self.servers, key=lambda s: self._token_usage[s])
+            addr = min(self.servers, key=self._token_load)
         else:
             raise web.HTTPBadRequest(
                 reason=f"unknown schedule policy {self.schedule_policy}"
@@ -180,6 +233,7 @@ class DecodeRouter:
             ) * float(req.get("group_size", 1))
             self._request_counts[addr] += 1
             self._token_usage[addr] += cost
+            self._est_since_poll[addr] += cost
             if qid:
                 self._qid_to_server[qid] = addr
                 self._qid_cost[qid] = self._qid_cost.get(qid, 0.0) + cost
@@ -222,6 +276,9 @@ class DecodeRouter:
         self._token_usage[addr] = max(
             0.0, self._token_usage[addr] - unit_cost
         )
+        self._est_since_poll[addr] = max(
+            0.0, self._est_since_poll[addr] - unit_cost
+        )
         if pending <= 1:
             self._qid_to_server.pop(qid, None)
             self._qid_cost.pop(qid, None)
@@ -259,6 +316,9 @@ class DecodeRouter:
                     "submitted": self._submitted,
                     "accepted": self._accepted,
                     "request_counts": dict(self._request_counts),
+                    "token_loads": {
+                        s: self._token_load(s) for s in self.servers
+                    },
                 }
             )
 

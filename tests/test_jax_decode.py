@@ -494,3 +494,74 @@ def test_decode_under_foreign_global_mesh(cpu_devices):
         if eng is not None:
             eng.destroy()
         mesh_lib.set_current_mesh(None)
+
+
+@pytest.mark.slow
+def test_prefix_fork_group_decode(cpu_devices):
+    """GRPO-group admission path: group_size same-prompt requests prefill
+    ONCE; the rest fork the donor slot's prompt KV (a memcpy), and outputs
+    stay exactly equal to the greedy reference. Parity target: the radix
+    prefix cache the reference inherits from SGLang
+    (areal/engine/sglang_remote.py:22)."""
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    cfg = JaxDecodeConfig(
+        context_length=96,
+        max_running_requests=4,
+        new_tokens_per_chunk=8,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    try:
+        prompt = [3, 7, 11, 2, 9, 4]
+        n_new = 9
+        g = GenerationHyperparameters(greedy=True, max_new_tokens=n_new)
+
+        eng.pause_generation()
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [
+                pool.submit(
+                    eng.generate,
+                    ModelRequest(input_ids=list(prompt), gconfig=g),
+                    600,
+                )
+                for _ in range(4)
+            ]
+            deadline = _time.monotonic() + 30
+            while eng._request_q.qsize() < 4:
+                assert _time.monotonic() < deadline, "requests never enqueued"
+                _time.sleep(0.01)
+            eng.continue_generation()
+            results = [f.result(timeout=600) for f in futs]
+
+        expected = greedy_reference(eng.params, prompt, n_new)
+        for r in results:
+            assert r.output_tokens == expected
+            # latency observability: itl filled, one entry per token
+            assert len(r.itl) == r.output_len
+            assert all(v > 0 for v in r.itl)
+            assert r.ttft != float("inf")
+        assert eng._n_prefills == 1
+        assert eng._n_prefix_forks == 3
+        m = eng.get_metrics()
+        assert m["prefix_forks_total"] == 3
+        assert m["generated_tokens_total"] >= 4 * n_new
+
+        # Retired slots keep their prompt KV: a later same-prompt request
+        # reuses it (fork or in-place) without any new prefill.
+        r = eng.generate(ModelRequest(input_ids=list(prompt), gconfig=g), timeout=600)
+        assert r.output_tokens == expected
+        assert eng._n_prefills == 1
+
+        # A weight install invalidates the registry (old-weight KV must not
+        # seed new-weight generation) — the next admission prefills again.
+        eng.update_weights_from_tensor({}, version=1)
+        r = eng.generate(ModelRequest(input_ids=list(prompt), gconfig=g), timeout=600)
+        assert r.output_tokens == expected
+        assert eng._n_prefills == 2
+    finally:
+        eng.destroy()

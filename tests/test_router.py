@@ -13,19 +13,27 @@ from areal_tpu.utils.http import arequest_with_retry, close_current_session
 
 
 class FakeServer:
-    """Minimal decode-server stand-in: /health with a version."""
+    """Minimal decode-server stand-in: /health with a version, plus an
+    optional /metrics active-token gauge (None = no metrics endpoint)."""
 
-    def __init__(self, version=0):
+    def __init__(self, version=0, active_tokens=None):
         self.version = version
+        self.active_tokens = active_tokens
         self._runner = None
         self.addr = None
 
     async def _health(self, request):
         return web.json_response({"status": "ok", "version": self.version})
 
+    async def _metrics(self, request):
+        if self.active_tokens is None:
+            raise web.HTTPNotFound()
+        return web.json_response({"active_tokens": self.active_tokens})
+
     async def start(self):
         app = web.Application()
         app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, "127.0.0.1", 0)
@@ -172,3 +180,54 @@ async def _scenario_staleness(tmp_root):
 
 def test_router_staleness_gate(tmp_path):
     assert _run_async(_scenario_staleness(tmp_path))
+
+
+async def _scenario_token_load_rebalance():
+    """least_token_usage follows the servers' MEASURED /metrics load, not
+    just the router's own estimates (parity: least-token scheduling in
+    realhf/system/gserver_manager.py:339): a synthetic skew pushes all new
+    work to the lighter server, and flipping the skew rebalances."""
+    s1 = FakeServer(version=1, active_tokens=50_000)
+    s2 = FakeServer(version=1, active_tokens=100)
+    a1, a2 = await s1.start(), await s2.start()
+    router = DecodeRouter(
+        servers=[a1, a2],
+        schedule_policy="least_token_usage",
+        health_poll_interval=0.2,
+    )
+    addr = await router.start("127.0.0.1", 0)
+    try:
+        await asyncio.sleep(0.5)  # poll loop sees both /metrics
+        picks = []
+        for i in range(4):
+            r = await arequest_with_retry(
+                addr, "/schedule_request",
+                payload=dict(qid=f"skew-{i}", prompt_len=64, group_size=1,
+                             new_token_budget=64),
+            )
+            picks.append(r["url"])
+        assert picks == [a2] * 4, f"skewed load not avoided: {picks}"
+
+        # flip the skew; after the next poll new requests go the other way
+        s1.active_tokens, s2.active_tokens = 100, 50_000
+        await asyncio.sleep(0.6)
+        r = await arequest_with_retry(
+            addr, "/schedule_request",
+            payload=dict(qid="flip", prompt_len=64, group_size=1,
+                         new_token_budget=64),
+        )
+        assert r["url"] == a1, "router did not rebalance on measured load"
+
+        health = await arequest_with_retry(addr, "/health", method="GET")
+        assert set(health["token_loads"]) == {a1, a2}
+        assert health["token_loads"][a2] > health["token_loads"][a1]
+        return True
+    finally:
+        await close_current_session()
+        await router.stop()
+        await s1.stop()
+        await s2.stop()
+
+
+def test_router_token_load_rebalance():
+    assert _run_async(_scenario_token_load_rebalance())
