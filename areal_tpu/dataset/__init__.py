@@ -99,6 +99,58 @@ def _gsm8k(path: str, split: str, type: str, tokenizer=None, max_length=None, **
     return ds
 
 
+def _math_items(ds):
+    """Map MATH-style rows (problem/solution/answer) to the RLVR schema.
+    `answer` prefers the explicit answer field, falling back to the
+    solution's \\boxed{...} via the math parser."""
+    from areal_tpu.reward.math_parser import extract_answer
+
+    def to_item(x):
+        ans = x.get("answer") or extract_answer(x.get("solution", "")) or ""
+        return dict(
+            messages=[{"role": "user", "content": x["problem"]}],
+            prompt=x["problem"],
+            answer=str(ans),
+        )
+
+    return ds.map(to_item, remove_columns=ds.column_names)
+
+
+@register_dataset("math500")
+@register_dataset("math-500")
+def _math500(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
+    """MATH-500 (the OpenAI PRM800K test split; canonical hub id
+    HuggingFaceH4/MATH-500) — the reference's headline offline math
+    benchmark (/root/reference/evaluation/data)."""
+    import datasets as hf_datasets
+
+    if path in ("", "math500", "math-500", None) or path.endswith("MATH-500"):
+        hub = path if path and path.endswith("MATH-500") else "HuggingFaceH4/MATH-500"
+        ds = hf_datasets.load_dataset(hub, split=split)
+    else:
+        ds = hf_datasets.load_dataset(path, split=split)
+    return _math_items(ds)
+
+
+@register_dataset("aime")
+@register_dataset("aime24")
+@register_dataset("aime25")
+def _aime(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
+    """AIME competition problems (canonical hub ids
+    AI-MO/aimo-validation-aime, math-ai/aime24/aime25) — pass@k on these
+    is the reference's boba² quality metric (blog/AReaL_v0_3.md)."""
+    import datasets as hf_datasets
+
+    name = path.split("/")[-1].lower() if path else "aime"
+    if name in ("aime", ""):
+        ds = hf_datasets.load_dataset("AI-MO/aimo-validation-aime", split=split)
+    elif name in ("aime24", "aime25"):
+        ds = hf_datasets.load_dataset(f"math-ai/{name}", split=split)
+    else:
+        ds = hf_datasets.load_dataset(path, split=split)
+    return _math_items(ds)
+
+
 class SimpleDataLoader:
     """Minimal stateful dataloader over a dataset (list-like), yielding
     lists of items; replaces torchdata StatefulDataLoader for the TPU build.

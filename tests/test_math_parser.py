@@ -1,45 +1,237 @@
+"""Math answer extraction + equivalence grading.
+
+Parity contract: areal/reward/math_parser.py (+ the vendored latex2sympy
+the reference's evaluation/ uses). The reference parser's dependencies
+(latex2sympy2, word2number, pebble, antlr4) are not installed in this
+environment, so instead of executing it side-by-side, GRADE_PAIRS below
+encodes its documented verdicts for ≥50 curated (prediction, target)
+pairs spanning every capability class VERDICT r3 called out: fractions,
+radicals, intervals, matrices, units, percents, word numbers, equations.
+"""
+
 import pytest
 
 from areal_tpu.reward.math_parser import (
     extract_answer,
     extract_boxed,
     math_equal,
+    math_equal_subprocess,
     math_verify_reward,
+    normalize_answer,
+    parse_number,
+    process_results,
+    word_to_number,
 )
 
+# (prediction, target, expected_equal) — the reference grader's verdicts
+GRADE_PAIRS = [
+    # --- plain numbers ---
+    ("42", "42", True),
+    ("42.0", "42", True),
+    ("042", "42", True),
+    ("1,234,567", "1234567", True),
+    ("-3", "3", False),
+    ("3.14159", "3.1416", True),       # rel_tol 1e-4
+    ("3.14", "3.1416", False),
+    (".5", "0.5", True),
+    # --- percent ambiguity (reference include_percentage) ---
+    ("50%", "0.5", True),
+    ("0.5", "50", True),
+    ("50\\%", "\\frac{1}{2}", True),
+    ("12%", "0.13", False),
+    # --- fractions ---
+    ("\\frac{1}{2}", "1/2", True),
+    ("\\dfrac{3}{4}", "0.75", True),
+    ("\\tfrac12", "\\frac{1}{2}", True),
+    ("\\frac{2}{4}", "\\frac{1}{2}", True),   # symbolic reduce
+    ("-\\frac{1}{3}", "-1/3", True),
+    ("\\frac{1}{3}", "0.3333", True),
+    ("\\frac{1}{3}", "0.3", False),
+    ("7/8", "\\frac{7}{8}", True),
+    ("\\frac{22}{7}", "\\pi", False),          # close but not equal
+    # --- radicals ---
+    ("\\sqrt{8}", "2\\sqrt{2}", True),
+    ("\\sqrt2", "\\sqrt{2}", True),
+    ("\\frac{\\sqrt{3}}{3}", "\\frac{1}{\\sqrt{3}}", True),
+    ("\\sqrt[3]{27}", "3", True),
+    ("\\sqrt{16}", "4", True),
+    ("\\sqrt{5}", "2.2360679", True),
+    ("\\sqrt{5}", "2.23", False),
+    # --- pi / constants ---
+    ("\\frac{\\pi}{4}", "0.7853981", True),
+    ("2\\pi", "6.2831853", True),
+    ("\\pi^2", "9.8696", True),
+    # --- units / decorations ---
+    ("5 \\text{ miles}", "5", True),
+    ("90^\\circ", "90", True),
+    ("\\$15", "15", True),
+    ("15 dollars", "15", True),
+    ("3 \\text{cm}", "3", True),
+    # --- word numbers ---
+    ("twenty-five", "25", True),
+    ("one hundred seven", "107", True),
+    ("eleven", "11", True),
+    # --- variable bindings ---
+    ("x = 7", "7", True),
+    ("k=\\frac{1}{2}", "0.5", True),
+    # --- intervals / tuples (reference compares elementwise; bracket
+    #     style is not distinguished) ---
+    ("[2, 5)", "[2,5)", True),
+    ("(1, 2)", "(1, 2)", True),
+    ("(\\frac{1}{2}, 3)", "(0.5, 3)", True),
+    ("[1, 2]", "[1, 3]", False),
+    ("(-\\infty, 4)", "(-\\infty, 4)", True),
+    ("(2,5)", "(2,4)", False),
+    # --- sets vs bare ---
+    ("{3}", "3", True),
+    ("(4)", "4", True),
+    # --- matrices ---
+    (
+        "\\begin{pmatrix} 1 & 2 \\\\ 3 & 4 \\end{pmatrix}",
+        "\\begin{pmatrix}1&2\\\\3&4\\end{pmatrix}",
+        True,
+    ),
+    (
+        "\\begin{bmatrix} 1 \\\\ \\frac{2}{4} \\end{bmatrix}",
+        "\\begin{pmatrix}1\\\\0.5\\end{pmatrix}",
+        True,
+    ),
+    (
+        "\\begin{pmatrix} 1 & 2 \\\\ 3 & 4 \\end{pmatrix}",
+        "\\begin{pmatrix}1&2\\\\3&5\\end{pmatrix}",
+        False,
+    ),
+    ("\\begin{pmatrix}2\\\\3\\end{pmatrix}", "{2,3}", True),
+    # --- equations ---
+    ("y = 2x + 1", "2x - y + 1 = 0", True),
+    ("x + y = 5", "y = 5 - x", True),
+    ("y = 2x", "y = 3x", False),
+    # --- symbolic expressions ---
+    ("(x+1)^2", "x^2 + 2x + 1", True),
+    ("\\frac{x^2-1}{x-1}", "x+1", True),
+    ("2^{10}", "1024", True),
+    ("x^2", "x^3", False),
+    ("x+1", "1+x", True),
+    # --- choice answers ---
+    ("The answer is (C).", "C", True),
+    ("B", "C", False),
+    # --- strings ---
+    ("\\text{east}", "east", True),
+    ("no solution", "no solution", True),
+]
 
-def test_extract_boxed_balanced():
-    assert extract_boxed(r"so \boxed{42}") == "42"
-    assert extract_boxed(r"\boxed{\frac{1}{2}}") == r"\frac{1}{2}"
-    assert extract_boxed(r"\boxed{a} then \boxed{b}") == "b"
+
+@pytest.mark.parametrize("pred,target,expected", GRADE_PAIRS)
+def test_grade_pairs(pred, target, expected):
+    assert math_equal(pred, target) == expected, (pred, target)
+
+
+def test_pair_count_contract():
+    # VERDICT r3 item 3 asks for >=50 curated pairs
+    assert len(GRADE_PAIRS) >= 50
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+
+def test_extract_boxed_nested():
+    assert extract_boxed(r"so \boxed{\frac{1}{2}}") == r"\frac{1}{2}"
+    assert extract_boxed(r"\boxed{a{b{c}}} and \boxed{7}") == "7"
     assert extract_boxed("no box") is None
 
 
-def test_extract_answer_fallbacks():
+def test_extract_answer_patterns():
+    assert extract_answer(r"blah \boxed{42} done") == "42"
     assert extract_answer("The answer is 17.") == "17"
-    assert extract_answer("blah 3 then 42") == "42"
+    assert (
+        extract_answer("the final answer is $\\frac{3}{4}$. I hope it helps")
+        == r"\frac{3}{4}"
+    )
+    # last-number fallback
+    assert extract_answer("we get 12 then 15") == "15"
     assert extract_answer("nothing here") is None
+    # choice datasets reduce to the letter
+    assert extract_answer("So the answer is (B).", data_name="aqua") == "B"
 
 
-@pytest.mark.parametrize(
-    "a,b,eq",
-    [
-        ("42", "42", True),
-        ("42.0", "42", True),
-        ("1/2", "0.5", True),
-        (r"\frac{1}{2}", "0.5", True),
-        ("1,234", "1234", True),
-        ("41", "42", False),
-        ("x+1", "1+x", True),  # sympy path
-    ],
-)
-def test_math_equal(a, b, eq):
-    assert math_equal(a, b) == eq
+def test_extract_answer_normalizes():
+    assert extract_answer(r"\boxed{\dfrac{1}{2}}") == r"\frac{1}{2}"
+    assert extract_answer(r"\boxed{90^\circ}") == "90"
 
 
-def test_reward_fn():
-    assert math_verify_reward(None, r"... \boxed{10}", answer="10") == 1.0
-    assert math_verify_reward(None, r"... \boxed{11}", answer="10") == 0.0
-    assert math_verify_reward(None, "The answer is 7", answer="#### 7".split("####")[-1].strip()) == 1.0
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+
+def test_word_to_number():
+    assert word_to_number("twenty-five") == 25
+    assert word_to_number("one hundred and seven") == 107
+    assert word_to_number("three thousand forty") == 3040
+    assert word_to_number("banana") is None
+
+
+def test_parse_number():
+    assert parse_number("1,234.5") == 1234.5
+    assert parse_number("50%") == 0.5
+    assert parse_number(r"\frac{3}{4}") == 0.75
+    assert parse_number(r"1\frac{1}{2}") == 1.5
+    assert parse_number("-7/2") == -3.5
+    assert parse_number("x+1") is None
+
+
+def test_normalize_answer():
+    assert normalize_answer(r"\dfrac{1}{2}") == r"\frac{1}{2}"
+    assert normalize_answer(r"\frac12") == r"\frac{1}{2}"
+    assert normalize_answer("5.000") == "5"
+    assert normalize_answer(r"90^\circ") == "90"
+    assert normalize_answer("x = 5") == "5"
+    assert normalize_answer("1,234,567") == "1234567"
+    assert normalize_answer(r"\sqrt5") == r"\sqrt{5}"
+
+
+def test_subprocess_grader():
+    assert math_equal_subprocess("1/2", "0.5", timeout_s=10)
+    assert not math_equal_subprocess("1/2", "0.6", timeout_s=10)
+
+
+def test_process_results():
+    ok, (pred, gt) = process_results(
+        r"...so we find \boxed{\frac{2}{4}}", r"\boxed{\frac{1}{2}}"
+    )
+    assert ok == 1 and pred and gt
+
+
+def test_math_verify_reward():
+    assert math_verify_reward(None, r"hence \boxed{10}", answer="10") == 1.0
+    assert math_verify_reward(None, r"hence \boxed{11}", answer="10") == 0.0
+    assert (
+        math_verify_reward(
+            None, "The answer is 7", answer="#### 7".split("####")[-1].strip()
+        )
+        == 1.0
+    )
     assert math_verify_reward(None, None, answer="1") == 0.0
     assert math_verify_reward(None, "junk", answer=None) == 0.0
+
+
+def test_math_items_schema():
+    """MATH500/AIME loader mapping (network-free via an in-memory HF
+    dataset): problem/solution/answer -> RLVR messages/prompt/answer."""
+    import datasets as hf_datasets
+
+    from areal_tpu.dataset import _math_items
+
+    ds = hf_datasets.Dataset.from_list(
+        [
+            dict(problem="What is 2+2?", solution=r"easy: \boxed{4}", answer="4"),
+            dict(problem="Half?", solution=r"\boxed{\frac{1}{2}}", answer=None),
+        ]
+    )
+    items = list(_math_items(ds))
+    assert items[0]["answer"] == "4"
+    assert items[0]["messages"][0]["content"] == "What is 2+2?"
+    # missing answer field falls back to the solution's boxed value
+    assert items[1]["answer"] == r"\frac{1}{2}"
