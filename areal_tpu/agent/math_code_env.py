@@ -1,0 +1,84 @@
+"""Math+code single-step environment (parity:
+realhf/impl/environment/math_code_single_step_env.py:42
+MathCodeSingleStepEnv).
+
+The env owns per-question metadata (`id2info`: qid -> {"task": "math"|
+"code", ...}) and `step((qid, answers))` dispatches the whole GRPO group
+to the matching verifier:
+
+- math: LaTeX-equivalence grading (areal_tpu.reward.math_parser) against
+  the question's `solutions`;
+- code: the sandboxed subprocess test-case runner
+  (areal_tpu.reward.code_verify) against `input_output` testcases.
+
+Both verifiers run in worker threads so the asyncio rollout loop never
+blocks on sympy or subprocess wall time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from areal_tpu.api.agent_api import EnvironmentService, register_environment
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("math_code_env")
+
+
+class MathCodeSingleStepEnv(EnvironmentService):
+    def __init__(self, id2info: dict[str, dict[str, Any]]):
+        self.id2info = dict(id2info)
+
+    async def reset(self, seed=None, options=None):
+        if options and "id2info" in options:
+            self.id2info = dict(options["id2info"])
+        return None
+
+    async def step(self, action: tuple[str, list[str]]):
+        """action = (qid, group answers) -> (None, [0/1 per answer],
+        True, False, {"task": ...}). Unknown qids raise — a silent zero
+        would poison GRPO advantages with fake all-fail groups."""
+        qid, answers = action
+        qid = str(qid).split("@")[0]
+        info = self.id2info[qid]
+        task = info.get("task", "math")
+        loop = asyncio.get_running_loop()
+        if task == "math":
+            rewards = await loop.run_in_executor(
+                None, self._verify_math, info, list(answers)
+            )
+        elif task == "code":
+            rewards = await loop.run_in_executor(
+                None, self._verify_code, info, list(answers)
+            )
+        else:
+            raise ValueError(f"unknown task {task!r} for qid {qid}")
+        return None, [float(r) for r in rewards], True, False, {"task": task}
+
+    @staticmethod
+    def _verify_math(info: dict, answers: list[str]) -> list[int]:
+        from areal_tpu.reward.math_parser import math_verify_reward
+
+        sols = info.get("solutions") or [info.get("answer", "")]
+        out = []
+        for a in answers:
+            ok = any(
+                math_verify_reward(None, a, answer=s) > 0 for s in sols
+            )
+            out.append(int(ok))
+        return out
+
+    @staticmethod
+    def _verify_code(info: dict, answers: list[str]) -> list[int]:
+        from areal_tpu.reward.code_verify import extract_code, run_problem
+
+        io_spec = info.get("input_output") or {}
+        out = []
+        for a in answers:
+            code = extract_code(a)
+            out.append(int(bool(code) and run_problem(code, io_spec)))
+        return out
+
+
+register_environment("math-code-single-step", MathCodeSingleStepEnv)

@@ -44,3 +44,44 @@ class Agent(abc.ABC):
     ) -> list[dict[str, Any]]:
         """-> list of training rows (input_ids/loss_mask/logprobs/versions/
         rewards per row), possibly empty to reject the episode."""
+
+
+# ---------------------------------------------------------------------------
+# Env registry (parity: realhf/api/core/env_api.py register_environment /
+# make_env) — configs name an env by string; implementations self-register
+# at import time.
+# ---------------------------------------------------------------------------
+
+ALL_ENV_CLASSES: dict[str, type] = {}
+
+
+def register_environment(name: str, env_cls: type) -> None:
+    assert name not in ALL_ENV_CLASSES, f"env {name!r} already registered"
+    assert "/" not in name
+    ALL_ENV_CLASSES[name] = env_cls
+
+
+def make_env(name: str, **kwargs) -> EnvironmentService:
+    """Instantiate a registered environment by name. Built-in envs
+    (agent/ modules) self-register on import; imported lazily here so
+    config-driven callers need no import side effects."""
+    import importlib
+
+    for mod in ("areal_tpu.agent.math_single_step",
+                "areal_tpu.agent.math_code_env"):
+        importlib.import_module(mod)
+    return ALL_ENV_CLASSES[name](**kwargs)
+
+
+class NullEnvironment(EnvironmentService):
+    """No-op env (parity: env_api.py NullEnvironment) for pure-generation
+    agents: step() terminates immediately with zero reward."""
+
+    async def reset(self, seed: int | None = None, options: dict | None = None):
+        return None
+
+    async def step(self, action: Any):
+        return None, 0.0, True, False, {}
+
+
+register_environment("null", NullEnvironment)

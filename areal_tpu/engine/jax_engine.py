@@ -926,7 +926,7 @@ class JaxTrainEngine(TrainEngine):
 
         t_start = time.perf_counter()
         # env-gated device-trace window (AREAL_TPU_XPROF_DIR [+ _STEPS])
-        maybe_xprof_step(self._step_count)
+        maybe_xprof_step(self._step_count, owner=id(self))
         mb_list = split_padded_tensor_dict_into_mb_list(
             input_, self.config.mb_spec
         )

@@ -12,6 +12,7 @@ launching.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 from typing import Any, Callable
 
@@ -19,6 +20,51 @@ from areal_tpu.utils import logging
 from areal_tpu.utils.network import gethostip
 
 logger = logging.getLogger("ray_launcher")
+
+PLACEMENT_GROUP_READY_TIMEOUT = 30.0  # seconds
+
+
+@dataclasses.dataclass
+class PlacementPlan:
+    """Pure description of a PACK placement for a task array (parity:
+    the per-node bundles of areal/launcher/ray.py:172-206): one bundle
+    per node holding that node's aggregate CPU/TPU/memory, plus the
+    bundle index each task rank schedules into. Building the plan is
+    side-effect-free so it unit-tests without a cluster."""
+
+    bundles: list[dict[str, float]]
+    strategy: str
+    bundle_index: list[int]  # per task rank
+
+    @property
+    def nodes(self) -> int:
+        return len(self.bundles)
+
+
+def build_placement_plan(
+    count: int,
+    nodes: int,
+    *,
+    tpus_per_task: int = 0,
+    cpus_per_task: int = 4,
+    mem_mb_per_task: int = 16 * 1024,
+) -> PlacementPlan:
+    if nodes <= 0 or count % nodes != 0:
+        raise ValueError(
+            f"count {count} must be a positive multiple of nodes {nodes}"
+        )
+    tasks_per_node = count // nodes
+    bundle: dict[str, float] = {
+        "CPU": float(cpus_per_task * tasks_per_node),
+        "memory": float(mem_mb_per_task * tasks_per_node * 1024 * 1024),
+    }
+    if tpus_per_task:
+        bundle["TPU"] = float(tpus_per_task * tasks_per_node)
+    return PlacementPlan(
+        bundles=[dict(bundle) for _ in range(nodes)],
+        strategy="PACK",
+        bundle_index=[i // tasks_per_node for i in range(count)],
+    )
 
 
 def _require_ray():
@@ -102,6 +148,53 @@ class RayLauncher:
         self.experiment_name = experiment_name
         self.trial_name = trial_name
         self.refs: dict[str, Any] = {}
+        # PGs cached per array name: a recover-restart of the same trial
+        # reuses the reserved nodes instead of re-queueing behind other
+        # jobs (parity: ray.py:205 "Reuse placement group in recover runs").
+        self.placement_groups: dict[str, Any] = {}
+
+    def _ensure_placement_group(self, name: str, plan: PlacementPlan):
+        """Reserve (or reuse) the PACK placement group for an array.
+
+        Reuse requires the SAME plan — a resubmit with a new topology
+        (scale-up, recover onto different node counts) releases the old
+        reservation instead of scheduling ranks into out-of-range or
+        undersized bundles."""
+        ray = _require_ray()
+        plan_key = (
+            plan.strategy,
+            tuple(tuple(sorted(b.items())) for b in plan.bundles),
+        )
+        cached = self.placement_groups.get(name)
+        if cached is not None:
+            cached_key, pg = cached
+            if cached_key == plan_key:
+                return pg
+            try:
+                ray.util.remove_placement_group(pg)
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+            del self.placement_groups[name]
+        pg = ray.util.placement_group(
+            bundles=plan.bundles, strategy=plan.strategy
+        )
+        try:
+            ray.get(pg.ready(), timeout=PLACEMENT_GROUP_READY_TIMEOUT)
+        except Exception:
+            logger.error(
+                "placement group not ready: the experiment's resource "
+                f"demand ({plan.nodes} nodes x {plan.bundles[0]}) likely "
+                f"exceeds the cluster; ray.nodes(): {ray.nodes()}"
+            )
+            # a pending PG holds its queue position forever; release it so
+            # retries (and other jobs) aren't starved by our own orphans
+            try:
+                ray.util.remove_placement_group(pg)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            raise
+        self.placement_groups[name] = (plan_key, pg)
+        return pg
 
     def submit_array(
         self,
@@ -109,17 +202,34 @@ class RayLauncher:
         fn: Callable,
         count: int,
         *,
+        nodes: int = 1,
         tpus_per_task: int = 0,
         cpus_per_task: int = 4,
         mem_mb_per_task: int = 16 * 1024,
         env_hook: Callable[[int], dict[str, str]] | None = None,
         args: tuple = (),
     ) -> list[Any]:
-        """Run `fn(rank, *args)` as `count` Ray tasks, PACKed per node."""
+        """Run `fn(rank, *args)` as `count` Ray tasks over `nodes` nodes,
+        PACKed via a placement group: each node's tasks land in that
+        node's bundle (bundle_index = rank // tasks_per_node), so a
+        multi-host trainer's ranks are physically adjacent and ICI/DCN
+        topology assumptions hold."""
         ray = _require_ray()
         if not ray.is_initialized():  # pragma: no cover - needs cluster
             ray.init(address=os.environ.get("RAY_ADDRESS", "auto"))
 
+        from ray.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
+        plan = build_placement_plan(
+            count,
+            nodes,
+            tpus_per_task=tpus_per_task,
+            cpus_per_task=cpus_per_task,
+            mem_mb_per_task=mem_mb_per_task,
+        )
+        pg = self._ensure_placement_group(name, plan)
         resources = {"TPU": tpus_per_task} if tpus_per_task else None
         group = f"ray_coord/{name}"
         # Drop any stale coordinator key from a previous run of this trial
@@ -137,10 +247,17 @@ class RayLauncher:
                 memory=mem_mb_per_task * 1024 * 1024,
                 resources=resources,
                 runtime_env={"env_vars": env} if env else None,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg,
+                    placement_group_bundle_index=plan.bundle_index[rank],
+                    placement_group_capture_child_tasks=True,
+                ),
             )(task)
             refs.append(remote_fn.remote(rank, count, *args))
         self.refs[name] = refs
-        logger.info(f"submitted ray array {name} x{count}")
+        logger.info(
+            f"submitted ray array {name} x{count} over {nodes} node bundles"
+        )
         return refs
 
     def wait(self) -> None:
@@ -157,3 +274,9 @@ class RayLauncher:
             for r in refs:
                 ray.cancel(r, force=True)
         self.refs.clear()
+        for _, pg in self.placement_groups.values():
+            try:
+                ray.util.remove_placement_group(pg)
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+        self.placement_groups.clear()

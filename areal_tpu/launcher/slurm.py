@@ -99,6 +99,108 @@ def render_sbatch_script(spec: SlurmJobSpec, log_dir: str) -> str:
     return "\n".join(lines) + "\n"
 
 
+def plan_decoupled_jobs(
+    *,
+    experiment_name: str,
+    trial_name: str,
+    allocation_mode: str,
+    trainer_cmd: str,
+    model_path: str = "",
+    accelerators_per_node: int = 4,
+    cpus_per_task: int = 8,
+    mem_mb: int = 64 * 1024,
+    partition: str | None = None,
+    container_image: str | None = None,
+    container_mounts: str | None = None,
+    trainer_nodelist: str | None = None,
+    server_nodelist: str | None = None,
+    time_limit: str | None = None,
+    name_resolve_env: dict[str, str] | None = None,
+    decode_args: str = "",
+) -> list[SlurmJobSpec]:
+    """Plan the sbatch jobs for one experiment from its allocation mode
+    (parity: the job-array planning of areal/launcher/slurm.py:46):
+    decoupled `jax:dXtY+jax:...` yields one job per decode-server replica
+    (tp chips each), a router job, and a multi-node trainer job; COLOCATE
+    yields the trainer alone. Pure planning — submission is
+    SlurmLauncher.submit — so cluster-shape rendering unit-tests offline.
+    """
+    from areal_tpu.api.alloc_mode import AllocationMode, AllocationType
+
+    alloc = AllocationMode.from_str(allocation_mode)
+    common_env = {
+        "AREAL_EXPERIMENT_NAME": experiment_name,
+        "AREAL_TRIAL_NAME": trial_name,
+        **(name_resolve_env or {}),
+    }
+    jobs: list[SlurmJobSpec] = []
+    if alloc.type_ == AllocationType.DECOUPLED_TRAIN:
+        gen_tp = alloc.gen.tp_size
+        n_servers = alloc.gen.data_parallel_size
+        for i in range(n_servers):
+            cmd = (
+                f"python -m areal_tpu.launcher.decode_server "
+                f"--model-path {model_path} --tp-size {gen_tp} "
+                f"--server-id srv{i}"
+            )
+            if decode_args:
+                cmd += f" {decode_args}"
+            jobs.append(
+                SlurmJobSpec(
+                    name=f"{experiment_name}_{trial_name}:server{i}",
+                    cmd=cmd,
+                    n_nodes=max(1, -(-gen_tp // accelerators_per_node)),
+                    cpus_per_task=cpus_per_task,
+                    mem_mb=mem_mb,
+                    accelerators_per_node=min(gen_tp, accelerators_per_node),
+                    partition=partition,
+                    container_image=container_image,
+                    container_mounts=container_mounts,
+                    nodelist=server_nodelist,
+                    time_limit=time_limit,
+                    env=dict(common_env),
+                )
+            )
+        jobs.append(
+            SlurmJobSpec(
+                name=f"{experiment_name}_{trial_name}:router",
+                cmd=(
+                    "python -m areal_tpu.launcher.router "
+                    f"--experiment-name {experiment_name} "
+                    f"--trial-name {trial_name}"
+                ),
+                n_nodes=1,
+                cpus_per_task=2,
+                mem_mb=4 * 1024,
+                accelerators_per_node=0,
+                partition=partition,
+                container_image=container_image,
+                container_mounts=container_mounts,
+                time_limit=time_limit,
+                env=dict(common_env),
+            )
+        )
+    train_world = alloc.train_world_size
+    trainer_nodes = max(1, -(-train_world // accelerators_per_node))
+    jobs.append(
+        SlurmJobSpec(
+            name=f"{experiment_name}_{trial_name}:trainer",
+            cmd=trainer_cmd,
+            n_nodes=trainer_nodes,
+            cpus_per_task=cpus_per_task,
+            mem_mb=mem_mb,
+            accelerators_per_node=min(train_world, accelerators_per_node),
+            partition=partition,
+            container_image=container_image,
+            container_mounts=container_mounts,
+            nodelist=trainer_nodelist,
+            time_limit=time_limit,
+            env=dict(common_env),
+        )
+    )
+    return jobs
+
+
 class SlurmLauncher:
     def __init__(self, experiment_name: str, trial_name: str, fileroot: str):
         self.experiment_name = experiment_name

@@ -48,6 +48,13 @@ def chunked_label_logprobs(
     `head_is_vh=True` (tied embedding table — avoids transposing it);
     labels: int [T]. `temperature` divides logits before the softmax,
     matching gather_logprobs' convention.
+
+    Label-range contract: labels outside [0, V) fall in no vocab chunk,
+    so their picked-logit term is 0 and the returned logp degrades to
+    -logsumexp. This mirrors the dense path's take_along_axis clamp —
+    out-of-range labels are the CALLER's bug (padding rows must be masked
+    by loss_mask, not given sentinel label ids) and are deliberately not
+    asserted here, since a device-side check would sync every step.
     """
     T = hidden.shape[0]
     V = head_w.shape[0] if head_is_vh else head_w.shape[1]

@@ -94,15 +94,18 @@ _UNIT_TEXTS = [
     "second", "sec", "days", "day", "weeks", "week", "months", "month",
     "years", "year", "yr",
     "apples", "apple", "people", "men", "man", "women", "woman",
-    "students", "student", "ways", "way", "times",
+    "students", "student", "ways", "way",
 ]
 # longest first so "meters" wins over "m"
 _UNIT_TEXTS.sort(key=len, reverse=True)
 
 
 def _strip_units(s: str) -> str:
+    # (?<![\\A-Za-z]) guards LaTeX commands: "min"/"sec"/"deg" must not
+    # eat \min, \sec^2, \deg — a backslash or letter before the word means
+    # it is (part of) a command, not a unit suffix.
     for u in _UNIT_TEXTS:
-        s = re.sub(rf"(^|[\W\d]){re.escape(u)}($|\W)", r"\1\2", s)
+        s = re.sub(rf"(?<![\\A-Za-z]){re.escape(u)}(?![A-Za-z])", "", s)
     return s
 
 

@@ -174,7 +174,11 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-_xprof_state = {"active": False, "done": False}
+# jax.profiler supports ONE process-global trace; "owner" records which
+# engine claimed the window so co-resident engines (PPO actor + critic
+# both call maybe_xprof_step from train_batch) cannot flush or skew each
+# other's capture: the first engine to reach the start step owns it.
+_xprof_state = {"active": False, "done": False, "owner": None}
 
 
 def _xprof_flush() -> None:
@@ -183,15 +187,20 @@ def _xprof_flush() -> None:
 
         jax.profiler.stop_trace()
         _xprof_state["active"] = False
+        _xprof_state["owner"] = None
         _xprof_state["done"] = True
 
 
-def maybe_xprof_step(step: int) -> None:
+def maybe_xprof_step(step: int, owner: object = None) -> None:
     """Env-gated capture window for training loops: with
     AREAL_TPU_XPROF_DIR set, starts a jax.profiler trace at the first step
     of AREAL_TPU_XPROF_STEPS (default "2-4", inclusive, after warmup
     compiles) and stops it after the last. Called by the train engine at
-    the top of every train_batch; free when the env var is unset."""
+    the top of every train_batch; free when the env var is unset.
+
+    `owner` identifies the calling engine; the window is claimed by the
+    first owner to reach the start step and only that owner's step counter
+    advances/ends it."""
     import jax
 
     target = os.environ.get("AREAL_TPU_XPROF_DIR")
@@ -203,8 +212,13 @@ def maybe_xprof_step(step: int) -> None:
         os.makedirs(target, exist_ok=True)
         jax.profiler.start_trace(target)
         _xprof_state["active"] = True
+        _xprof_state["owner"] = owner
         # short runs (or a crash mid-window) never see a step > hi call;
         # flush at exit so the capture is not silently lost
         atexit.register(_xprof_flush)
-    elif _xprof_state["active"] and step > hi:
+    elif (
+        _xprof_state["active"]
+        and step > hi
+        and _xprof_state["owner"] == owner
+    ):
         _xprof_flush()

@@ -18,8 +18,10 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import uuid
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -66,14 +68,25 @@ def run_python_tool(
     """Execute `code` in a fresh python subprocess under rlimits (CPU,
     memory, nproc) in its own session; the whole process GROUP is killed on
     timeout, so spawned grandchildren holding the output pipe cannot stall
-    the rollout loop past the deadline. Returns stdout+stderr, truncated."""
+    the rollout loop past the deadline. Returns stdout+stderr, truncated.
+
+    Threat model: RESOURCE isolation only, same as the reference's
+    PythonExecutor (examples/tir/tools/python_code.py) — the policy model
+    is assumed trusted-but-buggy, not adversarial. `-I` (isolated mode)
+    keeps the repo and cwd off sys.path and env vars out, and the child
+    runs in a throwaway tempdir, but it retains the training user's
+    filesystem and network access. Untrusted-model deployments need an
+    external sandbox (container/jail) around the whole rollout worker.
+    """
     proc = None
+    workdir = tempfile.mkdtemp(prefix="tir_tool_")
     try:
         proc = subprocess.Popen(
-            [sys.executable, "-E", "-c", code],
+            [sys.executable, "-I", "-c", code],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
+            cwd=workdir,
             preexec_fn=_tool_rlimits(timeout_seconds),
         )
         out, _ = proc.communicate(timeout=timeout_seconds)
@@ -90,6 +103,7 @@ def run_python_tool(
             except (ProcessLookupError, PermissionError):
                 proc.kill()
             proc.wait()
+        shutil.rmtree(workdir, ignore_errors=True)
     if len(out) > max_output_chars:
         out = out[:max_output_chars] + "...(truncated)\n"
     if not out.endswith("\n"):

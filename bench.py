@@ -796,7 +796,28 @@ def main() -> None:
     }
     if "step_time_s" in train:
         detail["step_time_s"] = round(train["step_time_s"], 3)
-    _emit(metric, train["mfu"], detail)
+    if mode in ("all", "train"):
+        _emit(metric, train["mfu"], detail)
+    else:
+        # dev modes skip the trainer: emitting the MFU metric as 0.0 would
+        # read as a catastrophic regression. Headline the mode's own number.
+        headline = {
+            "decode": ("decode_tokens_per_sec_per_chip", "tok/s/chip"),
+            "prefix": ("prefix_share_speedup", "x"),
+            "grpo": ("grpo_samples_per_sec_per_chip", "samples/s/chip"),
+        }[mode]
+        print(
+            json.dumps(
+                {
+                    "metric": f"bench_{mode}_{'cpu_smoke' if not on_accel else 'tpu'}",
+                    "value": round(float(decode.get(headline[0], 0.0)), 4),
+                    "unit": headline[1],
+                    "vs_baseline": 0.0,
+                    "detail": detail,
+                }
+            ),
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

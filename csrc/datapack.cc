@@ -61,19 +61,22 @@ int64_t partition_balanced_native(const int64_t* nums, int64_t n, int64_t k,
   if (k <= 0 || n < k * min_size) return -1;
   std::vector<int64_t> prefix(n + 1, 0);
   for (int64_t i = 0; i < n; ++i) prefix[i + 1] = prefix[i] + nums[i];
-  const double INF = std::numeric_limits<double>::infinity();
+  // Exact int64 arithmetic: piece sums are integral, and double would
+  // lose exact tie-breaking once sums pass 2^53. INT64_MAX is the
+  // unreachable sentinel (piece sums are < it by construction).
+  const int64_t INF = std::numeric_limits<int64_t>::max();
   // dp[j*(n+1)+i]: minimal max-sum splitting first i items into j pieces
-  std::vector<double> dp((k + 1) * (n + 1), INF);
+  std::vector<int64_t> dp((k + 1) * (n + 1), INF);
   std::vector<int64_t> choice((k + 1) * (n + 1), 0);
-  dp[0] = 0.0;
+  dp[0] = 0;
   for (int64_t j = 1; j <= k; ++j) {
     for (int64_t i = j * min_size; i <= n; ++i) {
-      double best = INF;
+      int64_t best = INF;
       int64_t best_t = 0;
       for (int64_t t = (j - 1) * min_size; t <= i - min_size; ++t) {
-        const double prev = dp[(j - 1) * (n + 1) + t];
-        const double piece = static_cast<double>(prefix[i] - prefix[t]);
-        const double cand = prev > piece ? prev : piece;
+        const int64_t prev = dp[(j - 1) * (n + 1) + t];
+        const int64_t piece = prefix[i] - prefix[t];
+        const int64_t cand = prev > piece ? prev : piece;
         if (cand < best) {
           best = cand;
           best_t = t;

@@ -129,3 +129,75 @@ def test_dataset_registry_mappers():
         ds2 = _REGISTRY["torl_data"](path="x/torl_data", split="train", type="rl")
     assert ds2[0]["messages"][0]["content"] == "2+2?"
     assert ds2[0]["answer"] == "4"
+
+
+def test_env_registry_and_null_env():
+    """Env registry parity (realhf/api/core/env_api.py): envs resolve by
+    name; the null env terminates immediately."""
+    from areal_tpu.api.agent_api import ALL_ENV_CLASSES, make_env
+
+    env = make_env("null")
+    obs, reward, term, trunc, info = asyncio.run(env.step("anything"))
+    assert term and not trunc and reward == 0.0
+    # lazy import registered the built-in envs too
+    assert "math-code-single-step" in ALL_ENV_CLASSES
+
+
+def test_math_code_env_obs_act_queues():
+    """Drive the math+code env through obs/act queues the way the
+    reference RolloutWorker does (parity:
+    realhf/impl/environment/math_code_single_step_env.py): the agent side
+    pushes (qid, answers) actions, the env side pushes observations
+    (reward groups) back."""
+    from areal_tpu.api.agent_api import make_env
+
+    id2info = {
+        "q-math": dict(task="math", solutions=[r"\boxed{\frac{1}{2}}"]),
+        "q-code": dict(
+            task="code",
+            input_output=dict(
+                inputs=["3 4\n"], outputs=["7\n"], fn_name=""
+            ),
+        ),
+    }
+    env = make_env("math-code-single-step", id2info=id2info)
+
+    async def run():
+        act_q: asyncio.Queue = asyncio.Queue()
+        obs_q: asyncio.Queue = asyncio.Queue()
+
+        async def env_loop():
+            await env.reset()
+            while True:
+                action = await act_q.get()
+                if action is None:
+                    return
+                obs = await env.step(action)
+                await obs_q.put(obs)
+
+        loop_task = asyncio.create_task(env_loop())
+        # math group: one right (equivalent fraction), one wrong
+        await act_q.put(
+            ("q-math@0", ["the answer is $\\frac{2}{4}$... \\boxed{2/4}",
+                          "\\boxed{3}"])
+        )
+        _, rewards, term, _, info = await obs_q.get()
+        assert rewards == [1.0, 0.0] and term and info["task"] == "math"
+        # code group: one program that passes the testcase, one that fails
+        good = "```python\na, b = map(int, input().split())\nprint(a + b)\n```"
+        bad = "```python\nprint(0)\n```"
+        await act_q.put(("q-code", [f"reasoning... {good}", bad]))
+        _, rewards, term, _, info = await obs_q.get()
+        assert rewards == [1.0, 0.0] and term and info["task"] == "code"
+        await act_q.put(None)
+        await loop_task
+
+    asyncio.run(run())
+
+
+def test_math_code_env_unknown_qid_raises():
+    from areal_tpu.api.agent_api import make_env
+
+    env = make_env("math-code-single-step", id2info={})
+    with pytest.raises(KeyError):
+        asyncio.run(env.step(("missing", ["x"])))

@@ -1,0 +1,237 @@
+"""Fleet-launcher planning: ray placement groups (mocked cluster) and
+slurm decoupled-allocation sbatch plans (parity: areal/launcher/ray.py:68,
+328 placement-group PACK scheduling; areal/launcher/slurm.py:46 job
+planning). No cluster needed — plans are pure and ray is stubbed."""
+
+import sys
+import types
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from areal_tpu.launcher.ray import PlacementPlan, build_placement_plan
+from areal_tpu.launcher.slurm import plan_decoupled_jobs, render_sbatch_script
+
+
+# ---------------------------------------------------------------------------
+# placement plan (pure)
+# ---------------------------------------------------------------------------
+
+
+def test_build_placement_plan_pack():
+    plan = build_placement_plan(
+        8, 2, tpus_per_task=1, cpus_per_task=4, mem_mb_per_task=1024
+    )
+    assert plan.strategy == "PACK"
+    assert plan.nodes == 2
+    # per-node bundle aggregates that node's 4 tasks
+    assert plan.bundles[0] == {
+        "CPU": 16.0,
+        "memory": float(4 * 1024 * 1024 * 1024),
+        "TPU": 4.0,
+    }
+    # ranks fill node 0 first, then node 1 (adjacency for ICI/DCN)
+    assert plan.bundle_index == [0, 0, 0, 0, 1, 1, 1, 1]
+
+
+def test_build_placement_plan_rejects_ragged():
+    with pytest.raises(ValueError):
+        build_placement_plan(5, 2)
+    with pytest.raises(ValueError):
+        build_placement_plan(4, 0)
+
+
+# ---------------------------------------------------------------------------
+# mocked-ray submit_array + coordinator rendezvous
+# ---------------------------------------------------------------------------
+
+
+class _FakePG:
+    def __init__(self, bundles, strategy):
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self):
+        return "ready-ref"
+
+
+class _FakeStrategy:
+    def __init__(self, placement_group, placement_group_bundle_index,
+                 placement_group_capture_child_tasks):
+        self.pg = placement_group
+        self.bundle_index = placement_group_bundle_index
+        self.capture = placement_group_capture_child_tasks
+
+
+def _install_fake_ray(monkeypatch, record):
+    """A minimal `ray` that executes tasks on a thread pool so the real
+    coordinator rendezvous (name_resolve) runs across 'ranks'."""
+    pool = ThreadPoolExecutor(max_workers=8)
+
+    ray = types.ModuleType("ray")
+    ray_util = types.ModuleType("ray.util")
+    ray_sched = types.ModuleType("ray.util.scheduling_strategies")
+    ray_sched.PlacementGroupSchedulingStrategy = _FakeStrategy
+    ray_util.scheduling_strategies = ray_sched
+
+    def placement_group(bundles, strategy):
+        pg = _FakePG(bundles, strategy)
+        record["pgs"].append(pg)
+        return pg
+
+    ray_util.placement_group = placement_group
+    ray_util.remove_placement_group = lambda pg: record["removed"].append(pg)
+    ray.util = ray_util
+    ray.is_initialized = lambda: True
+    ray.nodes = lambda: []
+
+    def ray_get(ref_or_list, timeout=None):
+        if ref_or_list == "ready-ref":
+            return True
+        return [f.result(timeout=60) for f in ref_or_list]
+
+    ray.get = ray_get
+    ray.cancel = lambda ref, force=False: None
+
+    def remote(**opts):
+        def deco(fn):
+            class Remote:
+                def remote(self, *args):
+                    record["tasks"].append(opts)
+                    return pool.submit(fn, *args)
+
+            return Remote()
+
+        return deco
+
+    ray.remote = remote
+    monkeypatch.setitem(sys.modules, "ray", ray)
+    monkeypatch.setitem(sys.modules, "ray.util", ray_util)
+    monkeypatch.setitem(sys.modules, "ray.util.scheduling_strategies", ray_sched)
+    return ray
+
+
+def test_ray_submit_array_placement_and_rendezvous(monkeypatch):
+    from areal_tpu.launcher.ray import RayLauncher
+    from areal_tpu.utils import name_resolve
+
+    name_resolve.reconfigure(name_resolve.NameResolveConfig(type="memory"))
+    record = {"pgs": [], "tasks": [], "removed": []}
+    _install_fake_ray(monkeypatch, record)
+
+    got = []
+
+    def fn(rank, marker):
+        # the dist wrapper resolved + exported the coordinator before us
+        import os
+
+        got.append((rank, os.environ["AREAL_TPU_COORDINATOR"], marker))
+        return rank
+
+    launcher = RayLauncher("rexp", "rt")
+    refs = launcher.submit_array(
+        "trainer",
+        fn,
+        count=4,
+        nodes=2,
+        tpus_per_task=1,
+        cpus_per_task=2,
+        mem_mb_per_task=512,
+        env_hook=lambda rank: {"RANK_HINT": str(rank)},
+        args=("m",),
+    )
+    import ray as fake_ray
+
+    results = fake_ray.get(refs)
+    assert sorted(results) == [0, 1, 2, 3]
+
+    # one PACK placement group with 2 node bundles, each 2 tasks' worth
+    assert len(record["pgs"]) == 1
+    pg = record["pgs"][0]
+    assert pg.strategy == "PACK"
+    assert len(pg.bundles) == 2 and pg.bundles[0]["TPU"] == 2.0
+
+    # every task scheduled into its node's bundle with capture enabled
+    strategies = [t["scheduling_strategy"] for t in record["tasks"]]
+    assert [s.bundle_index for s in strategies] == [0, 0, 1, 1]
+    assert all(s.pg is pg and s.capture for s in strategies)
+    # env hook flowed into runtime_env per rank
+    envs = [t["runtime_env"]["env_vars"]["RANK_HINT"] for t in record["tasks"]]
+    assert envs == ["0", "1", "2", "3"]
+
+    # all ranks agreed on ONE coordinator (rank 0 published, others waited)
+    coords = {c for _, c, _ in got}
+    assert len(coords) == 1 and ":" in next(iter(coords))
+
+    # recover path: same name + same plan reuses the PG
+    launcher.submit_array(
+        "trainer", fn, count=4, nodes=2, tpus_per_task=1,
+        cpus_per_task=2, mem_mb_per_task=512,
+    )
+    assert len(record["pgs"]) == 1
+    # a CHANGED topology must release the old reservation, not reuse it
+    launcher.submit_array(
+        "trainer", fn, count=8, nodes=2, tpus_per_task=1,
+        cpus_per_task=2, mem_mb_per_task=512,
+    )
+    assert len(record["pgs"]) == 2 and record["removed"] == [pg]
+    launcher.stop_all()
+    assert record["removed"] == [pg, record["pgs"][1]]
+
+
+# ---------------------------------------------------------------------------
+# slurm decoupled plan
+# ---------------------------------------------------------------------------
+
+
+def test_slurm_decoupled_plan_two_node():
+    jobs = plan_decoupled_jobs(
+        experiment_name="exp",
+        trial_name="t0",
+        allocation_mode="jax:d2t2+jax:d8",
+        trainer_cmd="python -m examples.gsm8k_grpo --config c.yaml",
+        model_path="/models/qwen",
+        accelerators_per_node=4,
+        partition="tpu-v5p",
+        container_image="ghcr.io/org/areal-tpu:latest",
+        container_mounts="/data:/data",
+        trainer_nodelist="tpu-[01-02]",
+        name_resolve_env={"AREAL_NAME_RESOLVE_TYPE": "nfs"},
+    )
+    by_name = {j.name.split(":")[-1]: j for j in jobs}
+    assert set(by_name) == {"server0", "server1", "router", "trainer"}
+
+    # 2 decode replicas, tp=2 chips each, one node apiece
+    s0 = by_name["server0"]
+    assert s0.accelerators_per_node == 2 and s0.n_nodes == 1
+    assert "--tp-size 2" in s0.cmd and "/models/qwen" in s0.cmd
+    assert s0.env["AREAL_NAME_RESOLVE_TYPE"] == "nfs"
+
+    # trainer: d8 over 4-chip nodes -> 2 nodes, gres tpu:4, pinned nodelist
+    tr = by_name["trainer"]
+    assert tr.n_nodes == 2 and tr.accelerators_per_node == 4
+    script = render_sbatch_script(tr, "/tmp/logs")
+    assert "#SBATCH --nodes=2" in script
+    assert "#SBATCH --gres=tpu:4" in script
+    assert "#SBATCH --partition=tpu-v5p" in script
+    assert "#SBATCH --nodelist=tpu-[01-02]" in script
+    assert "--container-image=ghcr.io/org/areal-tpu:latest" in script
+    assert "--container-mounts=/data:/data" in script
+    assert "export AREAL_EXPERIMENT_NAME=exp" in script
+    # rendezvous env renders inside the srun task, not the batch shell
+    assert "AREAL_TPU_PROCESS_ID=$SLURM_PROCID" in script
+
+    # router is accelerator-free
+    assert by_name["router"].accelerators_per_node == 0
+
+
+def test_slurm_colocate_plan_trainer_only():
+    jobs = plan_decoupled_jobs(
+        experiment_name="exp",
+        trial_name="t1",
+        allocation_mode="d4t2",
+        trainer_cmd="python train.py",
+        accelerators_per_node=8,
+    )
+    assert len(jobs) == 1
+    assert jobs[0].n_nodes == 1 and jobs[0].accelerators_per_node == 8

@@ -57,6 +57,9 @@ GRADE_PAIRS = [
     ("\\sqrt{16}", "4", True),
     ("\\sqrt{5}", "2.2360679", True),
     ("\\sqrt{5}", "2.23", False),
+    # --- scientific notation / latex operators must survive unit strip ---
+    ("9 \\times 10^8", "900000000", True),
+    ("3 \\times 4", "12", True),
     # --- pi / constants ---
     ("\\frac{\\pi}{4}", "0.7853981", True),
     ("2\\pi", "6.2831853", True),
