@@ -609,8 +609,34 @@ class JaxDecodeEngine(InferenceEngine):
             self.mesh, P(None, None, None, kv_axis, None)
         )
 
-    def _get_chunk_fn(self, use_topp: bool, use_freq: bool = False):
+    def _chunk_bucket(self) -> int:
+        """Smallest KV bucket covering every slot through this chunk.
+        Attention cost per decode step is O(R x S_bucket): with the
+        default 32k context, short rollouts would otherwise pay full-32k
+        attention every token. Buckets are geometric so the jit cache
+        stays small, and rows live at positions [0, length) for every
+        slot, so slicing the FIRST bucket rows is always sufficient.
+
+        The max is over ALL slots, not just active ones: decode_step
+        writes (harmlessly, at a fixed position) even for inactive slots,
+        and a parked slot with length >= bucket would have that write
+        clamped onto its last in-bucket row — corrupting KV a resume
+        still needs."""
+        S = self.config.context_length
+        needed = int(self._slot_lengths.max()) + self.config.new_tokens_per_chunk + 1
+        b = 256
+        while b < needed:
+            b *= 2
+        return min(b, S)
+
+    def _get_chunk_fn(self, use_topp: bool, use_freq: bool = False,
+                      s_bucket: int | None = None):
         """Chunked decode loop; static sampler variants.
+
+        `s_bucket` (None = full context): the scan runs on a
+        [L, R, s_bucket] slice of the KV cache and writes it back — one
+        extra slice copy per chunk buys n_chunk decode steps attending
+        over s_bucket rows instead of the full context.
 
         `use_topp=False` (the common RL rollout setting, top_p == 1):
         plain categorical over temperature-scaled logits. `use_topp=True`:
@@ -624,11 +650,13 @@ class JaxDecodeEngine(InferenceEngine):
         penalty * per-token generation counts); the [R, V] count buffer
         only exists for batches where some slot requested it.
         """
-        key_ = (use_topp, use_freq)
+        key_ = (use_topp, use_freq, s_bucket)
         if key_ in self._chunk_fns:
             return self._chunk_fns[key_]
         cfg = self.model_config
         n_chunk = self.config.new_tokens_per_chunk
+        S_full = self.config.context_length
+        sliced = s_bucket is not None and s_bucket < S_full
 
         def sample(logits, key, temps, top_ps, greedy):
             logits = logits.astype(jnp.float32)
@@ -664,6 +692,18 @@ class JaxDecodeEngine(InferenceEngine):
             def chunk(params, kc, vc, last_tokens, lengths, active, key,
                       temps, top_ps, greedy, rope_delta, *freq_args):
                 freq_pens, counts0 = freq_args if freq else (None, None)
+                if sliced:
+                    # carve the live prefix of the cache: one slice copy
+                    # buys n_chunk steps of O(s_bucket) attention instead
+                    # of O(context_length)
+                    kc_full, vc_full = kc, vc
+                    L, R, _, nkv, hd = kc.shape
+                    kc = jax.lax.slice(
+                        kc, (0, 0, 0, 0, 0), (L, R, s_bucket, nkv, hd)
+                    )
+                    vc = jax.lax.slice(
+                        vc, (0, 0, 0, 0, 0), (L, R, s_bucket, nkv, hd)
+                    )
 
                 def step(carry, _):
                     tokens, lengths, kc, vc, key, counts = carry
@@ -689,6 +729,13 @@ class JaxDecodeEngine(InferenceEngine):
                 (last, lengths, kc, vc, key, counts), (toks, logps) = (
                     jax.lax.scan(step, init, None, length=n_chunk)
                 )
+                if sliced:
+                    kc = jax.lax.dynamic_update_slice(
+                        kc_full, kc, (0, 0, 0, 0, 0)
+                    )
+                    vc = jax.lax.dynamic_update_slice(
+                        vc_full, vc, (0, 0, 0, 0, 0)
+                    )
                 if freq:
                     return kc, vc, last, lengths, key, toks, logps, counts
                 return kc, vc, last, lengths, key, toks, logps
@@ -1159,7 +1206,9 @@ class JaxDecodeEngine(InferenceEngine):
                 for s in self._slots
             )
         )
-        chunk_fn = self._get_chunk_fn(use_topp, use_freq)
+        chunk_fn = self._get_chunk_fn(
+            use_topp, use_freq, self._chunk_bucket()
+        )
         version_at_chunk = self._version
         chunk_t0 = time.monotonic()
         with self._weight_lock:

@@ -202,34 +202,41 @@ class RayLauncher:
         fn: Callable,
         count: int,
         *,
-        nodes: int = 1,
+        nodes: int | None = None,
         tpus_per_task: int = 0,
         cpus_per_task: int = 4,
         mem_mb_per_task: int = 16 * 1024,
         env_hook: Callable[[int], dict[str, str]] | None = None,
         args: tuple = (),
     ) -> list[Any]:
-        """Run `fn(rank, *args)` as `count` Ray tasks over `nodes` nodes,
-        PACKed via a placement group: each node's tasks land in that
-        node's bundle (bundle_index = rank // tasks_per_node), so a
-        multi-host trainer's ranks are physically adjacent and ICI/DCN
-        topology assumptions hold."""
+        """Run `fn(rank, *args)` as `count` Ray tasks.
+
+        With `nodes` set, tasks are PACKed via a placement group: each
+        node's tasks land in that node's bundle (bundle_index =
+        rank // tasks_per_node), so a multi-host trainer's ranks are
+        physically adjacent and ICI/DCN topology assumptions hold. With
+        `nodes=None` (default) Ray schedules by plain per-task resource
+        requests — callers who don't know the cluster shape must not be
+        forced into a single-node bundle that can never become ready."""
         ray = _require_ray()
         if not ray.is_initialized():  # pragma: no cover - needs cluster
             ray.init(address=os.environ.get("RAY_ADDRESS", "auto"))
 
-        from ray.util.scheduling_strategies import (
-            PlacementGroupSchedulingStrategy,
-        )
+        pg = None
+        plan = None
+        if nodes is not None:
+            from ray.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy,
+            )
 
-        plan = build_placement_plan(
-            count,
-            nodes,
-            tpus_per_task=tpus_per_task,
-            cpus_per_task=cpus_per_task,
-            mem_mb_per_task=mem_mb_per_task,
-        )
-        pg = self._ensure_placement_group(name, plan)
+            plan = build_placement_plan(
+                count,
+                nodes,
+                tpus_per_task=tpus_per_task,
+                cpus_per_task=cpus_per_task,
+                mem_mb_per_task=mem_mb_per_task,
+            )
+            pg = self._ensure_placement_group(name, plan)
         resources = {"TPU": tpus_per_task} if tpus_per_task else None
         group = f"ray_coord/{name}"
         # Drop any stale coordinator key from a previous run of this trial
@@ -242,21 +249,24 @@ class RayLauncher:
         refs = []
         for rank in range(count):
             env = dict(env_hook(rank)) if env_hook is not None else {}
-            remote_fn = ray.remote(
+            opts: dict[str, Any] = dict(
                 num_cpus=cpus_per_task,
                 memory=mem_mb_per_task * 1024 * 1024,
                 resources=resources,
                 runtime_env={"env_vars": env} if env else None,
-                scheduling_strategy=PlacementGroupSchedulingStrategy(
+            )
+            if pg is not None:
+                opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
                     placement_group=pg,
                     placement_group_bundle_index=plan.bundle_index[rank],
                     placement_group_capture_child_tasks=True,
-                ),
-            )(task)
+                )
+            remote_fn = ray.remote(**opts)(task)
             refs.append(remote_fn.remote(rank, count, *args))
         self.refs[name] = refs
         logger.info(
-            f"submitted ray array {name} x{count} over {nodes} node bundles"
+            f"submitted ray array {name} x{count}"
+            + (f" over {nodes} node bundles" if pg is not None else "")
         )
         return refs
 

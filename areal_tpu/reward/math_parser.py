@@ -94,7 +94,7 @@ _UNIT_TEXTS = [
     "second", "sec", "days", "day", "weeks", "week", "months", "month",
     "years", "year", "yr",
     "apples", "apple", "people", "men", "man", "women", "woman",
-    "students", "student", "ways", "way",
+    "students", "student", "ways", "way", "times",
 ]
 # longest first so "meters" wins over "m"
 _UNIT_TEXTS.sort(key=len, reverse=True)

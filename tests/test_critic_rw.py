@@ -4,6 +4,8 @@ areal/engine/rw/rw_engine.py)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from areal_tpu.api.alloc_mode import ParallelStrategy
 from areal_tpu.api.cli_args import (
     MicroBatchSpec,

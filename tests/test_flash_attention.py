@@ -107,6 +107,7 @@ def test_segment_isolation():
     assert not np.allclose(np.asarray(out[: T // 2]), np.asarray(out2[: T // 2]))
 
 
+@pytest.mark.slow
 def test_model_forward_flash_vs_dense():
     # Full decoder forward parity between attention implementations.
     from areal_tpu.models.qwen2 import (

@@ -369,6 +369,7 @@ def test_prefill_budget_bounds_admission(cpu_devices):
         eng.destroy()
 
 
+@pytest.mark.slow
 def test_stop_strings(cpu_devices):
     """Stop STRINGS (gconfig.stop) truncate generation at the earliest
     token boundary whose decoded prefix contains the string."""
@@ -450,6 +451,7 @@ def test_frequency_penalty_reduces_repeats(cpu_devices):
     assert uniq_pen > uniq_base, (uniq_base, uniq_pen)
 
 
+@pytest.mark.slow
 def test_decode_under_foreign_global_mesh(cpu_devices):
     """Regression: a decode engine must trace against ITS OWN mesh even when
     another engine (the COLOCATE train engine) has installed a different
@@ -563,5 +565,37 @@ def test_prefix_fork_group_decode(cpu_devices):
         r = eng.generate(ModelRequest(input_ids=list(prompt), gconfig=g), timeout=600)
         assert r.output_tokens == expected
         assert eng._n_prefills == 2
+    finally:
+        eng.destroy()
+
+
+@pytest.mark.slow
+def test_bucketed_chunk_attention_parity(cpu_devices):
+    """Length-bucketed decode: with a large context_length the chunk fn
+    runs on a sliced KV bucket (256 rows here) instead of the full cache;
+    outputs must exactly match the dense greedy reference."""
+    cfg = JaxDecodeConfig(
+        context_length=2048,
+        max_running_requests=2,
+        new_tokens_per_chunk=8,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    try:
+        prompt = [1, 5, 9, 13, 2, 7]
+        n_new = 10
+        resp = eng.generate(
+            ModelRequest(
+                input_ids=list(prompt),
+                gconfig=GenerationHyperparameters(greedy=True, max_new_tokens=n_new),
+            ),
+            timeout=600,
+        )
+        assert resp.output_tokens == greedy_reference(eng.params, prompt, n_new)
+        # the sliced variant (bucket < context) actually compiled and ran
+        assert any(k[2] == 256 for k in eng._chunk_fns), eng._chunk_fns.keys()
     finally:
         eng.destroy()

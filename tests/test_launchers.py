@@ -67,6 +67,7 @@ def _install_fake_ray(monkeypatch, record):
     """A minimal `ray` that executes tasks on a thread pool so the real
     coordinator rendezvous (name_resolve) runs across 'ranks'."""
     pool = ThreadPoolExecutor(max_workers=8)
+    record["pool"] = pool
 
     ray = types.ModuleType("ray")
     ray_util = types.ModuleType("ray.util")
@@ -111,12 +112,37 @@ def _install_fake_ray(monkeypatch, record):
     return ray
 
 
-def test_ray_submit_array_placement_and_rendezvous(monkeypatch):
+@pytest.fixture
+def _clean_dist_env():
+    """The dist task wrapper exports AREAL_TPU_* into os.environ (the
+    fake ray runs tasks in this process's threads); drain the task pool,
+    then scrub, so later engine tests don't try to join a phantom
+    jax.distributed cluster."""
+    import os
+
+    keys = ("AREAL_TPU_NUM_PROCESSES", "AREAL_TPU_PROCESS_ID",
+            "AREAL_TPU_COORDINATOR")
+    saved = {k: os.environ.get(k) for k in keys}
+    record: dict = {}
+    yield record
+    pool = record.get("pool")
+    if pool is not None:
+        # in-flight tasks re-export the env as they start; wait them out
+        pool.shutdown(wait=True)
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def test_ray_submit_array_placement_and_rendezvous(monkeypatch, _clean_dist_env):
     from areal_tpu.launcher.ray import RayLauncher
     from areal_tpu.utils import name_resolve
 
     name_resolve.reconfigure(name_resolve.NameResolveConfig(type="memory"))
-    record = {"pgs": [], "tasks": [], "removed": []}
+    record = _clean_dist_env
+    record.update({"pgs": [], "tasks": [], "removed": []})
     _install_fake_ray(monkeypatch, record)
 
     got = []

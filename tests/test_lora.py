@@ -187,6 +187,7 @@ def test_lora_zero_init_matches_base_forward():
     )
 
 
+@pytest.mark.slow
 def test_lora_activation_delta_equals_weight_merge():
     cfg = ModelConfig(
         vocab_size=64,

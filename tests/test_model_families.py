@@ -12,6 +12,8 @@ import json
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax
 
 from areal_tpu.models.hf_io import load_hf_params, save_hf_params

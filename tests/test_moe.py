@@ -104,6 +104,7 @@ def test_moe_capacity_drops_tokens():
     assert nonzero_rows <= 2 * E  # at most C(=1) tokens per expert survive
 
 
+@pytest.mark.slow
 def test_moe_forward_and_grad_finite():
     params = init_params(MOE_CFG, jax.random.PRNGKey(0))
     T = 32

@@ -10,6 +10,8 @@ same spliced embeddings and m-rope tables.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 

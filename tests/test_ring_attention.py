@@ -22,6 +22,7 @@ def sp_mesh(cpu_devices):
     mesh_lib.set_current_mesh(None)
 
 
+@pytest.mark.slow
 def test_ring_matches_dense(sp_mesh):
     # ring over dp*sp = 4 shards, tp=2 sharding the 4 query heads.
     T, nH, nKV, hd = 512, 4, 2, 32
