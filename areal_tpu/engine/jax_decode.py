@@ -609,21 +609,21 @@ class JaxDecodeEngine(InferenceEngine):
             self.mesh, P(None, None, None, kv_axis, None)
         )
 
-    def _chunk_bucket(self) -> int:
-        """Smallest KV bucket covering every slot through this chunk.
-        Attention cost per decode step is O(R x S_bucket): with the
+    def _chunk_bucket(self, active: np.ndarray) -> int:
+        """Smallest KV bucket covering every ACTIVE slot through this
+        chunk. Attention cost per decode step is O(R x S_bucket): with the
         default 32k context, short rollouts would otherwise pay full-32k
         attention every token. Buckets are geometric so the jit cache
         stays small, and rows live at positions [0, length) for every
         slot, so slicing the FIRST bucket rows is always sufficient.
 
-        The max is over ALL slots, not just active ones: decode_step
-        writes (harmlessly, at a fixed position) even for inactive slots,
-        and a parked slot with length >= bucket would have that write
-        clamped onto its last in-bucket row — corrupting KV a resume
-        still needs."""
+        Parked/retired slots may hold KV beyond the bucket; that is safe
+        because decode_step's cache write is masked by `active` — an
+        inactive slot's rows pass through the slice + write-back
+        unchanged, and rows past the bucket are never touched at all."""
         S = self.config.context_length
-        needed = int(self._slot_lengths.max()) + self.config.new_tokens_per_chunk + 1
+        lens = self._slot_lengths[active]
+        needed = int(lens.max()) + self.config.new_tokens_per_chunk + 1
         b = 256
         while b < needed:
             b *= 2
@@ -1207,7 +1207,7 @@ class JaxDecodeEngine(InferenceEngine):
             )
         )
         chunk_fn = self._get_chunk_fn(
-            use_topp, use_freq, self._chunk_bucket()
+            use_topp, use_freq, self._chunk_bucket(active)
         )
         version_at_chunk = self._version
         chunk_t0 = time.monotonic()

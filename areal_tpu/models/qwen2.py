@@ -1647,9 +1647,14 @@ def decode_step(
         )
 
     def write(cache_l, new):  # [R, S, nKV, hd] <- [R, nKV, hd]
-        onehot = (jnp.arange(S)[None, :] == positions[:, None]).astype(
-            cache_l.dtype
-        )
+        onehot = jnp.arange(S)[None, :] == positions[:, None]
+        if active is not None:
+            # inactive slots must not touch the cache: retired slots can
+            # still be prefix-KV donors and parked slots hold KV a resume
+            # needs — an unmasked write would clobber row positions[r]
+            # (e.g. row 0 of every retired slot) each step.
+            onehot = onehot & active[:, None]
+        onehot = onehot.astype(cache_l.dtype)
         return cache_l * (1 - onehot[..., None, None]) + (
             new[:, None] * onehot[..., None, None]
         )

@@ -94,13 +94,19 @@ _UNIT_TEXTS = [
     "second", "sec", "days", "day", "weeks", "week", "months", "month",
     "years", "year", "yr",
     "apples", "apple", "people", "men", "man", "women", "woman",
-    "students", "student", "ways", "way", "times",
+    "students", "student", "ways", "way",
 ]
 # longest first so "meters" wins over "m"
 _UNIT_TEXTS.sort(key=len, reverse=True)
 
 
 def _strip_units(s: str) -> str:
+    # "times" is special: as a trailing unit ("8 times") it must strip,
+    # but mid-string it is multiplication phrasing ("4 times 5") whose
+    # removal would CONCATENATE the operands into a wrong number after
+    # the later space removal. (\times stays: protected by the backslash
+    # guard below.)
+    s = re.sub(r"(?<=\d)\s*times\s*$", "", s)
     # (?<![\\A-Za-z]) guards LaTeX commands: "min"/"sec"/"deg" must not
     # eat \min, \sec^2, \deg — a backslash or letter before the word means
     # it is (part of) a command, not a unit suffix.

@@ -4,6 +4,8 @@ incl. segments, padding, GQA, sliding window, chunk-boundary cases."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 
@@ -63,7 +65,6 @@ def test_sliding_window_matches_dense(window):
     )
 
 
-@pytest.mark.slow
 def test_gradients_match_dense():
     q, k, v, seg = _setup(40, seed=2)
     w = jnp.asarray(np.asarray(seg) != PADDING_SEGMENT, jnp.float32)

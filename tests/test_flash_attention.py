@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from areal_tpu.models.qwen2 import PADDING_SEGMENT, segment_causal_mask
 from areal_tpu.ops.flash_attention import flash_attention
 
@@ -107,7 +109,6 @@ def test_segment_isolation():
     assert not np.allclose(np.asarray(out[: T // 2]), np.asarray(out2[: T // 2]))
 
 
-@pytest.mark.slow
 def test_model_forward_flash_vs_dense():
     # Full decoder forward parity between attention implementations.
     from areal_tpu.models.qwen2 import (

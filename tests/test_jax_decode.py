@@ -599,3 +599,126 @@ def test_bucketed_chunk_attention_parity(cpu_devices):
         assert any(k[2] == 256 for k in eng._chunk_fns), eng._chunk_fns.keys()
     finally:
         eng.destroy()
+
+
+@pytest.mark.slow
+def test_parked_long_sequence_survives_bucketed_chunks(cpu_devices):
+    """A parked long sequence must survive other slots' bucketed chunks:
+    decode_step's active-masked cache write means the short request can
+    run on a small bucket while the parked slot's KV (partly inside,
+    partly beyond the bucket) passes through untouched — and the parked
+    request then resumes with the exact greedy continuation."""
+    from areal_tpu.engine.jax_decode import _Slot
+
+    cfg = JaxDecodeConfig(
+        context_length=2048,
+        max_running_requests=2,
+        new_tokens_per_chunk=8,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    try:
+        eng.pause_generation()  # drive the scheduler by hand
+        # long request: run until its KV extends past the 256-row bucket
+        long_prompt = [1 + (i % 40) for i in range(300)]
+        g_long = GenerationHyperparameters(greedy=True, max_new_tokens=64)
+        item = _Slot(rid="long", prompt=list(long_prompt), gconfig=g_long,
+                     future=None, loop=None)
+        eng._request_q.put(item)
+        with eng._sched_lock:
+            eng._admit()
+            eng._run_chunk(eng._active_mask())  # 8 tokens, len ~307
+        partial = list(item.tokens)
+        assert len(partial) == 8
+        eng.abort_all()
+        assert "long" in eng._parked
+
+        # short request decodes alone on the SMALL (256-row) bucket even
+        # though the parked slot's KV extends to ~307 rows — safe because
+        # inactive slots never write
+        g_short = GenerationHyperparameters(greedy=True, max_new_tokens=8)
+        short = _Slot(rid="short", prompt=[2, 4, 6], gconfig=g_short,
+                      future=None, loop=None)
+        eng._request_q.put(short)
+        with eng._sched_lock:
+            eng._admit()
+            eng._run_chunk(eng._active_mask())
+        assert any(k[2] == 256 for k in eng._chunk_fns), (
+            "short request should use the small bucket",
+            list(eng._chunk_fns),
+        )
+        eng._slots = [None] * cfg.max_running_requests  # retire short slot
+
+        # resume the long request: continuation must be exact
+        resume = _Slot(
+            rid="long", prompt=list(long_prompt) + partial,
+            gconfig=GenerationHyperparameters(greedy=True, max_new_tokens=8),
+            future=None, loop=None,
+        )
+        eng._request_q.put(resume)
+        with eng._sched_lock:
+            eng._admit()
+            eng._run_chunk(eng._active_mask())
+        expected = greedy_reference(eng.params, long_prompt, 16)
+        assert partial + resume.tokens == expected
+    finally:
+        eng.destroy()
+
+
+@pytest.mark.slow
+def test_retired_donor_survives_later_chunks(cpu_devices):
+    """Staggered completion: a slot retires (stop_reason stop/length)
+    while others keep chunking, then a same-prompt request forks from the
+    retired donor's registered prefix. The fork must be exact — i.e.
+    later chunks must not have written into the retired slot's rows
+    (decode_step masks inactive-slot writes)."""
+    from areal_tpu.engine.jax_decode import _Slot
+
+    cfg = JaxDecodeConfig(
+        context_length=96,
+        max_running_requests=2,
+        new_tokens_per_chunk=4,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    try:
+        eng.pause_generation()  # drive the scheduler by hand
+        prompt_a = [3, 7, 11, 2, 9]
+        prompt_b = [4, 8, 12, 1]
+        # A finishes after one chunk; B keeps going for several more
+        a = _Slot(rid="a", prompt=list(prompt_a), future=None, loop=None,
+                  gconfig=GenerationHyperparameters(greedy=True, max_new_tokens=4))
+        b = _Slot(rid="b", prompt=list(prompt_b), future=None, loop=None,
+                  gconfig=GenerationHyperparameters(greedy=True, max_new_tokens=20))
+        eng._request_q.put(a)
+        eng._request_q.put(b)
+        with eng._sched_lock:
+            eng._admit()
+            eng._run_chunk(eng._active_mask())  # A hits max_new_tokens -> retires
+            assert a.stop_reason == "length"
+            assert tuple(prompt_a[:-1]) in eng._prefix_lookup
+            # B alone keeps chunking — these chunks must not corrupt A's rows
+            for _ in range(4):
+                if eng._active_mask().any():
+                    eng._run_chunk(eng._active_mask())
+        assert b.stop_reason == "length"
+
+        # fork a same-prompt request from the retired donor's rows
+        forks_before = eng._n_prefix_forks + eng._n_prefix_inplace
+        c = _Slot(rid="c", prompt=list(prompt_a), future=None, loop=None,
+                  gconfig=GenerationHyperparameters(greedy=True, max_new_tokens=4))
+        eng._request_q.put(c)
+        with eng._sched_lock:
+            eng._admit()
+            eng._run_chunk(eng._active_mask())
+        assert eng._n_prefix_forks + eng._n_prefix_inplace == forks_before + 1
+        assert c.tokens == greedy_reference(eng.params, prompt_a, 4)
+        assert c.tokens == a.tokens
+    finally:
+        eng.destroy()

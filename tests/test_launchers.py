@@ -261,3 +261,28 @@ def test_slurm_colocate_plan_trainer_only():
     )
     assert len(jobs) == 1
     assert jobs[0].n_nodes == 1 and jobs[0].accelerators_per_node == 8
+
+
+def test_ray_submit_array_without_placement_group(monkeypatch, _clean_dist_env):
+    """nodes=None (the default) schedules by plain resource requests — no
+    placement group is created and no scheduling_strategy is attached."""
+    from areal_tpu.launcher.ray import RayLauncher
+    from areal_tpu.utils import name_resolve
+
+    name_resolve.reconfigure(name_resolve.NameResolveConfig(type="memory"))
+    record = _clean_dist_env
+    record.update({"pgs": [], "tasks": [], "removed": []})
+    _install_fake_ray(monkeypatch, record)
+
+    launcher = RayLauncher("rexp2", "rt2")
+    refs = launcher.submit_array(
+        "plain", lambda rank: rank, count=3, tpus_per_task=1,
+        cpus_per_task=1, mem_mb_per_task=256,
+    )
+    import ray as fake_ray
+
+    assert sorted(fake_ray.get(refs)) == [0, 1, 2]
+    assert record["pgs"] == [], "no placement group expected"
+    assert all("scheduling_strategy" not in t for t in record["tasks"])
+    launcher.stop_all()
+    assert record["removed"] == []

@@ -60,6 +60,10 @@ GRADE_PAIRS = [
     # --- scientific notation / latex operators must survive unit strip ---
     ("9 \\times 10^8", "900000000", True),
     ("3 \\times 4", "12", True),
+    # trailing "times" is a countable unit; interior "times" is a product
+    # whose operands must NOT concatenate
+    ("8 times", "8", True),
+    ("4 times 5", "45", False),
     # --- pi / constants ---
     ("\\frac{\\pi}{4}", "0.7853981", True),
     ("2\\pi", "6.2831853", True),

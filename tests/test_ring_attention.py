@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from areal_tpu.api.alloc_mode import ParallelStrategy
 from areal_tpu.ops.ring_attention import ring_flash_attention
 from areal_tpu.parallel import mesh as mesh_lib
@@ -22,7 +24,6 @@ def sp_mesh(cpu_devices):
     mesh_lib.set_current_mesh(None)
 
 
-@pytest.mark.slow
 def test_ring_matches_dense(sp_mesh):
     # ring over dp*sp = 4 shards, tp=2 sharding the 4 query heads.
     T, nH, nKV, hd = 512, 4, 2, 32
