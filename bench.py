@@ -466,10 +466,18 @@ def _bench_grpo_run(
     loader = CycleLoader()
 
     def one_step(version: int):
+        # time_perf breakdown (reference accounting,
+        # benchmark/verl_v0_3_0_post1_76084d3/README.md:33-43): e2e =
+        # rollout-wait + train + weight-push. Rollout-wait is what the
+        # trainer BLOCKS on — generation itself overlaps ≥2 batches deep.
+        t0 = time.perf_counter()
         batch = rollout.prepare_batch(loader, workflow=workflow)
+        rollout_wait_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
         batch["prox_logp"] = actor.compute_logp(batch)
         actor.compute_advantages(batch)
         stats = actor.ppo_update(batch)
+        train_s = time.perf_counter() - t1
         actor.set_version(version)
         t_push = time.perf_counter()
         rollout.pause()
@@ -479,18 +487,22 @@ def _bench_grpo_run(
         push_s = time.perf_counter() - t_push
         gen_tokens = int((batch["versions"] >= 0).sum())
         total_tokens = int(batch["attention_mask"].sum())
-        return gen_tokens, total_tokens, push_s, stats
+        return gen_tokens, total_tokens, rollout_wait_s, train_s, push_s, stats
 
     for v in range(warmup_steps):
         one_step(v + 1)
 
     gen_tot = tok_tot = 0
-    push_tot = 0.0
+    wait_tot = train_tot = push_tot = 0.0
     t0 = time.perf_counter()
     for v in range(steps):
-        gen_tokens, total_tokens, push_s, _ = one_step(warmup_steps + v + 1)
+        gen_tokens, total_tokens, wait_s, train_s, push_s, _ = one_step(
+            warmup_steps + v + 1
+        )
         gen_tot += gen_tokens
         tok_tot += total_tokens
+        wait_tot += wait_s
+        train_tot += train_s
         push_tot += push_s
     e2e = time.perf_counter() - t0
     n_chips = max(jax.device_count(), 1)
@@ -499,6 +511,8 @@ def _bench_grpo_run(
         grpo_rollout_tokens_per_sec_per_chip=gen_tot / e2e / n_chips,
         grpo_effective_tokens_per_sec_per_chip=tok_tot / e2e / n_chips,
         grpo_step_time_s=e2e / steps,
+        grpo_time_rollout_wait_s=wait_tot / steps,
+        grpo_time_train_s=train_tot / steps,
         grpo_weight_push_s=push_tot / steps,
         grpo_prompts_per_step=n_prompts,
         grpo_group_size=group_size,

@@ -4,8 +4,6 @@ incl. segments, padding, GQA, sliding window, chunk-boundary cases."""
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.slow
-
 import jax
 import jax.numpy as jnp
 
@@ -65,6 +63,7 @@ def test_sliding_window_matches_dense(window):
     )
 
 
+@pytest.mark.slow
 def test_gradients_match_dense():
     q, k, v, seg = _setup(40, seed=2)
     w = jnp.asarray(np.asarray(seg) != PADDING_SEGMENT, jnp.float32)

@@ -5,8 +5,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.slow
-
 from areal_tpu.models.qwen2 import PADDING_SEGMENT, segment_causal_mask
 from areal_tpu.ops.flash_attention import flash_attention
 
@@ -61,6 +59,7 @@ def test_forward_matches_dense(T, nH, nKV, hd, pad):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_backward_matches_dense():
     T, nH, nKV, hd = 256, 4, 2, 32
     q, k, v, seg = make_inputs(T, nH, nKV, hd, pad=19, seed=1)
@@ -109,6 +108,7 @@ def test_segment_isolation():
     assert not np.allclose(np.asarray(out[: T // 2]), np.asarray(out2[: T // 2]))
 
 
+@pytest.mark.slow
 def test_model_forward_flash_vs_dense():
     # Full decoder forward parity between attention implementations.
     from areal_tpu.models.qwen2 import (

@@ -5,8 +5,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.slow
-
 from areal_tpu.api.alloc_mode import ParallelStrategy
 from areal_tpu.ops.ring_attention import ring_flash_attention
 from areal_tpu.parallel import mesh as mesh_lib
@@ -24,6 +22,7 @@ def sp_mesh(cpu_devices):
     mesh_lib.set_current_mesh(None)
 
 
+@pytest.mark.slow
 def test_ring_matches_dense(sp_mesh):
     # ring over dp*sp = 4 shards, tp=2 sharding the 4 query heads.
     T, nH, nKV, hd = 512, 4, 2, 32
@@ -35,6 +34,7 @@ def test_ring_matches_dense(sp_mesh):
     )
 
 
+@pytest.mark.slow
 def test_ring_gradients_match(sp_mesh):
     T, nH, nKV, hd = 512, 4, 2, 32
     q, k, v, seg = make_inputs(T, nH, nKV, hd, pad=17, seed=5, n_seqs=3)
