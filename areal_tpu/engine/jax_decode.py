@@ -64,10 +64,25 @@ from areal_tpu.utils import logging
 logger = logging.getLogger("jax_decode")
 
 _PREFILL_BUCKET = 64
+# partial prefix sharing kicks in only when the shared history is at least
+# this long — below it a fresh parallel prefill is cheaper than the
+# fork + suffix pass
+_MIN_SHARED_PREFIX = 64
 
 
 def _next_bucket(n: int, bucket: int = _PREFILL_BUCKET) -> int:
     return max(((n + bucket - 1) // bucket) * bucket, bucket)
+
+
+def _pow2_bucket(n: int, lo: int = _PREFILL_BUCKET) -> int:
+    """Power-of-two bucketing for the suffix-prefill jit keys: the fn is
+    keyed on (suffix_bucket, prefix_bucket) PAIRS, so linear 64-step
+    buckets would give a quadratic compile count; geometric buckets keep
+    it at ~log^2 combinations."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
 
 
 @dataclass
@@ -151,11 +166,13 @@ class JaxDecodeEngine(InferenceEngine):
         self._n_prefills = 0
         self._n_prefix_forks = 0
         self._n_prefix_inplace = 0
+        self._n_suffix_prefills = 0  # partial-prefix hits (multi-turn)
         self._gen_token_count = 0  # total tokens generated since init
         self._rng = None
         self._chunk_fns: dict[bool, Callable] = {}
         self._prefill_fns: dict[int, Callable] = {}
         self._fork_fns: dict[int, Callable] = {}
+        self._suffix_prefill_fns: dict[tuple[int, int], Callable] = {}
         self._write_fns: dict[int, Callable] = {}
         # GQA-under-tp: kv heads repeated _kv_repeat times at install
         # (_maybe_repeat_kv_heads); original config kept for HF reloads.
@@ -279,6 +296,7 @@ class JaxDecodeEngine(InferenceEngine):
         self._chunk_fns.clear()
         self._prefill_fns.clear()
         self._fork_fns.clear()
+        self._suffix_prefill_fns.clear()
         self._prefix_lookup.clear()
 
     def _maybe_load_vision_tower(self, model_path: str) -> None:
@@ -807,6 +825,77 @@ class JaxDecodeEngine(InferenceEngine):
             self._fork_fns[bucket] = jax.jit(fork, donate_argnums=(0, 1))
         return self._fork_fns[bucket]
 
+    def _get_suffix_prefill_fn(self, suffix_bucket: int, prefix_bucket: int):
+        """Prefill a SUFFIX whose context is prefix KV already in the
+        slot's cache rows (partial prefix sharing — multi-turn/tool-use
+        requests re-submit shared history + a short new segment). The
+        prefix rows are read back from the cache, the suffix runs one
+        parallel pass attending over them (models/qwen2.py
+        prefill_with_prefix), and its KV rows are written at the dynamic
+        offset prefix_len."""
+        key = (suffix_bucket, prefix_bucket)
+        if key not in self._suffix_prefill_fns:
+            cfg = self.model_config
+
+            def suffix_prefill(params, kc, vc, ids, slot, suffix_len,
+                               prefix_len):
+                from areal_tpu.models.qwen2 import prefill_with_prefix
+
+                L, R, S, nkv, hd = kc.shape
+                pk = jax.lax.dynamic_slice(
+                    kc, (0, slot, 0, 0, 0), (L, 1, prefix_bucket, nkv, hd)
+                )[:, 0]
+                pv = jax.lax.dynamic_slice(
+                    vc, (0, slot, 0, 0, 0), (L, 1, prefix_bucket, nkv, hd)
+                )[:, 0]
+                valid = jnp.arange(ids.shape[0]) < suffix_len
+                ks, vs = prefill_with_prefix(
+                    params, ids, pk, pv, prefix_len, cfg, valid=valid
+                )
+                kc = jax.lax.dynamic_update_slice(
+                    kc, ks[:, None].astype(kc.dtype), (0, slot, prefix_len, 0, 0)
+                )
+                vc = jax.lax.dynamic_update_slice(
+                    vc, vs[:, None].astype(vc.dtype), (0, slot, prefix_len, 0, 0)
+                )
+                return kc, vc
+
+            self._suffix_prefill_fns[key] = jax.jit(
+                suffix_prefill, donate_argnums=(1, 2)
+            )
+        return self._suffix_prefill_fns[key]
+
+    def _find_shared_prefix(self, covered: tuple[int, ...]):
+        """Longest registered prefix that is a PROPER prefix of `covered`
+        (the exact-match case is handled separately). Returns
+        (donor_slot, prefix_len) or None. Linear over <= R registry
+        entries on the host — negligible next to a prefill."""
+        best_key = None
+        for key in self._prefix_lookup:
+            kl = len(key)
+            if (
+                kl >= _MIN_SHARED_PREFIX
+                and kl < len(covered)
+                and covered[:kl] == key
+            ):
+                if best_key is None or kl > len(best_key):
+                    best_key = key
+        if best_key is None:
+            return None
+        return self._prefix_lookup[best_key], len(best_key)
+
+    def _find_covering_donor(self, covered: tuple[int, ...]) -> int | None:
+        """A registered key that EXTENDS `covered` also serves as an exact
+        donor — its first len(covered) rows hold precisely covered's KV.
+        (Retirement extends a slot's key to the full conversation, so a
+        late GRPO group member's plain-prompt key may only exist as the
+        head of a longer registration.)"""
+        n = len(covered)
+        for key, slot in self._prefix_lookup.items():
+            if len(key) >= n and key[:n] == covered:
+                return slot
+        return None
+
     # -- prefix-KV registry --------------------------------------------
     def _unregister_prefix(self, slot_idx: int) -> None:
         key = self._slot_prefix[slot_idx]
@@ -915,11 +1004,27 @@ class JaxDecodeEngine(InferenceEngine):
             # wave forks through: a fork is a memcpy, not prefill work).
             # Image requests are excluded — their KV depends on pixel data
             # the token-tuple key cannot see.
-            donor = (
-                self._prefix_lookup.get(tuple(prompt[:-1]))
-                if P > 1 and not item.image_data
-                else None
-            )
+            donor = None
+            if P > 1 and not item.image_data:
+                covered_t = tuple(prompt[:-1])
+                donor = self._prefix_lookup.get(covered_t)
+                if donor is None:
+                    donor = self._find_covering_donor(covered_t)
+            # Partial prefix sharing: no exact donor, but a registered
+            # prefix covers the head of this prompt (multi-turn requests
+            # re-submit shared history + a short new suffix). Fork the
+            # shared rows, prefill only the suffix.
+            partial = None
+            if donor is None and P > 1 and not item.image_data:
+                found = self._find_shared_prefix(tuple(prompt[:-1]))
+                if found is not None:
+                    donor_slot, plen = found
+                    suffix_bucket = min(
+                        _pow2_bucket(P - 1 - plen), self.config.context_length
+                    )
+                    if plen + suffix_bucket <= self.config.context_length:
+                        partial = (donor_slot, plen, suffix_bucket)
+                        needs_prefill_bucket = suffix_bucket
             if did_prefill and donor is None and needs_prefill_bucket > prefill_budget:
                 # budget exhausted for this pass; run the decode chunk first
                 self._overflow.insert(0, item)
@@ -973,6 +1078,43 @@ class JaxDecodeEngine(InferenceEngine):
                     self._n_prefix_forks += 1
                 else:
                     self._n_prefix_inplace += 1
+                    # the slot's registration may be LONGER than this
+                    # request's prefix (covering-donor reuse); decode will
+                    # overwrite rows past P-1, so trim the claim to what
+                    # stays valid
+                    self._register_prefix(slot_idx, list(prompt[:-1]))
+            elif resumed is None and P > 1 and partial is not None:
+                donor_slot, plen, sb = partial
+                prefill_budget -= sb
+                did_prefill = True
+                self._n_suffix_prefills += 1
+                # one prefix bucket for BOTH the fork copy and the suffix
+                # fn's prefix slice, so they can never drift apart
+                pb = min(_pow2_bucket(plen), self.config.context_length)
+                if donor_slot != slot_idx:
+                    # copy the shared history's rows; when re-admitting
+                    # into the donor slot itself they are already in place
+                    self._unregister_prefix(slot_idx)
+                    fork = self._get_fork_fn(pb)
+                    with self._weight_lock:
+                        self._k_cache, self._v_cache = fork(
+                            self._k_cache, self._v_cache, donor_slot, slot_idx
+                        )
+                suffix = prompt[plen : P - 1]
+                ids = np.zeros(sb, dtype=np.int32)
+                ids[: len(suffix)] = suffix
+                fn = self._get_suffix_prefill_fn(sb, pb)
+                with self._weight_lock:
+                    self._k_cache, self._v_cache = fn(
+                        self.params,
+                        self._k_cache,
+                        self._v_cache,
+                        jnp.asarray(ids),
+                        slot_idx,
+                        len(suffix),
+                        plen,
+                    )
+                self._register_prefix(slot_idx, list(prompt[:-1]))
             elif resumed is None and P > 1:
                 pre = P - 1
                 bucket = min(_next_bucket(pre), self.config.context_length)
@@ -1103,6 +1245,17 @@ class JaxDecodeEngine(InferenceEngine):
                 list(item.prompt) + list(item.tokens)
             )[:covered]
         else:
+            covered = int(self._slot_lengths[slot_idx])
+            if item is not None and not item.image_data and covered > 0:
+                # The finished slot's rows cover the WHOLE conversation
+                # (prompt + generated tokens, minus the never-consumed
+                # last one) — register that full span so a follow-up turn
+                # (history + answer + new user turn) forks everything
+                # instead of just the original prompt prefix.
+                self._register_prefix(
+                    slot_idx,
+                    (list(item.prompt) + list(item.tokens))[:covered],
+                )
             self._slot_lengths[slot_idx] = 0
         if item is not None:
             self._complete(item, stop_reason=item.stop_reason or "stop")
@@ -1582,6 +1735,7 @@ class JaxDecodeEngine(InferenceEngine):
             "prefills_total": self._n_prefills,
             "prefix_forks_total": self._n_prefix_forks,
             "prefix_inplace_total": self._n_prefix_inplace,
+            "suffix_prefills_total": self._n_suffix_prefills,
             "weight_version": self._version,
             "paused": self._gen_paused.is_set(),
         }

@@ -1519,6 +1519,9 @@ def prefill(
     input_embeds: jax.Array | None = None,
     rope_cos: jax.Array | None = None,
     rope_sin: jax.Array | None = None,
+    prefix_k: jax.Array | None = None,
+    prefix_v: jax.Array | None = None,
+    prefix_len: jax.Array | None = None,
 ) -> tuple[jax.Array | None, jax.Array, jax.Array]:
     """Causal forward over ONE sequence [T], returning (logits [T, V],
     k_cache [L, T, nKV, hd], v_cache [L, T, nKV, hd]).
@@ -1536,7 +1539,13 @@ def prefill(
     multimodal path: the decode engine splices vision-tower outputs over
     image-pad positions (models/qwen2_vl.splice_image_embeds) and
     prefills from embeddings. `rope_cos/rope_sin` [T, hd/2] override the
-    1-D rope tables (Qwen2-VL m-rope, models/qwen2_vl.mrope_table)."""
+    1-D rope tables (Qwen2-VL m-rope, models/qwen2_vl.mrope_table).
+
+    `prefix_k/prefix_v` [L, Tp, nKV, hd] + scalar `prefix_len`: cached
+    context for SUFFIX prefill (partial prefix sharing) — every token
+    additionally attends to prefix rows < prefix_len, and `position_ids`
+    must then be the absolute positions (prefix_len + arange). One layer
+    body serves both modes so the paths cannot drift apart."""
     compute_dtype = jnp.dtype(cfg.dtype)
     if input_embeds is not None:
         x = input_embeds.astype(compute_dtype)
@@ -1556,18 +1565,42 @@ def prefill(
     band = _window_band(T, cfg.sliding_window)
     if band is not None:
         causal = causal & band
+    with_prefix = prefix_k is not None
+    if with_prefix:
+        Tp = prefix_k.shape[1]
+        key_pos_prefix = jnp.arange(Tp, dtype=jnp.int32)
+        prefix_mask = jnp.broadcast_to(
+            key_pos_prefix[None, :] < prefix_len, (T, Tp)
+        )
+        if cfg.sliding_window is not None:
+            prefix_mask = prefix_mask & (
+                key_pos_prefix[None, :]
+                > position_ids[:, None] - cfg.sliding_window
+            )
+        mask = jnp.concatenate([prefix_mask, causal], axis=1)  # [T, Tp+T]
+    else:
+        mask = causal
     nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
     group = nH // nKV
 
-    def layer(x, layer_p):
+    def layer(x, inputs):
+        if with_prefix:
+            layer_p, pk, pv = inputs
+        else:
+            layer_p = inputs
         h = _norm(x, layer_p["input_norm"], cfg, layer_p.get("input_norm_bias"))
         q, k, v = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
+        if with_prefix:
+            kk = jnp.concatenate([pk.astype(k.dtype), k], axis=0)
+            vv = jnp.concatenate([pv.astype(v.dtype), v], axis=0)
+        else:
+            kk, vv = k, v
         qg = q.reshape(T, nKV, group, hd)
-        scores = jnp.einsum("tkgd,skd->kgts", qg, k).astype(jnp.float32)
+        scores = jnp.einsum("tkgd,skd->kgts", qg, kk).astype(jnp.float32)
         scores = scores / np.sqrt(hd)
-        scores = jnp.where(causal[None, None], scores, -1e30)
+        scores = jnp.where(mask[None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn_out = jnp.einsum("kgts,skd->tkgd", probs, v).reshape(T, nH, hd)
+        attn_out = jnp.einsum("kgts,skd->tkgd", probs, vv).reshape(T, nH, hd)
         proj = jnp.einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"])
         if cfg.attn_out_bias:
             proj = proj + layer_p["attn"]["o_bias"]
@@ -1581,11 +1614,21 @@ def prefill(
         return x, (k, v)
 
     if cfg.scan_layers:
-        x, (ks, vs) = jax.lax.scan(layer, x, params["layers"])
+        xs = (
+            (params["layers"], prefix_k, prefix_v)
+            if with_prefix
+            else params["layers"]
+        )
+        x, (ks, vs) = jax.lax.scan(layer, x, xs)
     else:
         ks_list, vs_list = [], []
         for i in range(cfg.num_hidden_layers):
-            x, (k, v) = layer(x, params[f"layers_{i}"])
+            inputs = (
+                (params[f"layers_{i}"], prefix_k[i], prefix_v[i])
+                if with_prefix
+                else params[f"layers_{i}"]
+            )
+            x, (k, v) = layer(x, inputs)
             ks_list.append(k)
             vs_list.append(v)
         ks, vs = jnp.stack(ks_list), jnp.stack(vs_list)
@@ -1600,6 +1643,44 @@ def prefill(
     else:
         logits = jnp.einsum("th,hv->tv", x, params["lm_head"]["kernel"])
     return logits.astype(jnp.float32), ks, vs
+
+
+def prefill_with_prefix(
+    params: dict,
+    input_ids: jax.Array,  # [T] suffix tokens (bucket-padded)
+    prefix_k: jax.Array,  # [L, Tp, nKV, hd] cached prefix KV
+    prefix_v: jax.Array,  # [L, Tp, nKV, hd]
+    prefix_len: jax.Array,  # scalar: valid prefix rows (dynamic, <= Tp)
+    cfg: ModelConfig,
+    valid: jax.Array | None = None,  # [T] real (non-pad) suffix tokens
+) -> tuple[jax.Array, jax.Array]:
+    """Causal forward over a SUFFIX whose context is cached prefix KV.
+
+    The partial-prefix-sharing path (the radix-tree property the reference
+    inherits from SGLang): a multi-turn / tool-use request re-submits
+    shared history + a short new suffix; the engine forks the history's
+    KV rows from a donor slot and runs ONE parallel pass over just the
+    suffix — each suffix token attends to [prefix rows < prefix_len] +
+    causally to earlier suffix tokens. Returns the suffix-only
+    (k_cache, v_cache) [L, T, nKV, hd] for writing at offset prefix_len.
+
+    Thin wrapper over `prefill` (same layer body — the paths cannot
+    drift): suffix token i occupies absolute position prefix_len + i, so
+    rope and sliding-window distances stay exact."""
+    T = input_ids.shape[0]
+    positions = prefix_len + jnp.arange(T, dtype=jnp.int32)
+    _, ks, vs = prefill(
+        params,
+        input_ids,
+        positions,
+        cfg,
+        valid=valid,
+        with_logits=False,
+        prefix_k=prefix_k,
+        prefix_v=prefix_v,
+        prefix_len=prefix_len,
+    )
+    return ks, vs
 
 
 def decode_step(

@@ -702,7 +702,13 @@ def test_retired_donor_survives_later_chunks(cpu_devices):
             eng._admit()
             eng._run_chunk(eng._active_mask())  # A hits max_new_tokens -> retires
             assert a.stop_reason == "length"
-            assert tuple(prompt_a[:-1]) in eng._prefix_lookup
+            # retirement registers the FULL conversation; the covering-donor
+            # lookup serves plain-prompt matches from its head
+            pa = tuple(prompt_a[:-1])
+            assert any(
+                len(k) >= len(pa) and k[: len(pa)] == pa
+                for k in eng._prefix_lookup
+            ), eng._prefix_lookup
             # B alone keeps chunking — these chunks must not corrupt A's rows
             for _ in range(4):
                 if eng._active_mask().any():
@@ -720,5 +726,55 @@ def test_retired_donor_survives_later_chunks(cpu_devices):
         assert eng._n_prefix_forks + eng._n_prefix_inplace == forks_before + 1
         assert c.tokens == greedy_reference(eng.params, prompt_a, 4)
         assert c.tokens == a.tokens
+    finally:
+        eng.destroy()
+
+
+@pytest.mark.slow
+def test_partial_prefix_sharing_multi_turn(cpu_devices):
+    """Multi-turn shape: request 2 = request 1's full conversation (prompt
+    + generated answer) + a new user turn. The engine forks the shared
+    history's KV from the registry and prefills ONLY the suffix
+    (prefill_with_prefix), with exactly the dense greedy output."""
+    cfg = JaxDecodeConfig(
+        context_length=512,
+        max_running_requests=2,
+        new_tokens_per_chunk=8,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    try:
+        # turn 1: long enough that its covered prefix >= _MIN_SHARED_PREFIX
+        turn1 = [1 + (i % 40) for i in range(100)]
+        g = GenerationHyperparameters(greedy=True, max_new_tokens=8)
+        r1 = eng.generate(
+            ModelRequest(input_ids=list(turn1), gconfig=g), timeout=600
+        )
+        assert r1.output_tokens == greedy_reference(eng.params, turn1, 8)
+        assert eng._n_prefills == 1
+
+        # turn 2: history + answer + a fresh user segment, NEW rid
+        turn2 = list(turn1) + list(r1.output_tokens) + [5, 17, 3, 29, 11]
+        r2 = eng.generate(
+            ModelRequest(input_ids=list(turn2), gconfig=g), timeout=600
+        )
+        assert r2.output_tokens == greedy_reference(eng.params, turn2, 8)
+        # the shared history was NOT re-prefilled
+        assert eng._n_prefills == 1
+        assert eng._n_suffix_prefills == 1
+        m = eng.get_metrics()
+        assert m["suffix_prefills_total"] == 1
+
+        # turn 3 extends turn 2 — the registry now holds the longer key
+        turn3 = list(turn2) + list(r2.output_tokens) + [7, 2]
+        r3 = eng.generate(
+            ModelRequest(input_ids=list(turn3), gconfig=g), timeout=600
+        )
+        assert r3.output_tokens == greedy_reference(eng.params, turn3, 8)
+        assert eng._n_prefills == 1
+        assert eng._n_suffix_prefills == 2
     finally:
         eng.destroy()
