@@ -129,13 +129,46 @@ def evaluate_offline(
     }
     # maj@n (parity: the reference's rm_maj_eval group_pred): plurality vote
     # over extracted answers; a problem counts iff the plurality answer's
-    # samples were rewarded correct.
+    # samples were rewarded correct. Votes cluster by mathematical
+    # EQUIVALENCE, not string identity — "\\frac{1}{2}" and "0.5" are the
+    # same vote (string-only voting splits majorities and understates
+    # maj@n on LaTeX-answer benchmarks). Clustering is two-stage to stay
+    # cheap and hang-proof: (1) canonicalize each answer once (numeric
+    # value or normalized string — no sympy); (2) merge the few remaining
+    # symbolic representatives pairwise through the SUBPROCESS grader,
+    # whose hard timeout contains adversarial sympy inputs.
+    from areal_tpu.reward.math_parser import (
+        math_equal_subprocess,
+        normalize_answer,
+        parse_number,
+    )
+
+    def vote_key(ans: str):
+        norm = normalize_answer(ans)
+        num = parse_number(norm)
+        if num is not None:
+            return ("num", round(num, 8))
+        return ("sym", norm.lower())
+
     maj = []
     for p_idx, samples in enumerate(per_problem):
-        votes: dict[str, list[float]] = {}
+        votes: dict[tuple, list[float]] = {}
+        originals: dict[tuple, str] = {}
         for r, _, completion in samples:
             ans = _extracted_answer(completion)
-            votes.setdefault(ans, []).append(r)
+            key = vote_key(ans)
+            if key not in votes:
+                # residual symbolic merge: \sqrt{8} and 2\sqrt{2} have
+                # different normalized strings but are one vote
+                if key[0] == "sym":
+                    for k in votes:
+                        if k[0] == "sym" and math_equal_subprocess(
+                            ans, originals[k], timeout_s=3.0
+                        ):
+                            key = k
+                            break
+            votes.setdefault(key, []).append(r)
+            originals.setdefault(key, ans)
         if not votes:
             maj.append(0.0)
             continue

@@ -201,3 +201,46 @@ def test_math_code_env_unknown_qid_raises():
     env = make_env("math-code-single-step", id2info={})
     with pytest.raises(KeyError):
         asyncio.run(env.step(("missing", ["x"])))
+
+
+def test_maj_at_n_clusters_equivalent_answers():
+    """maj@n votes by mathematical equivalence: \\frac{1}{2} and 0.5 are
+    ONE vote. String-identity voting would split them 1-1-1 against the
+    wrong answer; equivalence clustering restores the true 2-1 majority."""
+    from areal_tpu.api.io_struct import ModelResponse
+    from areal_tpu.reward.math_parser import math_verify_reward
+
+    class FormTokenizer:
+        eos_token_id = None
+
+        def decode(self, ids):
+            forms = {
+                1: r"the answer is $\boxed{\frac{1}{2}}$",
+                2: r"so \boxed{0.5}",
+                3: r"hence \boxed{7}",
+            }
+            return " ".join(forms.get(int(i), str(i)) for i in ids)
+
+    class FormEngine(ScriptedEngine):
+        async def agenerate(self, req):
+            out = [1 + self.calls % 3]  # cycles 1, 2, 3
+            self.calls += 1
+            return ModelResponse(
+                input_tokens=list(req.input_ids),
+                output_tokens=out,
+                output_logprobs=[-0.1],
+                output_versions=[0],
+                stop_reason="stop",
+            )
+
+    res = evaluate_offline(
+        FormEngine([]),
+        [{"input_ids": [9], "answer": "0.5"}],
+        reward_fn=math_verify_reward,
+        gconfig=GenerationHyperparameters(max_new_tokens=4),
+        tokenizer=FormTokenizer(),
+        n_samples=3,
+        ks=(1,),
+    )
+    assert res.maj_at_n == 1.0
+    assert abs(res.mean_reward - 2 / 3) < 1e-9
