@@ -58,27 +58,20 @@ class MathCodeSingleStepEnv(EnvironmentService):
 
     @staticmethod
     def _verify_math(info: dict, answers: list[str]) -> list[int]:
-        from areal_tpu.reward.math_parser import math_verify_reward
+        # batch seam: offloads to the verify service when
+        # AREAL_VERIFIER_SERVICE is set, local thread-pool grading
+        # otherwise (parity: math_verify_call switch in the reference env)
+        from areal_tpu.reward.remote_verify import batch_math_verify
 
-        sols = info.get("solutions") or [info.get("answer", "")]
-        out = []
-        for a in answers:
-            ok = any(
-                math_verify_reward(None, a, answer=s) > 0 for s in sols
-            )
-            out.append(int(ok))
-        return out
+        qids = ["q"] * len(answers)
+        return batch_math_verify({"q": info}, list(answers), qids)
 
     @staticmethod
     def _verify_code(info: dict, answers: list[str]) -> list[int]:
-        from areal_tpu.reward.code_verify import extract_code, run_problem
+        from areal_tpu.reward.remote_verify import batch_code_verify
 
-        io_spec = info.get("input_output") or {}
-        out = []
-        for a in answers:
-            code = extract_code(a)
-            out.append(int(bool(code) and run_problem(code, io_spec)))
-        return out
+        qids = ["q"] * len(answers)
+        return batch_code_verify({"q": info}, list(answers), qids)
 
 
 register_environment("math-code-single-step", MathCodeSingleStepEnv)

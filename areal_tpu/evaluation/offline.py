@@ -150,6 +150,11 @@ def evaluate_offline(
             return ("num", round(num, 8))
         return ("sym", norm.lower())
 
+    # bound the pairwise merge: a weak checkpoint emitting dozens of
+    # distinct unparseable answers must not trigger O(clusters^2) forked
+    # comparisons (each up to its hang timeout)
+    MAX_MERGE_CLUSTERS = 12
+
     maj = []
     for p_idx, samples in enumerate(per_problem):
         votes: dict[tuple, list[float]] = {}
@@ -157,12 +162,13 @@ def evaluate_offline(
         for r, _, completion in samples:
             ans = _extracted_answer(completion)
             key = vote_key(ans)
-            if key not in votes:
-                # residual symbolic merge: \sqrt{8} and 2\sqrt{2} have
-                # different normalized strings but are one vote
-                if key[0] == "sym":
+            if key not in votes and key[0] == "sym":
+                # residual symbolic merge AGAINST EVERY cluster (numeric
+                # too: \sqrt{4} must join the "2" cluster), via the
+                # subprocess grader so adversarial sympy cannot hang
+                if len(votes) <= MAX_MERGE_CLUSTERS:
                     for k in votes:
-                        if k[0] == "sym" and math_equal_subprocess(
+                        if math_equal_subprocess(
                             ans, originals[k], timeout_s=3.0
                         ):
                             key = k

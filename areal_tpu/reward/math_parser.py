@@ -636,6 +636,13 @@ def math_equal_subprocess(pred: str, target: str, timeout_s: float = 5.0) -> boo
     call_with_timeout + pebble ProcessPool, math_parser.py:684-744)."""
     import multiprocessing as mp
 
+    # Fork from thread pools is safe here ONLY because the child's single
+    # job is math_equal: pre-importing sympy in the parent makes the
+    # child's lazy import a sys.modules hit, so it cannot block on an
+    # import lock some other parent thread held at fork time. A child
+    # that wedges anyway is terminated at timeout_s and graded False.
+    import sympy  # noqa: F401 — warm the module before forking
+
     ctx = mp.get_context("fork")
     q = ctx.Queue()
 

@@ -1,0 +1,224 @@
+"""Remote batch verification: the scale-out reward seam.
+
+Parity: /root/reference/functioncall/ — the reference offloads math/code
+grading to an HTTP "functioncall" service (base/call.py batch_function_call:
+batched POSTs, bounded concurrency, retries) when
+FUNCTIONCALL_SERVICE_DOMAIN is set, else grades locally. Heavy RL runs need
+this: sympy/subprocess grading of thousands of samples per step would
+otherwise serialize on the trainer host.
+
+This module ships BOTH ends:
+- `batch_math_verify` / `batch_code_verify`: clients that POST to the
+  service named by AREAL_VERIFIER_SERVICE (FUNCTIONCALL_SERVICE_DOMAIN is
+  honoured for reference-compat) in bounded-concurrency batches with
+  retries, falling back to the local graders (areal_tpu.reward.math_parser
+  / code_verify) in a thread pool when unset or unreachable.
+- `VerifyServer` (`python -m areal_tpu.reward.verify_server`): the service
+  itself — an aiohttp app running the same local graders, horizontally
+  scalable on CPU hosts (the reference assumes an external deployment and
+  ships only the client).
+
+Protocol: POST /verify {"uid", "language": "MATH"|"CODE", "payload": ...}
+-> {"results": [0/1, ...]} aligned with the payload order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("remote_verify")
+
+_BATCH_SIZE = 10
+_CONCURRENCY = 64
+_RETRIES = 3
+
+
+def service_addr() -> str | None:
+    return (
+        os.environ.get("AREAL_VERIFIER_SERVICE")
+        or os.environ.get("FUNCTIONCALL_SERVICE_DOMAIN")
+        or None
+    )
+
+
+# ---------------------------------------------------------------------------
+# local grading (the fallback AND the server's engine)
+# ---------------------------------------------------------------------------
+
+
+def _grade_math_pair(answer: str, solution: str) -> int:
+    from areal_tpu.reward.math_parser import (
+        _extract_ground_truth,
+        extract_answer,
+        math_equal_subprocess,
+    )
+
+    pred = extract_answer(answer)  # extraction is regex-only — no sympy
+    if pred is None:
+        return 0
+    # the SUBPROCESS grader: adversarial sympy inputs hit its hard timeout
+    # instead of permanently wedging a grader thread (and, transitively,
+    # the verify service's whole worker pool)
+    return int(
+        math_equal_subprocess(
+            pred, _extract_ground_truth(str(solution)), timeout_s=5.0
+        )
+    )
+
+
+def grade_math_batch(answers: list[str], solutions: list[str]) -> list[int]:
+    """Pairwise grading, order-aligned."""
+    return [_grade_math_pair(a, s) for a, s in zip(answers, solutions)]
+
+
+def grade_code_batch(items: list[dict[str, Any]]) -> list[int]:
+    """Each item: {"completion": str, "input_output": {...}}."""
+    from areal_tpu.reward.code_verify import extract_code, run_problem
+
+    out = []
+    for item in items:
+        code = extract_code(item.get("completion", ""))
+        io_spec = item.get("input_output") or {}
+        out.append(int(bool(code) and run_problem(code, io_spec)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# batch client
+# ---------------------------------------------------------------------------
+
+
+async def _post_batches(
+    addr: str, payloads: list[dict], timeout_s: float
+) -> list[list[int]] | None:
+    """POST every payload; None on unrecoverable transport failure (the
+    caller falls back to local grading — a broken service must degrade,
+    not zero out rewards)."""
+    import aiohttp
+
+    url = addr if addr.startswith("http") else f"http://{addr}"
+    sem = asyncio.Semaphore(_CONCURRENCY)
+    timeout = aiohttp.ClientTimeout(total=timeout_s)
+
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+
+        async def one(payload: dict) -> list[int] | None:
+            async with sem:
+                last = "unknown"
+                for attempt in range(_RETRIES):
+                    try:
+                        async with session.post(
+                            f"{url}/verify", json=payload
+                        ) as resp:
+                            if resp.status == 200:
+                                data = await resp.json()
+                                return [int(r) for r in data["results"]]
+                            last = f"status {resp.status}"
+                    except Exception as e:  # noqa: BLE001 — retry then fail
+                        last = repr(e)
+                    if attempt < _RETRIES - 1:  # no dead wait after final try
+                        await asyncio.sleep(0.2 * (attempt + 1))
+                logger.warning(f"verify service call failed: {last}")
+                return None
+
+        results = await asyncio.gather(*[one(p) for p in payloads])
+    if any(r is None for r in results):
+        return None
+    return list(results)  # type: ignore[arg-type]
+
+
+def _run_async(coro):
+    """Client entry points are sync (reward fns run in worker threads);
+    always use a private loop so a caller's running loop is untouched."""
+    return asyncio.run(coro)
+
+
+def batch_math_verify(
+    id2info: dict[str, dict],
+    generateds: list[str],
+    query_ids: list[str],
+    *,
+    timeout_s: float = 1000.0,
+    max_workers: int = 8,
+) -> list[int]:
+    """One 0/1 per generated, order-aligned (parity:
+    functioncall/math/verify.py math_verify): a sample passes if it
+    matches ANY of its question's solutions."""
+    assert len(generateds) == len(query_ids)
+    pairs: list[tuple[str, str, int]] = []  # (answer, solution, sample idx)
+    for idx, (qid, gen) in enumerate(zip(query_ids, generateds)):
+        info = id2info[str(qid).split("@")[0]]
+        for sol in info.get("solutions") or [info.get("answer", "")]:
+            pairs.append((gen, str(sol), idx))
+
+    addr = service_addr()
+    flat: list[int] | None = None
+    if addr:
+        payloads = []
+        for i in range(0, len(pairs), _BATCH_SIZE):
+            chunk = pairs[i : i + _BATCH_SIZE]
+            payloads.append(
+                {
+                    "uid": f"math-{i}-{i + len(chunk)}",
+                    "language": "MATH",
+                    "payload": {
+                        "answers": [a for a, _, _ in chunk],
+                        "solutions": [s for _, s, _ in chunk],
+                    },
+                }
+            )
+        per_batch = _run_async(_post_batches(addr, payloads, timeout_s))
+        if per_batch is not None:
+            flat = [r for batch in per_batch for r in batch]
+    if flat is None:  # no service / service down: grade locally in threads
+        with ThreadPoolExecutor(max_workers=max_workers) as pool:
+            flat = list(
+                pool.map(lambda p: _grade_math_pair(p[0], p[1]), pairs)
+            )
+
+    results = [0] * len(generateds)
+    for (_, _, idx), ok in zip(pairs, flat):
+        results[idx] = max(results[idx], int(ok))
+    return results
+
+
+def batch_code_verify(
+    id2info: dict[str, dict],
+    generateds: list[str],
+    query_ids: list[str],
+    *,
+    timeout_s: float = 1000.0,
+    max_workers: int = 8,
+) -> list[int]:
+    """One 0/1 per generated, order-aligned (parity:
+    functioncall/code/verify.py code_verify)."""
+    assert len(generateds) == len(query_ids)
+    items = []
+    for qid, gen in zip(query_ids, generateds):
+        info = id2info[str(qid).split("@")[0]]
+        items.append(
+            {"completion": gen, "input_output": info.get("input_output") or {}}
+        )
+
+    addr = service_addr()
+    if addr:
+        payloads = []
+        for i in range(0, len(items), _BATCH_SIZE):
+            chunk = items[i : i + _BATCH_SIZE]
+            payloads.append(
+                {
+                    "uid": f"code-{i}-{i + len(chunk)}",
+                    "language": "CODE",
+                    "payload": {"items": chunk},
+                }
+            )
+        per_batch = _run_async(_post_batches(addr, payloads, timeout_s))
+        if per_batch is not None:
+            return [r for batch in per_batch for r in batch]
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        return list(pool.map(lambda it: grade_code_batch([it])[0], items))

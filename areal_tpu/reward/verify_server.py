@@ -1,0 +1,112 @@
+"""Standalone verification service (the server half of remote_verify.py).
+
+Run ``python -m areal_tpu.reward.verify_server --port 8841`` on any CPU
+host and point trainers at it with
+``AREAL_VERIFIER_SERVICE=host:8841`` — math (sympy) and code (sandboxed
+subprocess testcases) grading then runs off the TPU host. The reference
+only ships the client against an assumed external "functioncall"
+deployment (/root/reference/functioncall/base/call.py:21); this service is
+the deployable counterpart.
+
+Endpoints:
+  GET  /health  -> {"status": "ok"}
+  POST /verify  {"uid", "language": "MATH"|"CODE", "payload": ...}
+                -> {"results": [0/1, ...]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from aiohttp import web
+
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("verify_server")
+
+
+class VerifyServer:
+    def __init__(self, max_workers: int = 8):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(max_workers=max_workers)
+        self._runner: web.AppRunner | None = None
+        self.addr: str | None = None
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _verify(self, request: web.Request) -> web.Response:
+        from areal_tpu.reward.remote_verify import (
+            grade_code_batch,
+            grade_math_batch,
+        )
+
+        body = await request.json()
+        lang = str(body.get("language", "")).upper()
+        payload = body.get("payload") or {}
+        loop = asyncio.get_running_loop()
+        try:
+            if lang == "MATH":
+                results = await loop.run_in_executor(
+                    self._pool,
+                    grade_math_batch,
+                    payload["answers"],
+                    payload["solutions"],
+                )
+            elif lang == "CODE":
+                results = await loop.run_in_executor(
+                    self._pool, grade_code_batch, payload["items"]
+                )
+            else:
+                return web.json_response(
+                    {"status": "error", "message": f"unknown language {lang}"},
+                    status=400,
+                )
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            return web.json_response(
+                {"status": "error", "message": repr(e)}, status=500
+            )
+        return web.json_response({"uid": body.get("uid"), "results": results})
+
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024**2)
+        app.router.add_get("/health", self._health)
+        app.router.add_post("/verify", self._verify)
+        return app
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> str:
+        self._runner = web.AppRunner(self.build_app())
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual_port = self._runner.addresses[0][1]
+        self.addr = f"127.0.0.1:{actual_port}" if host in ("0.0.0.0", "::") else f"{host}:{actual_port}"
+        logger.info(f"verify server on {self.addr}")
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+        self._pool.shutdown(wait=False)
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="areal_tpu verification service")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8841)
+    p.add_argument("--max-workers", type=int, default=8)
+    args = p.parse_args(argv)
+
+    async def serve():
+        srv = VerifyServer(max_workers=args.max_workers)
+        await srv.start(args.host, args.port)
+        await asyncio.Event().wait()
+
+    asyncio.run(serve())
+
+
+if __name__ == "__main__":
+    main()

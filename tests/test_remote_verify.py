@@ -1,0 +1,100 @@
+"""Remote batch verification (parity: /root/reference/functioncall/ —
+client batching/retries + the service the reference assumes externally)."""
+
+import asyncio
+import threading
+
+import pytest
+
+from areal_tpu.reward.remote_verify import (
+    batch_code_verify,
+    batch_math_verify,
+    grade_code_batch,
+    grade_math_batch,
+)
+
+MATH_INFO = {
+    "m1": dict(solutions=[r"\boxed{\frac{1}{2}}"]),
+    "m2": dict(solutions=["4", "four"]),
+}
+CODE_INFO = {
+    "c1": dict(
+        input_output=dict(inputs=["3 4\n"], outputs=["7\n"], fn_name="")
+    ),
+}
+GOOD_CODE = "```python\na, b = map(int, input().split())\nprint(a + b)\n```"
+BAD_CODE = "```python\nprint(0)\n```"
+
+
+def test_local_fallback_math(monkeypatch):
+    monkeypatch.delenv("AREAL_VERIFIER_SERVICE", raising=False)
+    monkeypatch.delenv("FUNCTIONCALL_SERVICE_DOMAIN", raising=False)
+    out = batch_math_verify(
+        MATH_INFO,
+        [r"so \boxed{0.5}", r"\boxed{3}", "the answer is 4"],
+        ["m1", "m1@idx:0", "m2"],
+    )
+    assert out == [1, 0, 1]
+
+
+def test_local_fallback_code(monkeypatch):
+    monkeypatch.delenv("AREAL_VERIFIER_SERVICE", raising=False)
+    monkeypatch.delenv("FUNCTIONCALL_SERVICE_DOMAIN", raising=False)
+    out = batch_code_verify(
+        CODE_INFO, [GOOD_CODE, BAD_CODE], ["c1", "c1@1"]
+    )
+    assert out == [1, 0]
+
+
+@pytest.fixture
+def verify_service():
+    """A real VerifyServer on a private loop thread."""
+    from areal_tpu.reward.verify_server import VerifyServer
+
+    srv = VerifyServer(max_workers=2)
+    loop = asyncio.new_event_loop()
+    addr_box = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        addr_box["addr"] = loop.run_until_complete(srv.start("127.0.0.1", 0))
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    deadline = 50
+    while "addr" not in addr_box and deadline:
+        import time
+
+        time.sleep(0.1)
+        deadline -= 1
+    assert "addr" in addr_box, "verify server failed to start"
+    yield addr_box["addr"]
+    asyncio.run_coroutine_threadsafe(srv.stop(), loop).result(timeout=10)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
+
+
+def test_service_round_trip(monkeypatch, verify_service):
+    monkeypatch.setenv("AREAL_VERIFIER_SERVICE", verify_service)
+    out = batch_math_verify(
+        MATH_INFO, [r"\boxed{1/2}", r"\boxed{9}"], ["m1", "m1@2"]
+    )
+    assert out == [1, 0]
+    out = batch_code_verify(CODE_INFO, [GOOD_CODE], ["c1"])
+    assert out == [1]
+
+
+def test_service_down_degrades_to_local(monkeypatch):
+    # nothing listens here: the client must retry, then grade locally,
+    # never zeroing out rewards
+    monkeypatch.setenv("AREAL_VERIFIER_SERVICE", "127.0.0.1:1")
+    out = batch_math_verify(MATH_INFO, ["the answer is 4"], ["m2"])
+    assert out == [1]
+
+
+def test_grade_batches_direct():
+    assert grade_math_batch([r"\boxed{2/4}"], [r"\frac{1}{2}"]) == [1]
+    assert grade_code_batch(
+        [dict(completion=GOOD_CODE, input_output=CODE_INFO["c1"]["input_output"])]
+    ) == [1]
