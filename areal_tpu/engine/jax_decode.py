@@ -171,6 +171,7 @@ class JaxDecodeEngine(InferenceEngine):
         self._rng = None
         self._chunk_fns: dict[bool, Callable] = {}
         self._prefill_fns: dict[int, Callable] = {}
+        self._batched_prefill_fns: dict[tuple[int, int], Callable] = {}
         self._fork_fns: dict[int, Callable] = {}
         self._suffix_prefill_fns: dict[tuple[int, int], Callable] = {}
         self._write_fns: dict[int, Callable] = {}
@@ -295,6 +296,7 @@ class JaxDecodeEngine(InferenceEngine):
         self._embed_prefill_fns.clear()
         self._chunk_fns.clear()
         self._prefill_fns.clear()
+        self._batched_prefill_fns.clear()
         self._fork_fns.clear()
         self._suffix_prefill_fns.clear()
         self._prefix_lookup.clear()
@@ -775,29 +777,60 @@ class JaxDecodeEngine(InferenceEngine):
         prompt's LAST token is withheld from prefill and fed as the chunk's
         first decode input)."""
         if bucket not in self._prefill_fns:
-            cfg = self.model_config
+            batched = self._get_batched_prefill_fn(bucket, 1)
 
             def prefill_and_write(params, kc, vc, ids, positions, slot, true_len):
-                valid = jnp.arange(ids.shape[0]) < true_len
-                _, k, v = prefill(
-                    params, ids, positions, cfg, valid=valid, with_logits=False
-                )
-                kc = jax.lax.dynamic_update_slice(
+                # one kernel body for single AND wave-batched prefill
+                # (B=1 vmap is numerically identical)
+                return batched(
+                    params,
                     kc,
-                    k[:, None].astype(kc.dtype),
-                    (0, slot, 0, 0, 0),
-                )
-                vc = jax.lax.dynamic_update_slice(
                     vc,
-                    v[:, None].astype(vc.dtype),
-                    (0, slot, 0, 0, 0),
+                    jnp.asarray(ids)[None],
+                    positions,
+                    jnp.asarray([slot], dtype=jnp.int32),
+                    jnp.asarray([true_len], dtype=jnp.int32),
                 )
+
+            self._prefill_fns[bucket] = prefill_and_write
+        return self._prefill_fns[bucket]
+
+    def _get_batched_prefill_fn(self, bucket: int, B: int):
+        """Prefill B DISTINCT prompts in one dispatch (vmapped transformer
+        pass + per-slot cache writes): an admission wave of unique prompts
+        — rollout start, eval bursts — fills the MXU with a [B, bucket]
+        batch instead of B serial [bucket] passes."""
+        key = (bucket, B)
+        if key not in self._batched_prefill_fns:
+            cfg = self.model_config
+
+            def batched(params, kc, vc, ids_b, positions, slots_b, lens_b):
+                def core(ids, true_len):
+                    valid = jnp.arange(bucket) < true_len
+                    _, k, v = prefill(
+                        params, ids, positions, cfg, valid=valid,
+                        with_logits=False,
+                    )
+                    return k, v
+
+                ks, vs = jax.vmap(core)(ids_b, lens_b)  # [B, L, bucket, ...]
+                for b in range(B):  # static unroll: B is a compile key
+                    kc = jax.lax.dynamic_update_slice(
+                        kc,
+                        ks[b][:, None].astype(kc.dtype),
+                        (0, slots_b[b], 0, 0, 0),
+                    )
+                    vc = jax.lax.dynamic_update_slice(
+                        vc,
+                        vs[b][:, None].astype(vc.dtype),
+                        (0, slots_b[b], 0, 0, 0),
+                    )
                 return kc, vc
 
-            self._prefill_fns[bucket] = jax.jit(
-                prefill_and_write, donate_argnums=(1, 2)
+            self._batched_prefill_fns[key] = jax.jit(
+                batched, donate_argnums=(1, 2)
             )
-        return self._prefill_fns[bucket]
+        return self._batched_prefill_fns[key]
 
     def _get_fork_fn(self, bucket: int):
         """Copy `bucket` KV rows from a donor slot to a destination slot.
@@ -984,6 +1017,13 @@ class JaxDecodeEngine(InferenceEngine):
         admitted = False
         prefill_budget = max(int(self.config.max_prefill_tokens), _PREFILL_BUCKET)
         did_prefill = False
+        # Wave batching: full prefills collected during the loop and
+        # dispatched together afterwards (vmapped when >=2 share a
+        # bucket); same-wave duplicate prompts fork the wave's primary
+        # instead of prefilling at all.
+        wave_primaries: dict[tuple[int, ...], int] = {}
+        wave_pending: list[tuple[int, np.ndarray, int, int, tuple]] = []
+        wave_forks: list[tuple[int, tuple, int]] = []
         while True:
             item = self._next_request()
             if item is None:
@@ -1015,8 +1055,12 @@ class JaxDecodeEngine(InferenceEngine):
             # re-submit shared history + a short new suffix). Fork the
             # shared rows, prefill only the suffix.
             partial = None
-            if donor is None and P > 1 and not item.image_data:
-                found = self._find_shared_prefix(tuple(prompt[:-1]))
+            covered_t = tuple(prompt[:-1]) if P > 1 else ()
+            is_wave_dup = (
+                P > 1 and not item.image_data and covered_t in wave_primaries
+            )
+            if donor is None and P > 1 and not item.image_data and not is_wave_dup:
+                found = self._find_shared_prefix(covered_t)
                 if found is not None:
                     donor_slot, plen = found
                     suffix_bucket = min(
@@ -1025,7 +1069,27 @@ class JaxDecodeEngine(InferenceEngine):
                     if plen + suffix_bucket <= self.config.context_length:
                         partial = (donor_slot, plen, suffix_bucket)
                         needs_prefill_bucket = suffix_bucket
-            if did_prefill and donor is None and needs_prefill_bucket > prefill_budget:
+                else:
+                    # a WAVE primary's prompt is a proper prefix of this
+                    # one: its rows aren't written yet (flush is deferred),
+                    # so hold this request one pass — next pass the
+                    # registration exists and the cheap fork+suffix path
+                    # applies instead of a full shared-history prefill
+                    n_cov = len(covered_t)
+                    if any(
+                        len(k) >= _MIN_SHARED_PREFIX
+                        and len(k) < n_cov
+                        and covered_t[: len(k)] == k
+                        for k in wave_primaries
+                    ):
+                        self._overflow.insert(0, item)
+                        break
+            if (
+                did_prefill
+                and donor is None
+                and not is_wave_dup  # duplicates are memcpy forks: free
+                and needs_prefill_bucket > prefill_budget
+            ):
                 # budget exhausted for this pass; run the decode chunk first
                 self._overflow.insert(0, item)
                 break
@@ -1118,14 +1182,14 @@ class JaxDecodeEngine(InferenceEngine):
             elif resumed is None and P > 1:
                 pre = P - 1
                 bucket = min(_next_bucket(pre), self.config.context_length)
-                prefill_budget -= bucket
-                did_prefill = True
-                self._n_prefills += 1
                 self._unregister_prefix(slot_idx)
-                ids = np.zeros(bucket, dtype=np.int32)
-                ids[:pre] = prompt[:-1]
-                positions = np.arange(bucket, dtype=np.int32)
                 if item.image_data:
+                    prefill_budget -= bucket
+                    did_prefill = True
+                    self._n_prefills += 1
+                    ids = np.zeros(bucket, dtype=np.int32)
+                    ids[:pre] = prompt[:-1]
+                    positions = np.arange(bucket, dtype=np.int32)
                     img_embeds = self._encode_images(item.image_data)
                     cos, sin, delta = self._image_rope_tables(
                         prompt, item.image_data, bucket
@@ -1147,7 +1211,49 @@ class JaxDecodeEngine(InferenceEngine):
                             cos,
                             sin,
                         )
+                elif is_wave_dup:
+                    # duplicate within this admission wave: fork from the
+                    # primary once its (deferred) prefill has run
+                    wave_forks.append(
+                        (slot_idx, wave_primaries[covered_t], covered_t, bucket)
+                    )
+                    self._n_prefix_forks += 1
                 else:
+                    prefill_budget -= bucket
+                    did_prefill = True
+                    self._n_prefills += 1
+                    ids = np.zeros(bucket, dtype=np.int32)
+                    ids[:pre] = prompt[:-1]
+                    wave_primaries[covered_t] = slot_idx
+                    wave_pending.append(
+                        (slot_idx, ids, pre, bucket, covered_t)
+                    )
+            self._slots[slot_idx] = item
+            self._slot_lengths[slot_idx] = P - 1
+            admitted = True
+        self._flush_wave(wave_pending, wave_forks)
+        return admitted
+
+    def _flush_wave(
+        self,
+        pending: list[tuple[int, np.ndarray, int, int, tuple]],
+        forks: list[tuple[int, int, tuple, int]],
+    ) -> None:
+        """Execute the wave's deferred prefills (batched per bucket) and
+        then the duplicate-prompt forks that depend on them."""
+        by_bucket: dict[int, list] = {}
+        for entry in pending:
+            by_bucket.setdefault(entry[3], []).append(entry)
+        for bucket, entries in by_bucket.items():
+            positions = np.arange(bucket, dtype=np.int32)
+            i = 0
+            while i < len(entries):
+                rest = len(entries) - i
+                B = 8 if rest >= 8 else 4 if rest >= 4 else 2 if rest >= 2 else 1
+                group = entries[i : i + B]
+                i += B
+                if B == 1:
+                    slot_idx, ids, pre, _, _ = group[0]
                     fn = self._get_prefill_fn(bucket)
                     with self._weight_lock:
                         self._k_cache, self._v_cache = fn(
@@ -1159,11 +1265,33 @@ class JaxDecodeEngine(InferenceEngine):
                             slot_idx,
                             pre,
                         )
-                    self._register_prefix(slot_idx, list(prompt[:-1]))
-            self._slots[slot_idx] = item
-            self._slot_lengths[slot_idx] = P - 1
-            admitted = True
-        return admitted
+                else:
+                    fn = self._get_batched_prefill_fn(bucket, B)
+                    with self._weight_lock:
+                        self._k_cache, self._v_cache = fn(
+                            self.params,
+                            self._k_cache,
+                            self._v_cache,
+                            jnp.asarray(
+                                np.stack([g[1] for g in group])
+                            ),
+                            jnp.asarray(positions),
+                            jnp.asarray(
+                                np.array([g[0] for g in group], np.int32)
+                            ),
+                            jnp.asarray(
+                                np.array([g[2] for g in group], np.int32)
+                            ),
+                        )
+                for slot_idx, _, _, _, covered_t in group:
+                    self._register_prefix(slot_idx, list(covered_t))
+        for dst, src, covered_t, bucket in forks:
+            fork = self._get_fork_fn(bucket)
+            with self._weight_lock:
+                self._k_cache, self._v_cache = fork(
+                    self._k_cache, self._v_cache, src, dst
+                )
+            self._register_prefix(dst, list(covered_t))
 
     def _finished(self, item: _Slot) -> bool:
         g = item.gconfig
