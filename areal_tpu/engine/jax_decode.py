@@ -1023,7 +1023,7 @@ class JaxDecodeEngine(InferenceEngine):
         # instead of prefilling at all.
         wave_primaries: dict[tuple[int, ...], int] = {}
         wave_pending: list[tuple[int, np.ndarray, int, int, tuple]] = []
-        wave_forks: list[tuple[int, tuple, int]] = []
+        wave_forks: list[tuple[int, int, tuple, int]] = []
         while True:
             item = self._next_request()
             if item is None:
@@ -1846,12 +1846,16 @@ class JaxDecodeEngine(InferenceEngine):
                 active_tokens += int(self._slot_lengths[i]) + 1
         # queued work is load too: a router that only saw running slots
         # would dogpile a server whose queue is deep (its slot count
-        # saturates at max_running_requests). Snapshot iteration over the
-        # queue's deque is racy-but-safe: both containers only ever hold
-        # _Slot items, and metrics tolerate an off-by-a-request snapshot.
+        # saturates at max_running_requests). The queue's deque must be
+        # snapshotted under its mutex — iterating a deque the scheduler
+        # thread mutates mid-iteration raises RuntimeError. _overflow is a
+        # plain list; list() of it is atomic enough for an off-by-a-request
+        # metrics snapshot.
+        with self._request_q.mutex:
+            queued_items = list(self._request_q.queue)
         queued_tokens = 0
         queued = 0
-        for item in list(self._request_q.queue) + list(self._overflow):
+        for item in queued_items + list(self._overflow):
             queued += 1
             queued_tokens += len(item.prompt) + item.gconfig.max_new_tokens
         return {

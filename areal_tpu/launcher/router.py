@@ -41,6 +41,10 @@ from areal_tpu.utils.network import find_free_ports, gethostip
 
 logger = logging.getLogger("rollout_router")
 
+# consecutive /metrics failures before a server's measured token load is
+# considered stale and dropped (least_token_usage then uses the estimate)
+_METRICS_FAIL_LIMIT = 3
+
 
 class DecodeRouter:
     def __init__(
@@ -73,6 +77,11 @@ class DecodeRouter:
         # requests routed since that poll (not yet visible in the metrics).
         self._measured_tokens: dict[str, float] = {}
         self._est_since_poll: dict[str, float] = defaultdict(float)
+        # consecutive failed /metrics polls per server: after
+        # _METRICS_FAIL_LIMIT the measured base is dropped so _token_load
+        # degrades to the router's own estimate instead of keeping an
+        # arbitrarily stale measurement forever
+        self._metrics_fail: dict[str, int] = defaultdict(int)
         self._qid_to_server: dict[str, str] = {}
         self._qid_cost: dict[str, float] = {}
         # one qid may carry several in-flight requests (a GRPO group shares
@@ -150,7 +159,14 @@ class DecodeRouter:
                     self._versions = versions
                     for s, v, load, est_snapshot in probes:
                         if v is None or load is None:
+                            self._metrics_fail[s] += 1
+                            if (
+                                self._metrics_fail[s] >= _METRICS_FAIL_LIMIT
+                                and s in self._measured_tokens
+                            ):
+                                del self._measured_tokens[s]
                             continue
+                        self._metrics_fail[s] = 0
                         self._measured_tokens[s] = load
                         # subtract only what the measurement could have
                         # seen; later routings keep their estimated cost

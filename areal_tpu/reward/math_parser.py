@@ -630,29 +630,55 @@ def _equation_equal(pred: str, target: str) -> bool:
     return symbolic_equal(pdiff, tdiff) or symbolic_equal(f"-({pdiff})", tdiff)
 
 
+def _math_equal_worker(q, pred: str, target: str) -> None:
+    """Module-level so the forkserver context can pickle it."""
+    try:
+        q.put(bool(math_equal(pred, target)))
+    except Exception:  # noqa: BLE001 — any grading crash is a False
+        q.put(False)
+
+
+_GRADING_CTX = None
+
+
+def _grading_ctx():
+    """Forkserver multiprocessing context for grading workers.
+
+    The graders run inside thread pools (remote_verify / verify_server);
+    fork-from-threads is deprecated in 3.12 and can inherit a wedged lock
+    state that silently grades 0. A forkserver's children fork from a
+    clean single-threaded server process instead. sympy is preloaded into
+    the server so each grading child gets it by fork, not by import.
+    """
+    global _GRADING_CTX
+    if _GRADING_CTX is None:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("forkserver")
+        try:
+            # "__main__" keeps the default behaviour of importing the
+            # caller's main module ONCE in the server (children then fork
+            # with it loaded); dropping it would make every grading child
+            # re-import a possibly heavy entrypoint inside its timeout.
+            ctx.set_forkserver_preload(
+                ["__main__", "sympy", "areal_tpu.reward.math_parser"]
+            )
+        except Exception:  # noqa: BLE001 — preload is an optimization only
+            pass
+        _GRADING_CTX = ctx
+    return _GRADING_CTX
+
+
 def math_equal_subprocess(pred: str, target: str, timeout_s: float = 5.0) -> bool:
     """math_equal in a worker process with a hard timeout — sympy can hang
     on adversarial inputs; batch eval graders use this (parity: reference
-    call_with_timeout + pebble ProcessPool, math_parser.py:684-744)."""
-    import multiprocessing as mp
+    call_with_timeout + pebble ProcessPool, math_parser.py:684-744).
 
-    # Fork from thread pools is safe here ONLY because the child's single
-    # job is math_equal: pre-importing sympy in the parent makes the
-    # child's lazy import a sys.modules hit, so it cannot block on an
-    # import lock some other parent thread held at fork time. A child
-    # that wedges anyway is terminated at timeout_s and graded False.
-    import sympy  # noqa: F401 — warm the module before forking
-
-    ctx = mp.get_context("fork")
+    A child that wedges anyway is terminated at timeout_s and graded False.
+    """
+    ctx = _grading_ctx()
     q = ctx.Queue()
-
-    def run(q):
-        try:
-            q.put(bool(math_equal(pred, target)))
-        except Exception:
-            q.put(False)
-
-    p = ctx.Process(target=run, args=(q,), daemon=True)
+    p = ctx.Process(target=_math_equal_worker, args=(q, pred, target), daemon=True)
     p.start()
     p.join(timeout_s)
     if p.is_alive():
@@ -660,8 +686,20 @@ def math_equal_subprocess(pred: str, target: str, timeout_s: float = 5.0) -> boo
         p.join()
         return False
     try:
-        return q.get_nowait()
-    except Exception:
+        return q.get(timeout=1.0)
+    except Exception:  # noqa: BLE001 — lost result is a False grade
+        if p.exitcode != 0:
+            # Forkserver children import the caller's __main__; a script
+            # without an `if __name__ == "__main__"` guard dies here and
+            # every grade silently becomes False. Make that loud.
+            import logging
+
+            logging.getLogger("math_parser").warning(
+                "grading worker died rc=%s before producing a result; "
+                "if this is a script, it needs a __main__ guard "
+                "(forkserver re-imports the main module)",
+                p.exitcode,
+            )
         return False
 
 

@@ -1,0 +1,53 @@
+#!/bin/bash
+# Opportunistic real-TPU bench capture.
+#
+# The axon relay that fronts the one real TPU chip goes down for whole
+# sessions, and jax backend init HANGS (rather than erroring) when it is
+# down. This watcher probes in a timeout-wrapped subprocess every
+# PROBE_INTERVAL seconds; the first time the probe sees a non-CPU device it
+# runs the full bench (train+decode+prefix+grpo) plus the per-mode lines
+# and saves everything into bench_artifacts/ for the driver/judge.
+#
+# Usage: nohup bash tools/tpu_watch.sh &   (or via the session runner)
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p bench_artifacts
+LOG=bench_artifacts/r05_watch.log
+PROBE_INTERVAL=${PROBE_INTERVAL:-600}
+MAX_HOURS=${MAX_HOURS:-11}
+END=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+
+log "watcher start (probe every ${PROBE_INTERVAL}s, max ${MAX_HOURS}h)"
+while [ "$(date +%s)" -lt "$END" ]; do
+  if timeout 150 python -c \
+      "import jax; d = jax.devices(); assert d[0].platform != 'cpu', d; print(d)" \
+      >> "$LOG" 2>&1; then
+    log "TPU reachable — capturing bench lines"
+    # One full line first (the headline artifact), then the dev modes.
+    got_headline=0
+    for mode in all prefix grpo; do
+      out="bench_artifacts/r05_tpu_${mode}.json"
+      log "mode=$mode start"
+      AREAL_BENCH_CHILD=1 AREAL_BENCH_MODE=$mode \
+        timeout 3000 python bench.py > "$out" 2> "bench_artifacts/r05_tpu_${mode}.err"
+      rc=$?
+      log "mode=$mode rc=$rc $(tail -c 300 "$out" 2>/dev/null)"
+      if [ "$mode" = all ] && tail -n 1 "$out" 2>/dev/null | python -c \
+          "import json,sys; json.loads(sys.stdin.read())" 2>/dev/null; then
+        got_headline=1
+      fi
+    done
+    if [ "$got_headline" = 1 ]; then
+      log "capture complete"
+      exit 0
+    fi
+    # relay flapped mid-capture: re-arm instead of burning the one window
+    log "capture produced no headline line; re-arming probe loop"
+  fi
+  log "relay down; sleeping ${PROBE_INTERVAL}s"
+  sleep "$PROBE_INTERVAL"
+done
+log "watcher gave up after ${MAX_HOURS}h"
+exit 1
