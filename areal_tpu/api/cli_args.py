@@ -222,6 +222,21 @@ class PPOActorConfig(TrainEngineConfig):
     log_agent_stats: bool = False
     log_agent_stats_keys: list[str] = field(default_factory=list)
     max_new_tokens: int = 1024
+    # AEnt clamped-entropy regularization (parity: recipe/AEnt/aent_args.py).
+    # entropy_coeff > 0 adds an entropy bonus to the GRPO loss;
+    # entropy_clamp > 0 excludes that fraction of the vocab (lowest logits)
+    # from the bonus so it can't reward mass on the garbage tail.
+    entropy_coeff: float = 0.0
+    entropy_clamp: float = 0.0
+    # adaptive coefficient: nudge entropy_coeff to keep measured entropy
+    # inside [entropy_low, entropy_high], clipped to the box bounds
+    adaptive_entropy_coeff: bool = False
+    entropy_high: float = 0.5
+    entropy_low: float = 0.1
+    entropy_coeff_lr: float = 0.001
+    entropy_coeff_box_high: float = 0.01
+    entropy_coeff_box_low: float = 1e-5
+    entropy_warmup_steps: int = 0
 
 
 @dataclass

@@ -35,6 +35,7 @@ from areal_tpu.utils import stats_tracker
 from areal_tpu.utils.data import KLEstimator, Normalization
 from areal_tpu.utils.datapack import ffd_allocate
 from areal_tpu.utils.functional import (
+    clamped_entropy_of,
     dynamic_sampling,
     label_logprobs_entropy_of,
     label_logprobs_of,
@@ -65,6 +66,12 @@ class PPOActor:
         # Stable callables: the engine's jit caches are keyed by callable
         # identity, so per-call closures would recompile every step.
         self._logp_fns: dict[float, Any] = {}
+        # AEnt clamped-entropy regularization (parity: recipe/AEnt/actor.py).
+        # The coefficient is a python float here; in adaptive mode it is fed
+        # through the batch as a traced scalar so per-step coefficient
+        # updates never retrigger XLA compilation.
+        self.entropy_coeff = config.entropy_coeff
+        self._update_steps = 0
         self._loss_fn = functools.partial(
             grpo_loss_fn,
             temperature=config.temperature,
@@ -72,6 +79,8 @@ class PPOActor:
             eps_clip_higher=config.eps_clip_higher,
             c_clip=config.c_clip,
             behav_imp_weight_cap=config.behav_imp_weight_cap,
+            entropy_coeff=config.entropy_coeff,
+            entropy_clamp=config.entropy_clamp,
         )
         if self._fused_head():
             self._loss_fn.hidden_loss = True
@@ -249,8 +258,20 @@ class PPOActor:
 
         self.engine.train()
         loss_fn = self._loss_fn
+        if cfg.adaptive_entropy_coeff:
+            # traced token-aligned broadcast of the current coefficient
+            # ([B, T]: packing flattens it to the token stream, and the
+            # engine's _host_mb keeps only token-aligned arrays): the value
+            # reaches the loss as a runtime operand, so adapting it every
+            # update leaves the compiled step program untouched
+            data["entropy_coeff"] = np.full(
+                np.asarray(data["attention_mask"]).shape,
+                self.entropy_coeff,
+                np.float32,
+            )
 
         all_stats = []
+        ent_trace: list[float] = []
         for mb in _split_minibatches(data, cfg.ppo_n_minibatches):
             train_stat = self.engine.train_batch(
                 mb,
@@ -259,11 +280,32 @@ class PPOActor:
                     np.asarray(x["loss_mask"]).sum()
                 ),
             )
+            if "entropy" in train_stat:
+                ent_trace.append(float(train_stat["entropy"]))
             stats_tracker.scalar(**train_stat)
             all_stats.append(stats_tracker.export_all())
+        self._update_steps += 1
+        if cfg.adaptive_entropy_coeff and ent_trace:
+            self._adapt_entropy_coeff(sum(ent_trace) / len(ent_trace))
         all_stats[0].update(global_stats)
         self._publish_training_samples(len(reward_score))
         return all_stats
+
+    def _adapt_entropy_coeff(self, entropy: float) -> None:
+        """AEnt adaptive coefficient (parity: recipe/AEnt/actor.py:94-100):
+        below entropy_low the bonus grows, above entropy_high it shrinks,
+        clipped to the box bounds. No-op during warmup."""
+        cfg = self.config
+        if self._update_steps <= cfg.entropy_warmup_steps:
+            return
+        self.entropy_coeff -= cfg.entropy_coeff_lr * (
+            min(0.0, entropy - cfg.entropy_low)
+            + max(0.0, entropy - cfg.entropy_high)
+        )
+        self.entropy_coeff = min(
+            max(self.entropy_coeff, cfg.entropy_coeff_box_low),
+            cfg.entropy_coeff_box_high,
+        )
 
     def _publish_training_samples(self, n_seqs: int) -> None:
         """Publish the global consumed-sample counter that the fleet
@@ -337,8 +379,11 @@ def grpo_loss_fn(
     eps_clip_higher: float | None,
     c_clip: float | None,
     behav_imp_weight_cap: float | None,
+    entropy_coeff: float = 0.0,
+    entropy_clamp: float = 0.0,
 ):
-    """Packed GRPO/decoupled-PPO loss (parity: actor.py:313-341).
+    """Packed GRPO/decoupled-PPO loss (parity: actor.py:313-341; AEnt
+    entropy regularization: recipe/AEnt/actor.py:125-226).
 
     Labels are the packed stream rolled by -1; cross-segment labels carry
     loss_mask == 0 (the mask was rolled per-row before packing), so they
@@ -351,6 +396,9 @@ def grpo_loss_fn(
     prox_logp = mb["prox_logp"]
 
     logprobs, entropy = label_logprobs_entropy_of(logits, labels, temperature)
+    if entropy_clamp > 0:
+        # the logged "entropy" becomes the clamped one, as in the reference
+        entropy = clamped_entropy_of(logits, entropy_clamp, temperature)
     loss, stat = ppo_actor_loss_fn(
         logprobs=logprobs,
         proximal_logprobs=prox_logp,
@@ -364,12 +412,20 @@ def grpo_loss_fn(
     )
 
     # Per-update stats (masked means over trained tokens), mirroring the
-    # reference's recorded set. Entropy is logging-only: stop_gradient keeps
-    # it out of the policy gradient exactly as the reference detaches it.
+    # reference's recorded set. Entropy is logging-only unless the AEnt
+    # bonus is active: stop_gradient keeps it out of the policy gradient
+    # exactly as the reference detaches it.
     n = jnp.maximum(loss_mask.sum(), 1)
 
     def masked_mean(x, m=loss_mask):
         return jnp.where(m, x, 0.0).sum() / n
+
+    # "entropy_coeff" in the batch (adaptive mode) overrides the static
+    # coefficient: a traced operand, so host-side adaptation between
+    # updates never recompiles the step.
+    coeff = mb["entropy_coeff"][0] if "entropy_coeff" in mb else entropy_coeff
+    if "entropy_coeff" in mb or entropy_coeff:
+        loss = loss - coeff * masked_mean(entropy)
 
     stats = dict(
         entropy=jax.lax.stop_gradient(masked_mean(entropy)),

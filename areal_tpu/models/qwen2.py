@@ -898,6 +898,22 @@ class LMHead:
             vocab_chunk=self.cfg.loss_vocab_chunk,
         )
 
+    def clamped_entropy(
+        self, entropy_clamp: float, temperature: float = 1.0
+    ) -> jax.Array:
+        """AEnt token-space-clamped entropy (token-chunked; the clamp's
+        order-statistic threshold can't ride the online vocab scan)."""
+        from areal_tpu.ops.fused_xent import chunked_clamped_entropy
+
+        w, vh = self._head()
+        return chunked_clamped_entropy(
+            self.hidden,
+            w,
+            head_is_vh=vh,
+            entropy_clamp=entropy_clamp,
+            temperature=temperature,
+        )
+
 
 def rope_table(
     positions: jax.Array,

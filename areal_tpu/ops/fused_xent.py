@@ -130,3 +130,49 @@ def chunked_label_logprobs(
         entropy = lse - e / s
         return logp, entropy
     return logp
+
+
+def chunked_clamped_entropy(
+    hidden: jax.Array,
+    head_w: jax.Array,
+    *,
+    head_is_vh: bool = False,
+    entropy_clamp: float = 0.2,
+    temperature: float = 1.0,
+    token_chunk: int = 128,
+):
+    """Clamped softmax entropy (AEnt) for the fused-head engine mode.
+
+    The clamp threshold is a global order statistic over the vocab, so it
+    cannot fold into chunked_label_logprobs' online vocab scan. Instead:
+    iterate over TOKEN chunks, materialize each chunk's [token_chunk, V]
+    logits (78 MB f32 at 128x151936 — bounded, vs 2.5 GiB for the full
+    [T, V]), and run the dense clamped entropy on it.  `jax.checkpoint`
+    on the chunk body makes the backward recompute the chunk logits, so
+    peak memory stays one chunk in both passes.
+
+    Exact — matches clamped_softmax_entropy(dense logits) to f32 roundoff.
+    """
+    from areal_tpu.utils.functional import clamped_softmax_entropy
+
+    T, H = hidden.shape
+    pad = (-T) % token_chunk
+    h = jnp.pad(hidden, ((0, pad), (0, 0))) if pad else hidden
+    hc = h.reshape(-1, token_chunk, H)
+
+    @jax.checkpoint
+    def one(h_chunk):
+        if head_is_vh:
+            logits = jnp.einsum(
+                "th,vh->tv", h_chunk, head_w,
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            logits = jnp.einsum(
+                "th,hv->tv", h_chunk, head_w,
+                preferred_element_type=jnp.float32,
+            )
+        return clamped_softmax_entropy(logits, entropy_clamp, temperature)
+
+    ent = jax.lax.map(one, hc).reshape(-1)
+    return ent[:T] if pad else ent

@@ -32,6 +32,8 @@ __all__ = [
     "gather_logprobs_entropy",
     "label_logprobs_of",
     "label_logprobs_entropy_of",
+    "clamped_softmax_entropy",
+    "clamped_entropy_of",
     "masked_normalization",
     "ppo_actor_loss_fn",
     "ppo_critic_loss_fn",
@@ -83,6 +85,55 @@ def gather_logprobs_entropy(
     entropy = -jnp.sum(probs * logprobs_all, axis=-1)
     gathered = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
     return gathered - logz, entropy
+
+
+def clamped_softmax_entropy(
+    logits: jax.Array, entropy_clamp: float, temperature: float = 1.0
+) -> jax.Array:
+    """Token-space-clamped softmax entropy (AEnt regularizer).
+
+    Parity: recipe/AEnt/functional.py:16 (clamped_softmax_entropy) — the
+    ``floor(V * entropy_clamp)`` lowest-logit tokens are excluded, the
+    remaining distribution renormalized, and its entropy returned. The
+    clamp keeps the entropy bonus from pushing probability mass onto the
+    garbage tail of the vocabulary.
+
+    TPU-first: the reference round-trips logits to CPU for a bottom-k
+    index mask; here the threshold is the k-th order statistic from an
+    on-device vocab sort and the entropy comes from a masked logsumexp
+    (H = lse - E[x]), all fused by XLA. The keep-mask is stop_gradient'd
+    (discrete), the entropy itself is differentiable w.r.t. kept logits.
+    Ties at the threshold keep all tied tokens (deterministic, and never
+    removes more than the reference would).
+    """
+    if not 0.0 <= entropy_clamp < 1.0:
+        raise ValueError(f"entropy_clamp must be in [0, 1), got {entropy_clamp}")
+    v = logits.shape[-1]
+    k_rm = min(int(v * entropy_clamp), v - 1)
+    x = logits.astype(jnp.float32) / max(temperature, 1e-6)
+    if k_rm <= 0:
+        logz = jax.scipy.special.logsumexp(x, axis=-1)
+        p = jnp.exp(x - logz[..., None])
+        return logz - jnp.sum(p * x, axis=-1)
+    # smallest KEPT logit: indices [0, k_rm) of the ascending sort are removed
+    tau = jax.lax.stop_gradient(jnp.sort(x, axis=-1)[..., k_rm])
+    keep = jax.lax.stop_gradient(x >= tau[..., None])
+    masked = jnp.where(keep, x, -jnp.inf)
+    lse = jax.scipy.special.logsumexp(masked, axis=-1)
+    p = jnp.where(keep, jnp.exp(x - lse[..., None]), 0.0)
+    return lse - jnp.sum(p * x, axis=-1)
+
+
+def clamped_entropy_of(x, entropy_clamp: float, temperature: float = 1.0):
+    """Clamped entropy — dense [T, V] logits or LMHead (fused vocab head).
+
+    The fused path cannot clamp inside its online-logsumexp vocab scan
+    (the threshold is a global order statistic), so LMHead materializes
+    logits in token chunks under remat instead (models/qwen2.py::LMHead
+    .clamped_entropy)."""
+    if hasattr(x, "clamped_entropy"):
+        return x.clamped_entropy(entropy_clamp, temperature)
+    return clamped_softmax_entropy(x, entropy_clamp, temperature)
 
 
 def masked_normalization(
