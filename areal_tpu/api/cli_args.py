@@ -269,6 +269,13 @@ class JaxDecodeConfig:
     context_length: int = 32768
     max_running_requests: int = 64
     page_size: int = 128  # tokens per KV page (TPU-friendly multiple of 128)
+    # Paged-KV pool budget in tokens (x num_layers x kv heads). None =
+    # full provisioning (max_running_requests x context_length — the dense
+    # worst case). Setting it smaller is the point of paging: N concurrent
+    # 32k-context slots only consume blocks for the tokens they actually
+    # hold, with parked-KV eviction / donor-registry drop / active-slot
+    # preemption (internal requeue) when the pool runs dry.
+    kv_pool_tokens: int | None = None
     hbm_utilization: float = 0.85
     max_prefill_tokens: int = 8192
     # tokens generated per decode-loop dispatch; interrupts land on chunk

@@ -6,11 +6,16 @@ RemoteSGLangEngine + areal/experimental/sglang_engine.py local engine +
 realhf generation engine realhf/impl/model/nn/real_llm_generate.py).
 
 TPU-first design:
-- **Static-shape continuous batching**: R fixed decode slots with KV cache
-  [L, R, S, nKV, hd]. The batched decode step and the chunked decode loop
-  compile ONCE; requests hot-swap in and out of slots without recompiles
-  (the reference relies on SGLang's CUDA-graph capture for the same
-  property).
+- **Static-shape continuous batching**: R fixed decode slots over a PAGED
+  KV pool [L, n_blocks, block_size, nKV, hd] with host-side per-slot block
+  tables (engine/kv_pool.py) — reserved KV tracks tokens actually held,
+  not R x context worst case, and prefix forks are block-table aliasing.
+  The batched decode step and the chunked decode loop compile ONCE per
+  (sampler, block-bucket) key; requests hot-swap in and out of slots
+  without recompiles (the reference relies on SGLang's CUDA-graph capture
+  + paged radix cache for the same properties). Under pool pressure the
+  scheduler evicts parked KV, drops donor registrations, then preempts
+  active slots with an internal requeue invisible to clients.
 - **Chunked, interruptible generation**: the scheduler emits
   `new_tokens_per_chunk` tokens per dispatch (a lax.scan inside one jit).
   pause_generation() takes effect on chunk boundaries; weight updates swap
@@ -56,6 +61,7 @@ from areal_tpu.api.io_struct import (
     ModelResponse,
     WeightUpdateMeta,
 )
+from areal_tpu.engine.kv_pool import KVBlockAllocator, PoolDry
 from areal_tpu.models import hf_io
 from areal_tpu.models.qwen2 import ModelConfig, decode_step, prefill
 from areal_tpu.parallel import mesh as mesh_lib
@@ -167,6 +173,8 @@ class JaxDecodeEngine(InferenceEngine):
         self._n_prefix_forks = 0
         self._n_prefix_inplace = 0
         self._n_suffix_prefills = 0  # partial-prefix hits (multi-turn)
+        self._n_preemptions = 0  # pool-pressure internal requeues
+        self._alloc: KVBlockAllocator | None = None  # set in initialize
         self._gen_token_count = 0  # total tokens generated since init
         self._rng = None
         self._chunk_fns: dict[bool, Callable] = {}
@@ -246,10 +254,24 @@ class JaxDecodeEngine(InferenceEngine):
         R = self.config.max_running_requests
         S = self.config.context_length
         kv_dtype = jnp.dtype(self.config.kv_cache_dtype)
+        # Paged KV pool: [L, n_blocks, block_size, nKV, hd] + host-side
+        # per-slot block tables (engine/kv_pool.py). kv_pool_tokens=None
+        # provisions the dense worst case (R x S), so default behavior and
+        # memory are unchanged; a budget makes reserved memory track the
+        # tokens actually held.
+        bs = min(int(self.config.page_size), S)
+        max_bps = -(-S // bs)
+        if self.config.kv_pool_tokens:
+            n_blocks = (
+                max(-(-int(self.config.kv_pool_tokens) // bs), max_bps) + 1
+            )
+        else:
+            n_blocks = R * max_bps + 1
+        self._alloc = KVBlockAllocator(R, n_blocks, bs, max_bps)
         shape = (
             cfg.num_hidden_layers,
-            R,
-            S,
+            n_blocks,
+            bs,
             cfg.num_key_value_heads,
             cfg.head_dim_,
         )
@@ -289,6 +311,7 @@ class JaxDecodeEngine(InferenceEngine):
             self._executor.destroy()
         self.params = None
         self._k_cache = self._v_cache = None
+        self._alloc = None
         # vision tower + compiled-fn caches hold device buffers too
         self._vision_params = None
         self._freq_counts = None
@@ -472,7 +495,7 @@ class JaxDecodeEngine(InferenceEngine):
             img_tok = self._image_token_id
 
             def prefill_and_write(
-                params, kc, vc, ids, positions, slot, true_len, img_embeds,
+                params, kp, vp, ids, positions, bt_row, true_len, img_embeds,
                 cos, sin,
             ):
                 valid = jnp.arange(ids.shape[0]) < true_len
@@ -491,13 +514,19 @@ class JaxDecodeEngine(InferenceEngine):
                     rope_cos=cos,
                     rope_sin=sin,
                 )
-                kc = jax.lax.dynamic_update_slice(
-                    kc, k[:, None].astype(kc.dtype), (0, slot, 0, 0, 0)
+                L, _, bsz, nkv, hd = kp.shape
+                nb_w = bt_row.shape[0]
+                pad = nb_w * bsz - bucket
+                if pad:
+                    k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                    v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                kp = kp.at[:, bt_row].set(
+                    k.reshape(L, nb_w, bsz, nkv, hd).astype(kp.dtype)
                 )
-                vc = jax.lax.dynamic_update_slice(
-                    vc, v[:, None].astype(vc.dtype), (0, slot, 0, 0, 0)
+                vp = vp.at[:, bt_row].set(
+                    v.reshape(L, nb_w, bsz, nkv, hd).astype(vp.dtype)
                 )
-                return kc, vc
+                return kp, vp
 
             self._embed_prefill_fns[key] = jax.jit(
                 prefill_and_write, donate_argnums=(1, 2)
@@ -650,13 +679,27 @@ class JaxDecodeEngine(InferenceEngine):
         return min(b, S)
 
     def _get_chunk_fn(self, use_topp: bool, use_freq: bool = False,
-                      s_bucket: int | None = None):
+                      nb: int = 1):
         """Chunked decode loop; static sampler variants.
 
-        `s_bucket` (None = full context): the scan runs on a
-        [L, R, s_bucket] slice of the KV cache and writes it back — one
-        extra slice copy per chunk buys n_chunk decode steps attending
-        over s_bucket rows instead of the full context.
+        `nb`: blocks per slot this chunk. The kernel gathers each slot's
+        first nb blocks from the paged pool into a contiguous
+        [L, R, nb*block_size] workspace, runs the scan on it, and
+        scatters the blocks back — two HBM copies per chunk (the same
+        cost the dense engine's bucketed slice paid) buying n_chunk
+        decode steps of O(nb*block_size) attention. Aliased
+        (prefix-shared) blocks are never modified by the scan, so the
+        duplicate scatter writes identical bytes (see kv_pool.py).
+
+        Cost accounting vs the dense engine's full-context case (which
+        scanned in place with zero copies): the copies add ~2/n_chunk of
+        one workspace read — 1.6% extra KV bandwidth at the default
+        128-token chunk — in exchange for block aliasing and a pool that
+        tracks live tokens. Long-context serving should set
+        kv_pool_tokens so the pool (and this workspace) is bounded by
+        live KV, not by R x context; pushing the table lookup into a
+        Pallas paged-attention kernel would remove the copies entirely
+        and is the designated successor here.
 
         `use_topp=False` (the common RL rollout setting, top_p == 1):
         plain categorical over temperature-scaled logits. `use_topp=True`:
@@ -670,13 +713,11 @@ class JaxDecodeEngine(InferenceEngine):
         penalty * per-token generation counts); the [R, V] count buffer
         only exists for batches where some slot requested it.
         """
-        key_ = (use_topp, use_freq, s_bucket)
+        key_ = (use_topp, use_freq, nb)
         if key_ in self._chunk_fns:
             return self._chunk_fns[key_]
         cfg = self.model_config
         n_chunk = self.config.new_tokens_per_chunk
-        S_full = self.config.context_length
-        sliced = s_bucket is not None and s_bucket < S_full
 
         def sample(logits, key, temps, top_ps, greedy):
             logits = logits.astype(jnp.float32)
@@ -709,21 +750,19 @@ class JaxDecodeEngine(InferenceEngine):
         # counts carry and the penalty lines only trace when requested —
         # shared decode logic cannot diverge between the two compiled fns.
         def make_chunk(freq: bool):
-            def chunk(params, kc, vc, last_tokens, lengths, active, key,
+            def chunk(params, kp, vp, bt, last_tokens, lengths, active, key,
                       temps, top_ps, greedy, rope_delta, *freq_args):
                 freq_pens, counts0 = freq_args if freq else (None, None)
-                if sliced:
-                    # carve the live prefix of the cache: one slice copy
-                    # buys n_chunk steps of O(s_bucket) attention instead
-                    # of O(context_length)
-                    kc_full, vc_full = kc, vc
-                    L, R, _, nkv, hd = kc.shape
-                    kc = jax.lax.slice(
-                        kc, (0, 0, 0, 0, 0), (L, R, s_bucket, nkv, hd)
-                    )
-                    vc = jax.lax.slice(
-                        vc, (0, 0, 0, 0, 0), (L, R, s_bucket, nkv, hd)
-                    )
+                # gather each slot's blocks into a contiguous workspace
+                L, _, bsz, nkv, hd = kp.shape
+                R = bt.shape[0]
+                idx = bt.reshape(-1)
+                kc = jnp.take(kp, idx, axis=1).reshape(
+                    L, R, nb * bsz, nkv, hd
+                )
+                vc = jnp.take(vp, idx, axis=1).reshape(
+                    L, R, nb * bsz, nkv, hd
+                )
 
                 def step(carry, _):
                     tokens, lengths, kc, vc, key, counts = carry
@@ -749,22 +788,22 @@ class JaxDecodeEngine(InferenceEngine):
                 (last, lengths, kc, vc, key, counts), (toks, logps) = (
                     jax.lax.scan(step, init, None, length=n_chunk)
                 )
-                if sliced:
-                    kc = jax.lax.dynamic_update_slice(
-                        kc_full, kc, (0, 0, 0, 0, 0)
-                    )
-                    vc = jax.lax.dynamic_update_slice(
-                        vc_full, vc, (0, 0, 0, 0, 0)
-                    )
+                # scatter the workspace blocks back into the pool
+                kp = kp.at[:, idx].set(
+                    kc.reshape(L, R * nb, bsz, nkv, hd)
+                )
+                vp = vp.at[:, idx].set(
+                    vc.reshape(L, R * nb, bsz, nkv, hd)
+                )
                 if freq:
-                    return kc, vc, last, lengths, key, toks, logps, counts
-                return kc, vc, last, lengths, key, toks, logps
+                    return kp, vp, last, lengths, key, toks, logps, counts
+                return kp, vp, last, lengths, key, toks, logps
 
             return chunk
 
         fn = jax.jit(
             make_chunk(use_freq),
-            donate_argnums=(1, 2, 12) if use_freq else (1, 2),
+            donate_argnums=(1, 2, 13) if use_freq else (1, 2),
         )
         self._chunk_fns[key_] = fn
         return fn
@@ -779,7 +818,8 @@ class JaxDecodeEngine(InferenceEngine):
         if bucket not in self._prefill_fns:
             batched = self._get_batched_prefill_fn(bucket, 1)
 
-            def prefill_and_write(params, kc, vc, ids, positions, slot, true_len):
+            def prefill_and_write(params, kc, vc, ids, positions, bt_row,
+                                  true_len):
                 # one kernel body for single AND wave-batched prefill
                 # (B=1 vmap is numerically identical)
                 return batched(
@@ -788,7 +828,7 @@ class JaxDecodeEngine(InferenceEngine):
                     vc,
                     jnp.asarray(ids)[None],
                     positions,
-                    jnp.asarray([slot], dtype=jnp.int32),
+                    jnp.asarray(bt_row, dtype=jnp.int32)[None],
                     jnp.asarray([true_len], dtype=jnp.int32),
                 )
 
@@ -804,7 +844,8 @@ class JaxDecodeEngine(InferenceEngine):
         if key not in self._batched_prefill_fns:
             cfg = self.model_config
 
-            def batched(params, kc, vc, ids_b, positions, slots_b, lens_b):
+            def batched(params, kp, vp, ids_b, positions, bts_b, lens_b):
+                # bts_b: [B, nb_w] block-table rows to scatter into
                 def core(ids, true_len):
                     valid = jnp.arange(bucket) < true_len
                     _, k, v = prefill(
@@ -814,84 +855,112 @@ class JaxDecodeEngine(InferenceEngine):
                     return k, v
 
                 ks, vs = jax.vmap(core)(ids_b, lens_b)  # [B, L, bucket, ...]
+                L, _, bsz, nkv, hd = kp.shape
+                nb_w = bts_b.shape[1]
+                pad = nb_w * bsz - bucket
                 for b in range(B):  # static unroll: B is a compile key
-                    kc = jax.lax.dynamic_update_slice(
-                        kc,
-                        ks[b][:, None].astype(kc.dtype),
-                        (0, slots_b[b], 0, 0, 0),
+                    k, v = ks[b], vs[b]  # [L, bucket, nkv, hd]
+                    if pad:
+                        # rows past the bucket land in the tail of the last
+                        # block: positions >= covered, never attended before
+                        # decode overwrites them
+                        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                    kp = kp.at[:, bts_b[b]].set(
+                        k.reshape(L, nb_w, bsz, nkv, hd).astype(kp.dtype)
                     )
-                    vc = jax.lax.dynamic_update_slice(
-                        vc,
-                        vs[b][:, None].astype(vc.dtype),
-                        (0, slots_b[b], 0, 0, 0),
+                    vp = vp.at[:, bts_b[b]].set(
+                        v.reshape(L, nb_w, bsz, nkv, hd).astype(vp.dtype)
                     )
-                return kc, vc
+                return kp, vp
 
             self._batched_prefill_fns[key] = jax.jit(
                 batched, donate_argnums=(1, 2)
             )
         return self._batched_prefill_fns[key]
 
-    def _get_fork_fn(self, bucket: int):
-        """Copy `bucket` KV rows from a donor slot to a destination slot.
+    def _get_block_copy_fn(self):
+        """Copy ONE pool block (the fork boundary's partial block).
 
-        A pure HBM memcpy (dynamic_slice + dynamic_update_slice over the
-        slot axis) — orders of magnitude cheaper than re-running the
-        transformer prefill it replaces. Rows past the covered prefix may
-        carry the donor's generated tokens; harmless, because the
-        destination's slot length is set to the covered count and decode
-        only ever attends rows below the length before overwriting them."""
-        if bucket not in self._fork_fns:
+        Prefix forks are block-table aliasing on the host (kv_pool.py) —
+        the only device work left is this single-block copy, versus the
+        dense engine's O(prefix-length) row memcpy, and versus the
+        transformer prefill both replace."""
+        if True not in self._fork_fns:
 
-            def fork(kc, vc, src, dst):
-                L, _, _, nkv, hd = kc.shape
-                k_rows = jax.lax.dynamic_slice(
-                    kc, (0, src, 0, 0, 0), (L, 1, bucket, nkv, hd)
+            def copy_block(kp, vp, src_b, dst_b):
+                k = jnp.take(kp, src_b[None], axis=1)
+                v = jnp.take(vp, src_b[None], axis=1)
+                kp = kp.at[:, dst_b[None]].set(k)
+                vp = vp.at[:, dst_b[None]].set(v)
+                return kp, vp
+
+            self._fork_fns[True] = jax.jit(copy_block, donate_argnums=(0, 1))
+        return self._fork_fns[True]
+
+    def _device_fork(self, src: int, dst: int, covered: int) -> None:
+        """Alias the donor's full blocks and copy the boundary block.
+        Raises PoolDry when the boundary block cannot be allocated."""
+        cp = self._alloc.fork(src, dst, covered)
+        if cp is not None:
+            src_b, dst_b = cp
+            fn = self._get_block_copy_fn()
+            with self._weight_lock:
+                self._k_cache, self._v_cache = fn(
+                    self._k_cache,
+                    self._v_cache,
+                    jnp.asarray(src_b, jnp.int32),
+                    jnp.asarray(dst_b, jnp.int32),
                 )
-                v_rows = jax.lax.dynamic_slice(
-                    vc, (0, src, 0, 0, 0), (L, 1, bucket, nkv, hd)
-                )
-                kc = jax.lax.dynamic_update_slice(kc, k_rows, (0, dst, 0, 0, 0))
-                vc = jax.lax.dynamic_update_slice(vc, v_rows, (0, dst, 0, 0, 0))
-                return kc, vc
 
-            self._fork_fns[bucket] = jax.jit(fork, donate_argnums=(0, 1))
-        return self._fork_fns[bucket]
-
-    def _get_suffix_prefill_fn(self, suffix_bucket: int, prefix_bucket: int):
+    def _get_suffix_prefill_fn(self, suffix_bucket: int, prefix_bucket: int,
+                               nb: int):
         """Prefill a SUFFIX whose context is prefix KV already in the
-        slot's cache rows (partial prefix sharing — multi-turn/tool-use
+        slot's blocks (partial prefix sharing — multi-turn/tool-use
         requests re-submit shared history + a short new segment). The
-        prefix rows are read back from the cache, the suffix runs one
-        parallel pass attending over them (models/qwen2.py
-        prefill_with_prefix), and its KV rows are written at the dynamic
-        offset prefix_len."""
-        key = (suffix_bucket, prefix_bucket)
+        slot's first `nb` blocks are gathered into a contiguous
+        workspace, the suffix runs one parallel pass attending over the
+        prefix rows (models/qwen2.py prefill_with_prefix), its KV rows
+        land at the dynamic offset prefix_len, and the blocks scatter
+        back."""
+        key = (suffix_bucket, prefix_bucket, nb)
         if key not in self._suffix_prefill_fns:
             cfg = self.model_config
 
-            def suffix_prefill(params, kc, vc, ids, slot, suffix_len,
+            def suffix_prefill(params, kp, vp, bt_row, ids, suffix_len,
                                prefix_len):
                 from areal_tpu.models.qwen2 import prefill_with_prefix
 
-                L, R, S, nkv, hd = kc.shape
-                pk = jax.lax.dynamic_slice(
-                    kc, (0, slot, 0, 0, 0), (L, 1, prefix_bucket, nkv, hd)
-                )[:, 0]
-                pv = jax.lax.dynamic_slice(
-                    vc, (0, slot, 0, 0, 0), (L, 1, prefix_bucket, nkv, hd)
-                )[:, 0]
+                L, _, bsz, nkv, hd = kp.shape
+                ws_k = jnp.take(kp, bt_row, axis=1).reshape(
+                    L, nb * bsz, nkv, hd
+                )
+                ws_v = jnp.take(vp, bt_row, axis=1).reshape(
+                    L, nb * bsz, nkv, hd
+                )
+                pk = jax.lax.slice(
+                    ws_k, (0, 0, 0, 0), (L, prefix_bucket, nkv, hd)
+                )
+                pv = jax.lax.slice(
+                    ws_v, (0, 0, 0, 0), (L, prefix_bucket, nkv, hd)
+                )
                 valid = jnp.arange(ids.shape[0]) < suffix_len
                 ks, vs = prefill_with_prefix(
                     params, ids, pk, pv, prefix_len, cfg, valid=valid
                 )
-                kc = jax.lax.dynamic_update_slice(
-                    kc, ks[:, None].astype(kc.dtype), (0, slot, prefix_len, 0, 0)
+                ws_k = jax.lax.dynamic_update_slice(
+                    ws_k, ks.astype(kp.dtype), (0, prefix_len, 0, 0)
                 )
-                vc = jax.lax.dynamic_update_slice(
-                    vc, vs[:, None].astype(vc.dtype), (0, slot, prefix_len, 0, 0)
+                ws_v = jax.lax.dynamic_update_slice(
+                    ws_v, vs.astype(vp.dtype), (0, prefix_len, 0, 0)
                 )
-                return kc, vc
+                kp = kp.at[:, bt_row].set(
+                    ws_k.reshape(L, nb, bsz, nkv, hd)
+                )
+                vp = vp.at[:, bt_row].set(
+                    ws_v.reshape(L, nb, bsz, nkv, hd)
+                )
+                return kp, vp
 
             self._suffix_prefill_fns[key] = jax.jit(
                 suffix_prefill, donate_argnums=(1, 2)
@@ -948,7 +1017,13 @@ class JaxDecodeEngine(InferenceEngine):
     def _invalidate_prefixes(self) -> None:
         """Weight installs recompute nothing in place: any KV produced by
         the old weights must not seed a request generating under the new
-        ones (same reasoning as _invalidate_parked)."""
+        ones (same reasoning as _invalidate_parked). Blocks held only as
+        donor material (free slots) are returned to the pool; active
+        slots keep theirs (they continue decoding in place)."""
+        for i, key in enumerate(self._slot_prefix):
+            if key is not None and self._slots[i] is None:
+                self._alloc.free_slot(i)
+                self._slot_lengths[i] = 0
         self._prefix_lookup.clear()
         self._slot_prefix = [None] * len(self._slot_prefix)
 
@@ -964,15 +1039,74 @@ class JaxDecodeEngine(InferenceEngine):
     def _active_mask(self) -> np.ndarray:
         return np.array([s is not None for s in self._slots], dtype=bool)
 
-    def _evict_parked_lru(self) -> int | None:
+    def _release_slot_blocks(self, slot: int) -> None:
+        self._unregister_prefix(slot)
+        self._alloc.free_slot(slot)
+        self._slot_lengths[slot] = 0
+
+    def _evict_parked_lru(
+        self, protect: frozenset[int] = frozenset()
+    ) -> int | None:
         """Free the least-recently-parked slot; returns its index."""
-        if not self._parked:
+        candidates = [
+            r for r, (s, _, _) in self._parked.items() if s not in protect
+        ]
+        if not candidates:
             return None
-        rid = min(self._parked, key=lambda r: self._parked[r][2])
+        rid = min(candidates, key=lambda r: self._parked[r][2])
         slot, _, _ = self._parked.pop(rid)
         self._parked_tokens.pop(rid, None)
-        self._slot_lengths[slot] = 0
+        self._release_slot_blocks(slot)
         return slot
+
+    def _reclaim_blocks(self, protect: frozenset[int] = frozenset()) -> bool:
+        """Free SOME blocks under pool pressure, cheapest casualty first:
+        (1) a donor registration held by a free slot (only prefix-reuse
+        lost), then (2) the least-recently-parked interrupted request
+        (its resume re-prefills). One reclaim per call — the caller
+        retries its allocation and comes back if still dry.
+
+        `protect`: slots the CURRENT admission step is reading from or
+        writing into (the fork donor; the claimed-but-not-yet-active
+        slot). Reclaiming one of those would zero the very block table an
+        in-flight fork/suffix-prefill is about to read — the KV would be
+        silently replaced by null-block garbage and then *registered* as
+        a valid shared prefix."""
+        parked_slots = {s for s, _, _ in self._parked.values()}
+        for i, key in enumerate(self._slot_prefix):
+            if (
+                key is not None
+                and self._slots[i] is None
+                and i not in parked_slots
+                and i not in protect
+            ):
+                self._release_slot_blocks(i)
+                return True
+        return self._evict_parked_lru(protect) is not None
+
+    def _ensure_tokens(
+        self, slot: int, tokens: int,
+        protect: frozenset[int] = frozenset(),
+    ) -> bool:
+        protect = protect | {slot}
+        while not self._alloc.ensure(slot, tokens):
+            if not self._reclaim_blocks(protect):
+                return False
+        return True
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Return an ACTIVE slot's request to the queue head and free its
+        blocks (pool pressure; SGLang's recompute-preemption policy). The
+        client sees nothing: the request re-admits with its generated
+        tokens as part of the coverage prompt and decoding continues where
+        it left off — stronger than the reference's abort-and-resubmit
+        over HTTP (remote_inf_engine.py:428-478)."""
+        item = self._slots[slot]
+        self._slots[slot] = None
+        self._release_slot_blocks(slot)
+        if item is not None:
+            self._overflow.insert(0, item)
+            self._n_preemptions += 1
 
     def _take_parked(self, item: _Slot) -> int | None:
         """Slot index whose parked KV covers exactly item.prompt[:-1].
@@ -993,7 +1127,7 @@ class JaxDecodeEngine(InferenceEngine):
         # prompt diverged (edited/truncated): drop the stale cache
         self._parked.pop(item.rid)
         self._parked_tokens.pop(item.rid, None)
-        self._slot_lengths[slot] = 0
+        self._release_slot_blocks(slot)
         return None
 
     def _next_request(self) -> "_Slot | None":
@@ -1028,9 +1162,16 @@ class JaxDecodeEngine(InferenceEngine):
             item = self._next_request()
             if item is None:
                 break
-            prompt = item.prompt
+            # Coverage sequence: prompt plus any tokens already generated
+            # before a pool-pressure preemption returned the request to
+            # the queue — re-admission prefills the whole conversation so
+            # decoding continues exactly where it stopped.
+            prompt = list(item.prompt) + list(item.tokens)
             P = len(prompt)
-            if P + item.gconfig.max_new_tokens > self.config.context_length:
+            if (
+                len(item.prompt) + item.gconfig.max_new_tokens
+                > self.config.context_length
+            ):
                 self._complete(item, stop_reason="length")
                 continue
             # bucket may not exceed the KV cache's sequence capacity —
@@ -1122,22 +1263,32 @@ class JaxDecodeEngine(InferenceEngine):
             if resumed is None and P <= 1:
                 # no prefill: the decode loop writes KV from row 0, which
                 # invalidates whatever prefix this slot may have donated
-                self._unregister_prefix(slot_idx)
+                self._release_slot_blocks(slot_idx)
             if resumed is None and P > 1 and donor is not None:
                 # Prefix-KV hit (the GRPO group case: group_size requests
-                # share one prompt). The donor slot's rows [0, P-1) already
-                # hold this prefix — fork them with a device memcpy instead
-                # of re-running transformer prefill. When the chosen slot IS
-                # the donor (a retired slot re-admitted with the same
-                # prompt), the rows are already in place and nothing moves.
-                bucket = min(_next_bucket(P - 1), self.config.context_length)
+                # share one prompt). The donor slot's blocks [0, P-1)
+                # already hold this prefix — alias them in the block table
+                # and copy only the boundary block, instead of re-running
+                # transformer prefill. When the chosen slot IS the donor
+                # (a retired slot re-admitted with the same prompt), the
+                # rows are already in place and nothing moves.
                 if donor != slot_idx:
                     self._unregister_prefix(slot_idx)
-                    fn = self._get_fork_fn(bucket)
-                    with self._weight_lock:
-                        self._k_cache, self._v_cache = fn(
-                            self._k_cache, self._v_cache, donor, slot_idx
-                        )
+                    try:
+                        self._device_fork(donor, slot_idx, P - 1)
+                    except PoolDry:
+                        # never reclaim the donor mid-fork: its table is
+                        # the source of the alias we are creating
+                        if not self._reclaim_blocks(
+                            frozenset({donor, slot_idx})
+                        ):
+                            self._overflow.insert(0, item)
+                            break
+                        try:
+                            self._device_fork(donor, slot_idx, P - 1)
+                        except PoolDry:
+                            self._overflow.insert(0, item)
+                            break
                     self._register_prefix(slot_idx, list(prompt[:-1]))
                     self._n_prefix_forks += 1
                 else:
@@ -1152,29 +1303,40 @@ class JaxDecodeEngine(InferenceEngine):
                 prefill_budget -= sb
                 did_prefill = True
                 self._n_suffix_prefills += 1
-                # one prefix bucket for BOTH the fork copy and the suffix
-                # fn's prefix slice, so they can never drift apart
+                # one prefix bucket for BOTH the fork and the suffix fn's
+                # prefix slice, so they can never drift apart
                 pb = min(_pow2_bucket(plen), self.config.context_length)
-                if donor_slot != slot_idx:
-                    # copy the shared history's rows; when re-admitting
-                    # into the donor slot itself they are already in place
-                    self._unregister_prefix(slot_idx)
-                    fork = self._get_fork_fn(pb)
-                    with self._weight_lock:
-                        self._k_cache, self._v_cache = fork(
-                            self._k_cache, self._v_cache, donor_slot, slot_idx
-                        )
+                try:
+                    if donor_slot != slot_idx:
+                        # alias the shared history's blocks; re-admitting
+                        # into the donor slot itself leaves them in place
+                        self._unregister_prefix(slot_idx)
+                        self._device_fork(donor_slot, slot_idx, plen)
+                    # protect the donor AND this slot (in the in-place
+                    # donor_slot == slot_idx case the slot is still
+                    # registered and free — reclaiming it would replace
+                    # the shared-history KV with garbage)
+                    if not self._ensure_tokens(
+                        slot_idx, plen + sb, frozenset({donor_slot})
+                    ):
+                        raise PoolDry("suffix blocks")
+                except PoolDry:
+                    self._release_slot_blocks(slot_idx)
+                    self._overflow.insert(0, item)
+                    break
                 suffix = prompt[plen : P - 1]
                 ids = np.zeros(sb, dtype=np.int32)
                 ids[: len(suffix)] = suffix
-                fn = self._get_suffix_prefill_fn(sb, pb)
+                bsz = self._alloc.block_size
+                nb = -(-max(pb, plen + sb) // bsz)
+                fn = self._get_suffix_prefill_fn(sb, pb, nb)
                 with self._weight_lock:
                     self._k_cache, self._v_cache = fn(
                         self.params,
                         self._k_cache,
                         self._v_cache,
+                        jnp.asarray(self._alloc.row(slot_idx, nb)),
                         jnp.asarray(ids),
-                        slot_idx,
                         len(suffix),
                         plen,
                     )
@@ -1183,6 +1345,13 @@ class JaxDecodeEngine(InferenceEngine):
                 pre = P - 1
                 bucket = min(_next_bucket(pre), self.config.context_length)
                 self._unregister_prefix(slot_idx)
+                if not is_wave_dup:
+                    self._alloc.free_slot(slot_idx)
+                    self._slot_lengths[slot_idx] = 0
+                    if not self._ensure_tokens(slot_idx, bucket):
+                        self._overflow.insert(0, item)
+                        break
+                nb_w = -(-bucket // self._alloc.block_size)
                 if item.image_data:
                     prefill_budget -= bucket
                     did_prefill = True
@@ -1205,7 +1374,7 @@ class JaxDecodeEngine(InferenceEngine):
                             self._v_cache,
                             jnp.asarray(ids),
                             jnp.asarray(positions),
-                            slot_idx,
+                            jnp.asarray(self._alloc.row(slot_idx, nb_w)),
                             pre,
                             img_embeds,
                             cos,
@@ -1246,6 +1415,7 @@ class JaxDecodeEngine(InferenceEngine):
             by_bucket.setdefault(entry[3], []).append(entry)
         for bucket, entries in by_bucket.items():
             positions = np.arange(bucket, dtype=np.int32)
+            nb_w = -(-bucket // self._alloc.block_size)
             i = 0
             while i < len(entries):
                 rest = len(entries) - i
@@ -1262,7 +1432,7 @@ class JaxDecodeEngine(InferenceEngine):
                             self._v_cache,
                             jnp.asarray(ids),
                             jnp.asarray(positions),
-                            slot_idx,
+                            self._alloc.row(slot_idx, nb_w),
                             pre,
                         )
                 else:
@@ -1277,7 +1447,9 @@ class JaxDecodeEngine(InferenceEngine):
                             ),
                             jnp.asarray(positions),
                             jnp.asarray(
-                                np.array([g[0] for g in group], np.int32)
+                                np.stack(
+                                    [self._alloc.row(g[0], nb_w) for g in group]
+                                )
                             ),
                             jnp.asarray(
                                 np.array([g[2] for g in group], np.int32)
@@ -1286,11 +1458,40 @@ class JaxDecodeEngine(InferenceEngine):
                 for slot_idx, _, _, _, covered_t in group:
                     self._register_prefix(slot_idx, list(covered_t))
         for dst, src, covered_t, bucket in forks:
-            fork = self._get_fork_fn(bucket)
-            with self._weight_lock:
-                self._k_cache, self._v_cache = fork(
-                    self._k_cache, self._v_cache, src, dst
-                )
+            covered = len(covered_t)
+            try:
+                self._device_fork(src, dst, covered)
+            except PoolDry:
+                ok = self._reclaim_blocks(frozenset({src, dst}))
+                try:
+                    if ok:
+                        self._device_fork(src, dst, covered)
+                    else:
+                        raise PoolDry("wave fork")
+                except PoolDry:
+                    # fall back to a full prefill of the duplicate; if even
+                    # that can't get blocks, requeue the request (invisible
+                    # to the client — same path as pool-pressure preemption)
+                    if self._ensure_tokens(dst, bucket, frozenset({src})):
+                        ids = np.zeros(bucket, dtype=np.int32)
+                        ids[:covered] = covered_t
+                        nb_w = -(-bucket // self._alloc.block_size)
+                        fn = self._get_prefill_fn(bucket)
+                        with self._weight_lock:
+                            self._k_cache, self._v_cache = fn(
+                                self.params,
+                                self._k_cache,
+                                self._v_cache,
+                                jnp.asarray(ids),
+                                jnp.asarray(
+                                    np.arange(bucket, dtype=np.int32)
+                                ),
+                                self._alloc.row(dst, nb_w),
+                                covered,
+                            )
+                    else:
+                        self._preempt_slot(dst)
+                        continue
             self._register_prefix(dst, list(covered_t))
 
     def _finished(self, item: _Slot) -> bool:
@@ -1379,11 +1580,15 @@ class JaxDecodeEngine(InferenceEngine):
                 # (prompt + generated tokens, minus the never-consumed
                 # last one) — register that full span so a follow-up turn
                 # (history + answer + new user turn) forks everything
-                # instead of just the original prompt prefix.
+                # instead of just the original prompt prefix. The slot
+                # keeps its blocks while registered (donor material);
+                # pool pressure reclaims them via _reclaim_blocks.
                 self._register_prefix(
                     slot_idx,
                     (list(item.prompt) + list(item.tokens))[:covered],
                 )
+            else:
+                self._alloc.free_slot(slot_idx)
             self._slot_lengths[slot_idx] = 0
         if item is not None:
             self._complete(item, stop_reason=item.stop_reason or "stop")
@@ -1462,6 +1667,41 @@ class JaxDecodeEngine(InferenceEngine):
 
     def _run_chunk(self, active: np.ndarray):
         R = self.config.max_running_requests
+        n_chunk = self.config.new_tokens_per_chunk
+        S = self.config.context_length
+        # Every active slot needs blocks through this chunk's growth.
+        # Shortest-first so pool pressure preempts as few slots as
+        # possible; a preempted request requeues invisibly (see
+        # _preempt_slot). The pool always fits one full-context slot
+        # (kv_pool.py init guard), so the last survivor can always run.
+        order = sorted(
+            [i for i in range(R) if active[i]],
+            key=lambda i: int(self._slot_lengths[i]),
+        )
+        preempted = set()
+        for i in order:
+            if i in preempted:
+                continue
+            need = min(int(self._slot_lengths[i]) + n_chunk + 1, S)
+            while not self._ensure_tokens(i, need):
+                victims = [
+                    j
+                    for j in order
+                    if j != i and j not in preempted and self._slots[j] is not None
+                ]
+                if not victims:
+                    # i alone must fit (init guard); if ensure still fails
+                    # something is deeply wrong — surface it
+                    raise RuntimeError(
+                        "KV pool cannot back a single active slot"
+                    )
+                v = max(victims, key=lambda j: int(self._slot_lengths[j]))
+                self._preempt_slot(v)
+                preempted.add(v)
+        if preempted:
+            active = self._active_mask()
+            if not active.any():
+                return
         last = np.zeros(R, dtype=np.int32)
         temps = np.ones(R, dtype=np.float32)
         top_ps = np.ones(R, dtype=np.float32)
@@ -1487,9 +1727,9 @@ class JaxDecodeEngine(InferenceEngine):
                 for s in self._slots
             )
         )
-        chunk_fn = self._get_chunk_fn(
-            use_topp, use_freq, self._chunk_bucket(active)
-        )
+        s_bucket = self._chunk_bucket(active)
+        nb = -(-s_bucket // self._alloc.block_size)
+        chunk_fn = self._get_chunk_fn(use_topp, use_freq, nb)
         version_at_chunk = self._version
         chunk_t0 = time.monotonic()
         with self._weight_lock:
@@ -1498,6 +1738,7 @@ class JaxDecodeEngine(InferenceEngine):
                 self.params,
                 self._k_cache,
                 self._v_cache,
+                jnp.asarray(self._alloc.table_slice(nb)),
                 jnp.asarray(last),
                 jnp.asarray(self._slot_lengths),
                 jnp.asarray(active),
@@ -1706,6 +1947,7 @@ class JaxDecodeEngine(InferenceEngine):
         for rid in list(self._parked):
             slot, _, _ = self._parked.pop(rid)
             self._parked_tokens.pop(rid, None)
+            self._alloc.free_slot(slot)
             self._slot_lengths[slot] = 0
         # same staleness argument applies to the prefix-KV registry
         self._invalidate_prefixes()
@@ -1868,6 +2110,13 @@ class JaxDecodeEngine(InferenceEngine):
             "prefix_forks_total": self._n_prefix_forks,
             "prefix_inplace_total": self._n_prefix_inplace,
             "suffix_prefills_total": self._n_suffix_prefills,
+            "preemptions_total": self._n_preemptions,
+            "kv_block_size": self._alloc.block_size if self._alloc else 0,
+            "kv_blocks_total": self._alloc.usable_blocks if self._alloc else 0,
+            "kv_blocks_free": self._alloc.free_blocks if self._alloc else 0,
+            "kv_tokens_allocated": (
+                self._alloc.allocated_tokens() if self._alloc else 0
+            ),
             "weight_version": self._version,
             "paused": self._gen_paused.is_set(),
         }

@@ -395,10 +395,15 @@ def grpo_loss_fn(
     loss_mask = mb["loss_mask"].astype(bool)
     prox_logp = mb["prox_logp"]
 
-    logprobs, entropy = label_logprobs_entropy_of(logits, labels, temperature)
     if entropy_clamp > 0:
-        # the logged "entropy" becomes the clamped one, as in the reference
+        # the logged "entropy" becomes the clamped one, as in the
+        # reference; skip the unclamped entropy's accumulation entirely
+        logprobs = label_logprobs_of(logits, labels, temperature)
         entropy = clamped_entropy_of(logits, entropy_clamp, temperature)
+    else:
+        logprobs, entropy = label_logprobs_entropy_of(
+            logits, labels, temperature
+        )
     loss, stat = ppo_actor_loss_fn(
         logprobs=logprobs,
         proximal_logprobs=prox_logp,

@@ -1,0 +1,344 @@
+"""Paged KV cache: allocator accounting, memory bounds, fork aliasing,
+and pool-pressure preemption parity (parity target: the paged/radix KV
+the reference inherits from SGLang, areal/engine/sglang_remote.py:22)."""
+
+import jax
+import numpy as np
+import pytest
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxDecodeConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.engine.jax_decode import JaxDecodeEngine
+from areal_tpu.engine.kv_pool import KVBlockAllocator, PoolDry
+from areal_tpu.models.qwen2 import ModelConfig, forward, init_params
+
+TINY = ModelConfig(
+    vocab_size=48,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    dtype="float32",
+    param_dtype="float32",
+)
+
+
+def greedy_reference(params, prompt, n_new):
+    seq = list(prompt)
+    for _ in range(n_new):
+        T = len(seq)
+        logits = forward(
+            params,
+            np.array(seq, dtype=np.int32),
+            np.arange(T, dtype=np.int32),
+            np.zeros(T, dtype=np.int32),
+            TINY,
+        )
+        seq.append(int(np.argmax(np.asarray(logits[-1]))))
+    return seq[len(prompt):]
+
+
+# -- allocator unit tests ----------------------------------------------
+
+
+def test_allocator_ensure_and_free():
+    a = KVBlockAllocator(n_slots=4, n_blocks=9, block_size=128,
+                         max_blocks_per_slot=8)
+    assert a.free_blocks == 8  # block 0 is the pinned null block
+    assert a.ensure(0, 200)  # 2 blocks
+    assert a.nblocks[0] == 2 and a.free_blocks == 6
+    assert a.ensure(0, 200)  # idempotent
+    assert a.free_blocks == 6
+    assert a.ensure(0, 500)  # grow to 4
+    assert a.nblocks[0] == 4 and a.free_blocks == 4
+    assert a.allocated_tokens() == 4 * 128
+    a.free_slot(0)
+    assert a.free_blocks == 8 and a.nblocks[0] == 0
+    assert (a.tables[0] == 0).all()
+
+
+def test_allocator_pool_dry_and_guard():
+    a = KVBlockAllocator(4, 9, 128, 8)
+    assert a.ensure(0, 8 * 128)
+    assert not a.ensure(1, 1)  # dry
+    with pytest.raises(AssertionError):
+        KVBlockAllocator(4, 8, 128, 8)  # pool smaller than one full slot
+
+
+def test_allocator_fork_aliases_full_blocks():
+    a = KVBlockAllocator(4, 17, 128, 8)
+    assert a.ensure(0, 300)  # 3 blocks: 2 full + 1 partial under covered=300
+    free_before = a.free_blocks
+    cp = a.fork(0, 1, covered=300)
+    # 2 aliased + 1 fresh partial: only ONE new block consumed
+    assert a.free_blocks == free_before - 1
+    assert cp is not None and cp[0] == a.tables[0, 2] and cp[1] == a.tables[1, 2]
+    assert (a.tables[1, :2] == a.tables[0, :2]).all()
+    assert a.refcount[a.tables[0, 0]] == 2
+    # aliased blocks survive one holder's free
+    a.free_slot(0)
+    assert a.refcount[a.tables[1, 0]] == 1
+    # block-aligned boundary: no copy needed
+    assert a.ensure(2, 256)
+    assert a.fork(2, 3, covered=256) is None
+    assert (a.tables[3, :2] == a.tables[2, :2]).all()
+
+
+def test_allocator_fork_rolls_back_on_dry():
+    a = KVBlockAllocator(3, 9, 128, 8)
+    assert a.ensure(0, 300)  # 3 blocks
+    assert a.ensure(2, 5 * 128)  # hog the remaining 5; free now 0
+    with pytest.raises(PoolDry):
+        a.fork(0, 1, covered=300)  # needs 1 block for the boundary copy
+    # rollback: slot 1 empty, slot 0's refcounts back to 1
+    assert a.nblocks[1] == 0
+    assert a.refcount[a.tables[0, 0]] == 1
+
+
+# -- engine integration -------------------------------------------------
+
+
+def test_pool_reserves_far_less_than_dense(cpu_devices):
+    """The headline paging property: 8 slots x 2048 context reserves a
+    17-block pool (2176 tokens), not 8 x 2048 = 16384 rows — and short
+    concurrent requests all serve correctly out of it."""
+    cfg = JaxDecodeConfig(
+        context_length=2048,
+        max_running_requests=8,
+        new_tokens_per_chunk=8,
+        kv_pool_tokens=1024,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng.set_model(params, TINY)
+    eng.initialize()
+    try:
+        n_blocks = eng._k_cache.shape[1]
+        assert n_blocks == 17, n_blocks  # max(8, 16) + 1
+        assert n_blocks * eng._k_cache.shape[2] < 8 * 2048 / 4
+        prompts = [[i + 1, 5, 9, 2] for i in range(6)]
+        import threading
+
+        results = [None] * len(prompts)
+
+        def run(i):
+            results[i] = eng.generate(
+                ModelRequest(
+                    input_ids=list(prompts[i]),
+                    gconfig=GenerationHyperparameters(
+                        greedy=True, max_new_tokens=6
+                    ),
+                ),
+                timeout=600,
+            )
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(len(prompts))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(600)
+        for i, p in enumerate(prompts):
+            assert results[i] is not None
+            assert results[i].output_tokens == greedy_reference(params, p, 6)
+        m = eng.get_metrics()
+        assert m["kv_blocks_total"] == 16
+        assert m["kv_tokens_allocated"] <= 16 * 128
+    finally:
+        eng.destroy()
+
+
+@pytest.mark.slow
+def test_pool_pressure_preempts_and_stays_exact(cpu_devices):
+    """When concurrent long generations outgrow the pool, the engine
+    preempts (frees blocks, requeues internally) and every request still
+    returns the exact greedy continuation — the client never sees the
+    preemption."""
+    cfg = JaxDecodeConfig(
+        context_length=2048,
+        max_running_requests=4,
+        new_tokens_per_chunk=8,
+        kv_pool_tokens=128,  # floor: 16 usable blocks (one full slot)
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng.set_model(params, TINY)
+    eng.initialize()
+    try:
+        # 4 x 450-token prompts prefill into 4 blocks each (16 = the
+        # whole pool); once a generation crosses the 512-row boundary the
+        # chunk needs a 5th block and must preempt a peer
+        rng = np.random.RandomState(0)
+        prompts = [
+            [int(t) for t in rng.randint(1, 40, size=450)] for _ in range(4)
+        ]
+        import threading
+
+        results = [None] * 4
+
+        def run(i):
+            results[i] = eng.generate(
+                ModelRequest(
+                    input_ids=list(prompts[i]),
+                    gconfig=GenerationHyperparameters(
+                        greedy=True, max_new_tokens=72
+                    ),
+                ),
+                timeout=900,
+            )
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(900)
+        for i in range(4):
+            assert results[i] is not None, f"request {i} did not finish"
+            assert results[i].output_tokens == greedy_reference(
+                params, prompts[i], 72
+            ), f"request {i} diverged"
+        assert eng.get_metrics()["preemptions_total"] > 0
+    finally:
+        eng.destroy()
+
+
+@pytest.mark.slow
+def test_group_fork_shares_blocks(cpu_devices):
+    """A GRPO group's shared prompt is stored ONCE: later group members
+    alias the donor's full blocks and own only the boundary block plus
+    their generation tail."""
+    cfg = JaxDecodeConfig(
+        context_length=2048,
+        max_running_requests=8,
+        new_tokens_per_chunk=8,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng.set_model(params, TINY)
+    eng.initialize()
+    try:
+        prompt = [1 + (i % 40) for i in range(300)]  # covered=299: 2 full + 1
+        import threading
+
+        results = [None] * 4
+
+        def run(i):
+            results[i] = eng.generate(
+                ModelRequest(
+                    input_ids=list(prompt),
+                    gconfig=GenerationHyperparameters(
+                        greedy=True, max_new_tokens=4
+                    ),
+                ),
+                timeout=600,
+            )
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(600)
+        expected = greedy_reference(params, prompt, 4)
+        for i in range(4):
+            assert results[i] is not None
+            assert results[i].output_tokens == expected
+        m = eng.get_metrics()
+        assert m["prefix_forks_total"] >= 3, m
+        # dense would hold 4 x 3 = 12+ blocks of prompt KV; aliasing holds
+        # the 2 full blocks once + one boundary/tail block per request
+        assert m["kv_tokens_allocated"] <= (2 + 4 * 1 + 2) * 128, m
+    finally:
+        eng.destroy()
+
+
+@pytest.mark.slow
+def test_reclaim_never_eats_inflight_donor(cpu_devices):
+    """Regression (round-5 review): a fork that hits PoolDry must not
+    reclaim its own DONOR — here a PARKED slot whose admission-time
+    registration makes it the prefix donor. Pre-fix, _reclaim_blocks
+    evicted that parked slot, zeroed its block table, and the retried
+    fork aliased null-block garbage and REGISTERED it as a valid shared
+    prefix (silent rollout corruption). Post-fix the fork defers, the
+    donor survives, and the deferred request later decodes exactly."""
+    from areal_tpu.engine.jax_decode import _Slot
+
+    cfg = JaxDecodeConfig(
+        context_length=2048,
+        max_running_requests=4,
+        new_tokens_per_chunk=8,
+        kv_pool_tokens=128,  # floor: 16 usable blocks
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    eng.set_model(params, TINY)
+    eng.initialize()
+    try:
+        eng.pause_generation()  # drive the scheduler by hand
+        # A prefills (registers its prompt prefix), decodes one chunk,
+        # then is interrupted -> parked in slot 0, registration intact
+        prompt_a = [1 + (i % 40) for i in range(300)]  # 3 blocks
+        a = _Slot(rid="a", prompt=list(prompt_a),
+                  gconfig=GenerationHyperparameters(greedy=True,
+                                                    max_new_tokens=64),
+                  future=None, loop=None)
+        eng._request_q.put(a)
+        with eng._sched_lock:
+            eng._admit()
+            eng._run_chunk(eng._active_mask())
+        eng.abort_all()
+        (donor_slot, _, _) = eng._parked["a"]
+        assert tuple(prompt_a[:-1]) in eng._prefix_lookup
+        donor_blocks = list(eng._alloc.tables[donor_slot, :3])
+
+        # hog exactly the remaining 13 blocks with a long active request
+        hog = _Slot(rid="hog",
+                    prompt=[2 + (i % 30) for i in range(1657)],
+                    gconfig=GenerationHyperparameters(greedy=True,
+                                                      max_new_tokens=120),
+                    future=None, loop=None)
+        eng._request_q.put(hog)
+        with eng._sched_lock:
+            eng._admit()
+        assert eng._alloc.free_blocks == 0, eng._alloc.free_blocks
+
+        # same-prompt request: donor fork needs a boundary block -> dry.
+        # The reclaim scan must NOT evict the parked donor.
+        c = _Slot(rid="c", prompt=list(prompt_a),
+                  gconfig=GenerationHyperparameters(greedy=True,
+                                                    max_new_tokens=4),
+                  future=None, loop=None)
+        eng._request_q.put(c)
+        with eng._sched_lock:
+            eng._admit()
+        assert "a" in eng._parked, "reclaim evicted the in-flight donor"
+        assert list(eng._alloc.tables[donor_slot, :3]) == donor_blocks
+        assert all(b != 0 for b in donor_blocks)
+        assert tuple(prompt_a[:-1]) in eng._prefix_lookup
+
+        # drive to completion: the hog finishes (pool pressure may evict
+        # the parked donor NOW - legal, c is no longer mid-fork), then c
+        # admits and must decode the exact greedy continuation
+        for _ in range(60):
+            with eng._sched_lock:
+                eng._admit()
+                act = eng._active_mask()
+                if act.any():
+                    eng._run_chunk(act)
+            if c.stop_reason is not None and hog.stop_reason is not None:
+                break
+        assert c.stop_reason is not None, "c never completed"
+        assert c.tokens == greedy_reference(params, prompt_a, 4)
+    finally:
+        eng.destroy()
