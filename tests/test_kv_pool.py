@@ -103,6 +103,7 @@ def test_allocator_fork_rolls_back_on_dry():
 # -- engine integration -------------------------------------------------
 
 
+@pytest.mark.slow
 def test_pool_reserves_far_less_than_dense(cpu_devices):
     """The headline paging property: 8 slots x 2048 context reserves a
     17-block pool (2176 tokens), not 8 x 2048 = 16384 rows — and short
