@@ -438,3 +438,58 @@ class AllocationMode:
                 train_backend=train_backend,
             )
         raise InvalidAllocationModeError(f"unknown node {node!r}")
+
+    def check_hbm(
+        self,
+        model_cfg,
+        device_kind: str,
+        *,
+        microbatch_tokens: int = 8192,
+        remat: bool = True,
+        decode_slots: int = 64,
+        decode_context: int = 32768,
+        decode_pool_tokens: int | None = None,
+        utilization: float = 0.9,
+    ) -> dict:
+        """Validate that this allocation's train AND gen halves fit the
+        target chip's HBM, using the closed-form estimator (utils/hbm.py).
+
+        The reference validates allocation strings only for arithmetic
+        consistency (areal/api/alloc_mode.py world-size checks); chips that
+        OOM three hours into a run are discovered the hard way. Here the
+        plan is rejected up front. Raises AllocationValidationError with
+        the per-component breakdown; returns {"train": ..., "gen": ...}
+        breakdowns when both fit.
+        """
+        from areal_tpu.utils import hbm
+
+        report: dict = {}
+        if self.train is not None:
+            est = hbm.estimate_train_hbm(
+                model_cfg,
+                dp=self.train.dp_size,
+                tp=self.train.tp_size,
+                pp=self.train.pp_size,
+                sp=self.train.cp_size,
+                microbatch_tokens=microbatch_tokens,
+                remat=remat,
+            )
+            try:
+                hbm.check_fit(est, device_kind, utilization=utilization)
+            except MemoryError as e:
+                raise AllocationValidationError(f"train half: {e}") from None
+            report["train"] = est.breakdown()
+        if self.gen is not None and self.gen_world_size > 0:
+            est = hbm.estimate_decode_hbm(
+                model_cfg,
+                tp=self.gen.tp_size,
+                slots=decode_slots,
+                context_length=decode_context,
+                pool_tokens=decode_pool_tokens,
+            )
+            try:
+                hbm.check_fit(est, device_kind, utilization=utilization)
+            except MemoryError as e:
+                raise AllocationValidationError(f"gen half: {e}") from None
+            report["gen"] = est.breakdown()
+        return report

@@ -69,6 +69,28 @@ from areal_tpu.utils.data import (
 
 logger = logging.getLogger("jax_engine")
 
+
+def _memory_analysis_dict(compiled) -> dict:
+    """Per-program XLA memory analysis (bytes); {} where the backend does
+    not expose one (CPU returns a stub on some jaxlib versions)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # pragma: no cover - backend-dependent
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for k in (
+        "argument_size_in_bytes",
+        "output_size_in_bytes",
+        "temp_size_in_bytes",
+        "generated_code_size_in_bytes",
+    ):
+        v = getattr(ma, k, None)
+        if v is not None:
+            out[k] = int(v)
+    return out
+
 # Keys that carry per-token values and therefore ride along into the packed
 # device micro-batch. Anything else (per-sequence scalars, metadata) stays on
 # host — loss functions only consume token-aligned arrays.
@@ -225,29 +247,7 @@ class JaxTrainEngine(TrainEngine):
                 )
             self.model_config = ModelConfig.from_hf_config(cfg.path, **overrides)
 
-        pp_enabled = self.mesh.shape.get(mesh_lib.AXIS_PP, 1) > 1
-        if pp_enabled:
-            assert self.model_config.scan_layers, (
-                "pipeline parallelism (pp>1) requires scan_layers=True: the "
-                "stacked [L, ...] layer dim is what shards over the pp axis"
-            )
-            pp = self.mesh.shape[mesh_lib.AXIS_PP]
-            assert self.model_config.num_hidden_layers % pp == 0, (
-                f"num_hidden_layers={self.model_config.num_hidden_layers} "
-                f"must divide evenly into pp={pp} stages"
-            )
-        rules = mesh_lib.default_rules(
-            fsdp=bool(cfg.jax.fsdp_axes), pp=pp_enabled
-        )
-        axes = param_logical_axes(self.model_config)
-        if self.model_config.lora_rank:
-            axes["lora"] = lora_param_axes(self.model_config)
-        self._param_shardings = jax.tree.map(
-            lambda a: mesh_lib.named_sharding(self.mesh, a, rules),
-            axes,
-            is_leaf=lambda x: isinstance(x, tuple),
-        )
-        self._mb_sharding = mesh_lib.packed_sharding(self.mesh)
+        self._build_shardings()
 
         if cfg.init_from_scratch or not cfg.path:
             host_params = init_params(
@@ -278,6 +278,143 @@ class JaxTrainEngine(TrainEngine):
                 out_shardings=self._opt_state_shardings(),
             )(self._trainable_sub(self.params))
             self.opt_state = opt_state
+
+    def _build_shardings(self) -> None:
+        """Mesh rules → param/micro-batch NamedShardings (shared by real
+        initialization and the abstract plan check, so the two can never
+        drift on the sharding layout)."""
+        pp_enabled = self.mesh.shape.get(mesh_lib.AXIS_PP, 1) > 1
+        if pp_enabled:
+            assert self.model_config.scan_layers, (
+                "pipeline parallelism (pp>1) requires scan_layers=True: the "
+                "stacked [L, ...] layer dim is what shards over the pp axis"
+            )
+            pp = self.mesh.shape[mesh_lib.AXIS_PP]
+            assert self.model_config.num_hidden_layers % pp == 0, (
+                f"num_hidden_layers={self.model_config.num_hidden_layers} "
+                f"must divide evenly into pp={pp} stages"
+            )
+        rules = mesh_lib.default_rules(
+            fsdp=bool(self.config.jax.fsdp_axes), pp=pp_enabled
+        )
+        axes = param_logical_axes(self.model_config)
+        if self.model_config.lora_rank:
+            axes["lora"] = lora_param_axes(self.model_config)
+        self._param_shardings = jax.tree.map(
+            lambda a: mesh_lib.named_sharding(self.mesh, a, rules),
+            axes,
+            is_leaf=lambda x: isinstance(x, tuple),
+        )
+        self._mb_sharding = mesh_lib.packed_sharding(self.mesh)
+
+    def plan_compile_check(
+        self, mb_tokens: int, loss_fn: Callable | None = None
+    ) -> dict:
+        """AOT-compile the full sharded train step WITHOUT materializing
+        parameters: validates that a real-scale plan (full depth, full
+        width) builds into an XLA program — catching sharding rule
+        mismatches, axis-divisibility errors, and layout problems — on any
+        host, before a single parameter byte is allocated.
+
+        The reference has no analogue: its Megatron/FSDP engines only fail
+        at real initialization on real GPUs. Under XLA, compilation is
+        separable from execution (`jit(...).lower(abstract).compile()`), so
+        a laptop CPU can prove the v5p-128 7B program compiles.
+
+        Returns per-program XLA memory-analysis numbers (bytes) alongside
+        the closed-form estimate (utils/hbm.py) for cross-checking.
+        """
+        assert self.mesh is not None, "call create_process_group first"
+        assert self.model_config is not None, "set model_config first"
+        assert self.params is None, (
+            "plan_compile_check replaces engine state with abstract trees; "
+            "run it on a fresh engine (before initialize), not a live one"
+        )
+        cfg = self.config
+        model_cfg = self.model_config
+        try:
+            self._build_shardings()
+            abstract = jax.eval_shape(
+                lambda: init_params(model_cfg, jax.random.PRNGKey(0))
+            )
+            if model_cfg.lora_rank:
+                abstract["lora"] = jax.eval_shape(
+                    lambda: init_lora_params(model_cfg, jax.random.PRNGKey(0))
+                )
+            abstract = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype, sharding=sh
+                ),
+                abstract,
+                self._param_shardings,
+            )
+            # _opt_state_shardings path-matches against self.params; the
+            # abstract tree serves (eval_shape never touches values)
+            self.params = abstract
+            if self.optimizer is None and cfg.optimizer is not None:
+                self.optimizer, self.lr_schedule = make_optimizer(
+                    cfg.optimizer, 1000
+                )
+            if loss_fn is None:
+                from areal_tpu.engine.sft.lm_engine import (
+                    compute_packed_sft_loss_fused,
+                )
+
+                loss_fn = compute_packed_sft_loss_fused
+
+            grad_dtype = jnp.dtype(cfg.grad_reduce_dtype)
+            mb = {
+                k: jax.ShapeDtypeStruct(
+                    (mb_tokens,), jnp.int32, sharding=self._mb_sharding
+                )
+                for k in (
+                    "input_ids",
+                    "position_ids",
+                    "segment_ids",
+                    "loss_mask",
+                )
+            }
+            acc = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct(
+                    s.shape, grad_dtype, sharding=s.sharding
+                ),
+                self._trainable_sub(abstract),
+            )
+            weight = jax.ShapeDtypeStruct((), jnp.float32)
+            grad_compiled = (
+                self._get_grad_step(loss_fn).lower(abstract, acc, weight, mb)
+            ).compile()
+
+            report = {"grad_step": _memory_analysis_dict(grad_compiled)}
+            if self.optimizer is not None:
+                opt_abstract = jax.eval_shape(
+                    self.optimizer.init, self._trainable_sub(abstract)
+                )
+                opt_abstract = jax.tree.map(
+                    lambda s, sh: jax.ShapeDtypeStruct(
+                        s.shape, s.dtype, sharding=sh
+                    ),
+                    opt_abstract,
+                    self._opt_state_shardings(),
+                )
+                upd_compiled = (
+                    self._get_apply_update().lower(
+                        self._trainable_sub(abstract),
+                        opt_abstract,
+                        acc,
+                        weight,
+                    )
+                ).compile()
+                report["apply_update"] = _memory_analysis_dict(upd_compiled)
+            return report
+        finally:
+            # plan-check state must not leak into a later real initialize()
+            # — even when .compile() raises (surfacing those errors is this
+            # function's advertised use)
+            self._grad_step_cache.clear()
+            self._apply_update_fn = None
+            self.params = None
+            self._opt_shardings = None
 
     @property
     def _lora(self) -> bool:

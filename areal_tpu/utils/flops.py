@@ -21,18 +21,22 @@ MoE: ff work is per-activated-expert (top_k), not per-parameter.
 from __future__ import annotations
 
 
-# bf16 peak FLOP/s per chip by device-kind substring (first match wins).
+# bf16 peak FLOP/s per chip by NORMALIZED device-kind substring (first
+# match wins; normalization strips spaces/dashes/underscores so GKE-style
+# spellings like "tpu-v5-lite-podslice" don't fall through to the v5p row).
 PEAK_FLOPS: tuple[tuple[str, float], ...] = (
     ("v6", 918e12),
-    ("v5 lite", 197e12),
+    ("v5lite", 197e12),
     ("v5e", 197e12),
-    ("v5", 459e12),  # v5p
+    ("v5", 459e12),  # v5p reports plain "TPU v5"
     ("v4", 275e12),
 )
 
 
 def peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower()
+    kind = (
+        device_kind.lower().replace(" ", "").replace("-", "").replace("_", "")
+    )
     for sub, f in PEAK_FLOPS:
         if sub in kind:
             return f

@@ -1,0 +1,247 @@
+"""Analytic per-chip HBM accounting for train + decode plans.
+
+The reference sizes its allocations by operator experience (blog's 7B
+recipe pins d16t4+d8t4 on H800s); on TPU we can do better: the GSPMD
+engine's memory layout is regular enough to predict in closed form, so an
+allocation plan can be *validated* against the target chip's HBM before
+anything is launched (AllocationMode.check_hbm). The model:
+
+Per chip, a training step holds
+  params        n_params x param_bytes / (pp * dp * tp)     [ZeRO-3 + TP]
+  grads         n_params x param_bytes / (pp * dp * tp)     [same sharding]
+  opt (adamw)   2 x n_params x 4      / (pp * dp * tp)      [f32 mu + nu]
+  activations   under full remat, only per-layer boundaries are saved:
+                (L/pp) x T_local x d x act_bytes
+                plus ONE layer's recompute working set
+                T_local x (3d + 2ff/tp + 2*nH*hd/tp) x act_bytes
+  logits        fused vocab-chunked head: T_local x chunk x 4;
+                unfused: T_local x V x 4  (f32 logits)
+
+where T_local = per-chip microbatch tokens (dp and sp shard the token
+axis; pp processes one microbatch per stage at a time). Without remat the
+activation term multiplies by the ~10 saved tensors per layer instead of 1.
+
+A decode server holds
+  params        n_params x param_bytes / tp
+  kv pool       2 x (L ) x pool_tokens x nKV x hd x kv_bytes / tp
+
+Known-good anchor (unit-tested): Qwen2.5-0.5B = 0.494e9 params; the
+estimator's activation model is cross-checked against XLA's own
+`compile().memory_analysis()` on a tiny mesh in tests/test_hbm.py.
+
+HBM capacities are per-chip device specs (public): v5e 16 GiB, v5p 95 GiB,
+v4 32 GiB, v6e 32 GiB.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+GiB = 1024**3
+
+# Per-chip HBM by NORMALIZED device-kind substring (first match wins).
+# Normalization strips spaces/dashes/underscores so every spelling of the
+# v5e family ("TPU v5 lite", "tpu-v5-lite-podslice", "v5litepod") hits the
+# 16 GiB row — a substring match on the raw string would fall through to
+# the plain-"v5" (v5p) row and credit a 16 GiB chip with 95 GiB.
+HBM_BYTES: tuple[tuple[str, int], ...] = (
+    ("v6", 32 * GiB),
+    ("v5lite", 16 * GiB),
+    ("v5e", 16 * GiB),
+    ("v5", 95 * GiB),  # v5p reports plain "TPU v5"
+    ("v4", 32 * GiB),
+)
+
+
+def _normalize_kind(device_kind: str) -> str:
+    return (
+        device_kind.lower().replace(" ", "").replace("-", "").replace("_", "")
+    )
+
+
+def hbm_bytes(device_kind: str) -> int:
+    kind = _normalize_kind(device_kind)
+    for sub, b in HBM_BYTES:
+        if sub in kind:
+            return b
+    return 16 * GiB  # conservative default
+
+
+def _dtype_bytes(dtype) -> int:
+    s = str(dtype)
+    if "64" in s:
+        return 8
+    if "32" in s:
+        return 4
+    if "16" in s:
+        return 2
+    if "8" in s:
+        return 1
+    raise ValueError(f"unrecognized dtype {dtype!r}")
+
+
+def param_count(cfg) -> int:
+    """Exact decoder parameter count for models/qwen2.py's layout."""
+    d = cfg.hidden_size
+    nH = cfg.num_attention_heads
+    nKV = cfg.num_key_value_heads
+    hd = d // nH
+    L = cfg.num_hidden_layers
+    V = cfg.vocab_size
+
+    attn = d * (nH + 2 * nKV) * hd + nH * hd * d
+    if getattr(cfg, "qkv_bias", True):
+        attn += (nH + 2 * nKV) * hd
+    if getattr(cfg, "attn_out_bias", False):
+        attn += d
+    n_experts = getattr(cfg, "num_experts", 0) or 0
+    if n_experts:
+        ff = getattr(cfg, "moe_intermediate_size", None) or cfg.intermediate_size
+        mlp = n_experts * 3 * d * ff + d * n_experts  # experts + router
+        shared = getattr(cfg, "shared_expert_intermediate_size", 0) or 0
+        if shared:
+            mlp += 3 * d * shared + d  # shared expert + its gate
+    else:
+        mlp = 3 * d * cfg.intermediate_size
+    norms = 2 * d
+    per_layer = attn + mlp + norms
+    embed = V * d
+    head = 0 if getattr(cfg, "tie_word_embeddings", False) else V * d
+    return L * per_layer + embed + head + d  # + final norm
+
+
+@dataclass
+class HBMEstimate:
+    params_bytes: int
+    grads_bytes: int
+    opt_bytes: int
+    activation_bytes: int
+    logits_bytes: int
+    kv_bytes: int = 0
+
+    @property
+    def total_bytes(self) -> int:
+        return (
+            self.params_bytes
+            + self.grads_bytes
+            + self.opt_bytes
+            + self.activation_bytes
+            + self.logits_bytes
+            + self.kv_bytes
+        )
+
+    def breakdown(self) -> dict:
+        return {
+            "params_gib": round(self.params_bytes / GiB, 3),
+            "grads_gib": round(self.grads_bytes / GiB, 3),
+            "opt_gib": round(self.opt_bytes / GiB, 3),
+            "activations_gib": round(self.activation_bytes / GiB, 3),
+            "logits_gib": round(self.logits_bytes / GiB, 3),
+            "kv_gib": round(self.kv_bytes / GiB, 3),
+            "total_gib": round(self.total_bytes / GiB, 3),
+        }
+
+
+def estimate_train_hbm(
+    model_cfg,
+    *,
+    dp: int = 1,
+    tp: int = 1,
+    pp: int = 1,
+    sp: int = 1,
+    microbatch_tokens: int = 8192,
+    remat: bool = True,
+    fused_lm_head: bool = True,
+    vocab_chunk: int = 8192,
+    optimizer: str = "adamw",
+) -> HBMEstimate:
+    """Per-chip peak HBM for one training step of the GSPMD engine.
+
+    `microbatch_tokens` is the GLOBAL token count of one microbatch (the
+    unit `train_batch` runs per dispatch); dp and sp shard it.
+    """
+    n = param_count(model_cfg)
+    pbytes = _dtype_bytes(getattr(model_cfg, "param_dtype", "float32"))
+    abytes = _dtype_bytes(getattr(model_cfg, "dtype", "bfloat16"))
+    shard = dp * tp * pp
+    d = model_cfg.hidden_size
+    nH = model_cfg.num_attention_heads
+    hd = d // nH
+    ff = model_cfg.intermediate_size
+    L = model_cfg.num_hidden_layers
+
+    t_local = max(1, microbatch_tokens // (dp * sp))
+    layers_local = max(1, L // pp)
+    boundary = layers_local * t_local * d * abytes
+    # one decoder layer's live intermediates during (re)computation: qkv
+    # streams + two ff intermediates + attn scores working set, tp-sharded
+    working = t_local * (3 * d + (2 * ff + 2 * nH * hd) // tp) * abytes
+    if remat:
+        act = boundary + working
+    else:
+        # ~10 saved tensors per layer (qkv, probs-free flash residuals,
+        # ff gate/up, norms) — the classic no-remat multiplier
+        act = boundary * 10 + working
+    if fused_lm_head:
+        logits = t_local * min(vocab_chunk, model_cfg.vocab_size) * 4
+    else:
+        logits = t_local * model_cfg.vocab_size * 4
+    opt_mult = 2 if optimizer == "adamw" else 0  # f32 mu + nu
+    return HBMEstimate(
+        params_bytes=n * pbytes // shard,
+        grads_bytes=n * pbytes // shard,
+        opt_bytes=opt_mult * n * 4 // shard,
+        activation_bytes=act,
+        logits_bytes=logits,
+    )
+
+
+def estimate_decode_hbm(
+    model_cfg,
+    *,
+    tp: int = 1,
+    pool_tokens: int | None = None,
+    slots: int = 64,
+    context_length: int = 32768,
+    kv_cache_dtype: str = "bfloat16",
+) -> HBMEstimate:
+    """Per-chip HBM for a decode server: tp-sharded params + paged KV pool.
+
+    `pool_tokens=None` models dense provisioning (slots x context) — the
+    difference vs a sized pool is exactly what the paged cache buys.
+    """
+    n = param_count(model_cfg)
+    pbytes = _dtype_bytes(getattr(model_cfg, "param_dtype", "bfloat16"))
+    kvb = _dtype_bytes(kv_cache_dtype)
+    d = model_cfg.hidden_size
+    hd = d // model_cfg.num_attention_heads
+    nKV = max(model_cfg.num_key_value_heads, tp)  # GQA heads repeat to tp
+    if pool_tokens is None:
+        pool_tokens = slots * context_length
+    kv = 2 * model_cfg.num_hidden_layers * pool_tokens * nKV * hd * kvb // tp
+    return HBMEstimate(
+        params_bytes=n * pbytes // tp,
+        grads_bytes=0,
+        opt_bytes=0,
+        activation_bytes=0,
+        logits_bytes=0,
+        kv_bytes=kv,
+    )
+
+
+def check_fit(
+    estimate: HBMEstimate,
+    device_kind: str,
+    *,
+    utilization: float = 0.9,
+) -> None:
+    """Raise if the plan cannot fit the chip (90% of HBM usable by default:
+    XLA needs headroom for fusion temporaries and the compiled program)."""
+    cap = int(hbm_bytes(device_kind) * utilization)
+    if estimate.total_bytes > cap:
+        raise MemoryError(
+            f"plan needs {estimate.total_bytes / GiB:.2f} GiB/chip but "
+            f"{device_kind!r} offers {cap / GiB:.2f} GiB usable "
+            f"({utilization:.0%} of {hbm_bytes(device_kind) / GiB:.0f} GiB): "
+            f"{estimate.breakdown()}"
+        )
