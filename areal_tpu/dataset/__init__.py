@@ -360,6 +360,50 @@ def _clevr_count(path: str, split: str, type: str, tokenizer=None, max_length=No
     return _vqa_loader(path, split)
 
 
+@register_dataset("synthetic-vision")
+def _synthetic_vision(
+    path: str, split: str, type: str, tokenizer=None, max_length=None, **kw
+):
+    """Offline vision-RLVR dataset (no hub, no processor): pre-processed
+    patch dicts in the exact window-major format the decode engine's
+    vision tower consumes (JaxDecodeEngine.set_vision_model), with
+    pre-tokenized prompts carrying SMOKE_IMAGE_TOKEN spans. The offline
+    stand-in for clevr_count_70k, the vision analogue of synthetic-arith.
+    """
+    import numpy as np
+
+    from areal_tpu.models.smoke import (
+        SMOKE_IMAGE_TOKEN,
+        smoke_vision_config,
+    )
+
+    vis = smoke_vision_config()
+    n_items = kw.get("n_items", 256 if split == "train" else 64)
+    rng = np.random.RandomState(kw.get("seed", 0) + (split == "train"))
+    items = []
+    for i in range(n_items):
+        count = int(rng.randint(1, 5))
+        # 1x4x4 patch grid -> 16 patches -> 4 merged image tokens; pixel
+        # intensity encodes the "object count" so the mapping is learnable
+        pixels = (
+            rng.randn(16, vis.patch_dim).astype(np.float32) * 0.1
+            + count / 4.0
+        )
+        image = dict(
+            pixel_values=pixels,
+            image_grid_thw=np.array([[1, 4, 4]], dtype=np.int64),
+        )
+        prompt = [5, *([SMOKE_IMAGE_TOKEN] * 4), 9, 2]
+        items.append(
+            dict(
+                input_ids=prompt,
+                images=[image],
+                answer=str(count),
+            )
+        )
+    return items
+
+
 @register_dataset("geometry3k")
 def _geometry3k(path: str, split: str, type: str, tokenizer=None, max_length=None, **kw):
     """Geometry3K multimodal geometry problems (parity: areal/dataset geometry3k)."""

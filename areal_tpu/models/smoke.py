@@ -8,7 +8,7 @@ from __future__ import annotations
 from areal_tpu.models.qwen2 import ModelConfig
 
 # model/tokenizer paths that mean "offline smoke" (no HF access)
-OFFLINE_SENTINELS = ("", "synthetic-arith", "arith")
+OFFLINE_SENTINELS = ("", "synthetic-arith", "arith", "synthetic-vision")
 
 SMOKE_MODEL_DICT = dict(
     vocab_size=32,
@@ -18,6 +18,38 @@ SMOKE_MODEL_DICT = dict(
     num_attention_heads=4,
     num_key_value_heads=2,
 )
+
+
+# Offline vision smoke: a tiny Qwen2-VL-class tower paired with the smoke
+# decoder. IMAGE token is the smoke vocab's last id; grid 1x4x4 patches
+# merge 2x2 into 4 image-token embeddings.
+SMOKE_IMAGE_TOKEN = 31
+
+
+def smoke_vision_config():
+    """Tiny vision tower whose merged embeddings land in the smoke
+    decoder's hidden size (64)."""
+    from areal_tpu.models.qwen2_vl import VisionConfig
+
+    return VisionConfig(
+        embed_dim=16,
+        depth=2,
+        num_heads=2,
+        mlp_dim=32,
+        in_channels=3,
+        patch_size=2,
+        temporal_patch_size=1,
+        spatial_merge_size=2,
+        hidden_size=SMOKE_MODEL_DICT["hidden_size"],
+    )
+
+
+def smoke_mrope_sections() -> tuple[int, int, int]:
+    """(t, h, w) rotary sections for the smoke decoder's head_dim."""
+    hd = SMOKE_MODEL_DICT["hidden_size"] // SMOKE_MODEL_DICT[
+        "num_attention_heads"
+    ]
+    return (hd // 4, hd // 8, hd // 8)
 
 
 def smoke_model_config(

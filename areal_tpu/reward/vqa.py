@@ -52,3 +52,16 @@ def geometry3k_reward(
     if math_equal(pred, t):
         return 1.0
     return 1.0 if pred.strip().lower() == t.lower() else 0.0
+
+
+def synthetic_vision_reward(
+    prompt, completion, prompt_ids=None, completion_ids=None, **data
+) -> float:
+    """Offline smoke reward for the synthetic-vision dataset: the label
+    count (1-4) must appear among the generated token IDS — the smoke
+    decoder has no numeral text, so token identity stands in for the
+    decoded answer digit (cf. dataset/arith.py's string-level reward)."""
+    target = data.get("answer")
+    if not completion_ids or target is None:
+        return 0.0
+    return 1.0 if int(target) in list(completion_ids) else 0.0

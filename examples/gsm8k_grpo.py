@@ -71,10 +71,14 @@ def pick_reward_fn(dataset_path: str):
         from areal_tpu.reward.vqa import geometry3k_reward
 
         return geometry3k_reward
-    if dataset_path.split("/")[-1].lower() == "synthetic-arith":
+    if name == "synthetic-arith":
         from areal_tpu.dataset.arith import arith_reward_fn
 
         return arith_reward_fn
+    if name == "synthetic-vision":
+        from areal_tpu.reward.vqa import synthetic_vision_reward
+
+        return synthetic_vision_reward
     return gsm8k_reward_fn
 
 
@@ -98,8 +102,29 @@ def build_rollout(config: GRPOConfig, alloc: AllocationMode, actor, tokenizer):
     # COLOCATE: decode engine on the trainer's devices, memory weight updates
     from areal_tpu.engine.jax_decode import JaxDecodeEngine
 
-    rollout = JaxDecodeEngine(config.decode, config.rollout)
+    # tokenizer enables server-side stop STRINGS (TIR's ``` terminator);
+    # stop token ids work either way
+    rollout = JaxDecodeEngine(config.decode, config.rollout, tokenizer=tokenizer)
     rollout.set_model(actor.params, actor.model_config)
+    if config.workflow == "vision_rlvr" and not config.decode.model_path:
+        # offline vision smoke: tiny tower + smoke image token, so the
+        # synthetic-vision dataset serves end-to-end without hub access
+        import jax
+
+        from areal_tpu.models.qwen2_vl import init_vision_params
+        from areal_tpu.models.smoke import (
+            SMOKE_IMAGE_TOKEN,
+            smoke_mrope_sections,
+            smoke_vision_config,
+        )
+
+        vis = smoke_vision_config()
+        rollout.set_vision_model(
+            init_vision_params(vis, jax.random.PRNGKey(7)),
+            vis,
+            SMOKE_IMAGE_TOKEN,
+            mrope_sections=smoke_mrope_sections(),
+        )
     rollout.initialize()
     return rollout, WeightUpdateMeta.from_memory(alloc)
 
@@ -189,9 +214,14 @@ def main(args):
         )
     processor = None
     if config.workflow == "vision_rlvr":
-        from transformers import AutoProcessor
+        from areal_tpu.models.smoke import OFFLINE_SENTINELS
 
-        processor = AutoProcessor.from_pretrained(config.tokenizer_path)
+        if config.tokenizer_path not in OFFLINE_SENTINELS:
+            from transformers import AutoProcessor
+
+            processor = AutoProcessor.from_pretrained(config.tokenizer_path)
+        # offline: the synthetic-vision dataset ships pre-tokenized prompts
+        # + pre-processed patches, so no processor is needed
 
     def make_workflow(gconfig, dump_dir=None):
         if config.workflow == "multi_turn":

@@ -84,6 +84,149 @@ def test_grpo_multiturn_example_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_boba2_plan_check(tmp_path):
+    """The north-star recipe's --plan-check validates the 7B HBM plan and
+    AOT-compiles the full-depth sharded program on the CPU mesh (downsized
+    to the test harness's 8 virtual devices; the documented 64-device
+    command runs the same code on the real d16t4 mesh)."""
+    out = _run_example(
+        "boba2_grpo.py",
+        "boba2_7b_grpo.yaml",
+        "--plan-check",
+        "allocation_mode=jax:d4t2+d2t4",
+        f"cluster.fileroot={tmp_path}",
+        timeout=600,
+    )
+    assert "[plan-check] HBM fit" in out
+    assert "full-depth train program compiled" in out
+    assert "[plan-check] PASS" in out
+
+
+@pytest.mark.slow
+def test_boba2_tiny_smoke(tmp_path):
+    """The boba² entry runs the real async-GRPO loop at tiny geometry:
+    same yaml, smoke overrides (scratch model, synthetic math prompts,
+    colocated decode)."""
+    out = _run_example(
+        "boba2_grpo.py",
+        "boba2_7b_grpo.yaml",
+        "total_train_steps=2",
+        "total_train_epochs=1",
+        "tokenizer_path=synthetic-arith",
+        "allocation_mode=",
+        "train_dataset.path=synthetic-arith",
+        "train_dataset.batch_size=4",
+        "valid_dataset.path=synthetic-arith",
+        "valid_dataset.batch_size=8",
+        "gconfig.n_samples=4",
+        "gconfig.max_new_tokens=8",
+        "rollout.max_concurrent_rollouts=32",
+        "rollout.consumer_batch_size=16",
+        "decode.model_path=",
+        "decode.context_length=64",
+        "decode.max_running_requests=16",
+        "decode.kv_pool_tokens=null",
+        "decode.new_tokens_per_chunk=8",
+        "decode.dtype=float32",
+        "decode.kv_cache_dtype=float32",
+        "actor.path=",
+        "actor.init_from_scratch=true",
+        "actor.dtype=float32",
+        "actor.gradient_checkpointing=false",
+        "actor.group_size=4",
+        "actor.ppo_n_minibatches=2",
+        "actor.mb_spec.max_tokens_per_mb=512",
+        "actor.optimizer.lr=3.0e-3",
+        "actor.adv_norm.group_size=4",
+        "saver.freq_steps=null",
+        "evaluator.freq_steps=null",
+        "recover.mode=disabled",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=boba2-smoke-test",
+    )
+    assert "grpo_actor/loss" in out
+
+
+_OFFLINE_RL_OVERRIDES = (
+    "total_train_steps=2",
+    "total_train_epochs=1",
+    "tokenizer_path=synthetic-arith",
+    "allocation_mode=",
+    "train_dataset.batch_size=4",
+    "valid_dataset.path=synthetic-arith",
+    "valid_dataset.batch_size=8",
+    "gconfig.n_samples=4",
+    "gconfig.max_new_tokens=8",
+    "rollout.max_concurrent_rollouts=32",
+    "rollout.consumer_batch_size=16",
+    "decode.model_path=",
+    "decode.context_length=64",
+    "decode.max_running_requests=16",
+    "decode.new_tokens_per_chunk=8",
+    "decode.dtype=float32",
+    "decode.kv_cache_dtype=float32",
+    "actor.path=",
+    "actor.init_from_scratch=true",
+    "actor.dtype=float32",
+    "actor.gradient_checkpointing=false",
+    "actor.group_size=4",
+    "actor.ppo_n_minibatches=2",
+    "actor.mb_spec.max_tokens_per_mb=512",
+    "actor.optimizer.lr=3.0e-3",
+    "actor.adv_norm.group_size=4",
+    "saver.freq_steps=null",
+    "evaluator.freq_steps=null",
+    "recover.mode=disabled",
+)
+
+
+@pytest.mark.slow
+def test_tir_example_smoke(tmp_path):
+    """The TIR entry drives the tool-integrated workflow end-to-end on the
+    real tir_math.yaml with offline overrides (the workflow's sandbox loop
+    runs; the random policy simply rarely emits code blocks)."""
+    out = _run_example(
+        "tir_math.py",
+        "tir_math.yaml",
+        *_OFFLINE_RL_OVERRIDES,
+        "train_dataset.path=synthetic-arith",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=tir-smoke-test",
+    )
+    assert "grpo_actor/loss" in out
+
+
+@pytest.mark.slow
+def test_multi_turn_example_smoke(tmp_path):
+    out = _run_example(
+        "multi_turn_math.py",
+        "multi_turn_math.yaml",
+        *_OFFLINE_RL_OVERRIDES,
+        "train_dataset.path=synthetic-arith",
+        "max_turns=2",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=mtm-smoke-test",
+    )
+    assert "grpo_actor/loss" in out
+
+
+@pytest.mark.slow
+def test_clevr_example_smoke(tmp_path):
+    """The vision entry runs fully offline: synthetic counting images
+    through the tiny smoke vision tower (set_vision_model), token-only
+    training."""
+    out = _run_example(
+        "clevr_grpo.py",
+        "clevr_grpo.yaml",
+        *_OFFLINE_RL_OVERRIDES,
+        "train_dataset.path=synthetic-vision",
+        f"cluster.fileroot={tmp_path}",
+        "experiment_name=clevr-smoke-test",
+    )
+    assert "grpo_actor/loss" in out
+
+
+@pytest.mark.slow
 def test_ppo_example_smoke(tmp_path):
     out = _run_example(
         "gsm8k_ppo.py",
