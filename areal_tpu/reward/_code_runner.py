@@ -79,6 +79,17 @@ def _decode_arg(raw):
         return raw
 
 
+def _run_assert_case(code: str, harness: str) -> bool:
+    """HumanEval/MBPP-style unit-test harness: exec the candidate, then the
+    harness (assert statements / a check(candidate) driver) in the same
+    namespace; pass iff nothing raises."""
+    g = {"__name__": "__main__", "__builtins__": __builtins__}
+    with redirect_stdout(io.StringIO()):
+        exec(code, g)  # noqa: S102 — sandboxed candidate execution
+        exec(harness, g)  # noqa: S102 — sandboxed test harness
+    return True
+
+
 def _run_function_case(code: str, fn_name: str, inp, expected):
     g = {"__name__": "__main__", "__builtins__": __builtins__}
     with redirect_stdout(io.StringIO()):
@@ -114,7 +125,9 @@ def main() -> None:
         ok = False
         try:
             signal.setitimer(signal.ITIMER_REAL, timeout)
-            if fn_name:
+            if case.get("assertCode"):
+                ok = _run_assert_case(code, str(case["assertCode"]))
+            elif fn_name:
                 ok = _run_function_case(
                     code, fn_name, _decode_arg(case["input"]),
                     case["expectedOutput"],

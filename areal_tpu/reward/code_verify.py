@@ -55,20 +55,31 @@ def run_problem(
 ) -> bool:
     """Run one candidate against one problem's testcases in a sandbox
     subprocess; True iff every case passed."""
+    asserts = input_output.get("asserts") or []
     inputs = input_output.get("inputs", [])
     outputs = input_output.get("outputs", [])
-    if len(inputs) != len(outputs):
-        raise ValueError(
-            f"inputs({len(inputs)})/outputs({len(outputs)}) mismatch"
-        )
-    if not inputs:
-        return False  # unit-test-only problems need a harness we don't ship
+    if asserts:
+        # HumanEval/MBPP-style unit-test harnesses: each case is a code
+        # snippet (assert statement or check(candidate) driver) exec'd in
+        # the candidate's namespace
+        testcases: list[dict] = [
+            {"input": "", "expectedOutput": "", "assertCode": a}
+            for a in asserts
+        ]
+    else:
+        if len(inputs) != len(outputs):
+            raise ValueError(
+                f"inputs({len(inputs)})/outputs({len(outputs)}) mismatch"
+            )
+        if not inputs:
+            return False  # no testcases of either style: nothing to verify
+        testcases = [
+            {"input": i, "expectedOutput": o} for i, o in zip(inputs, outputs)
+        ]
     spec = dict(
         code=code,
         entryFunction=input_output.get("fn_name", ""),
-        testcases=[
-            {"input": i, "expectedOutput": o} for i, o in zip(inputs, outputs)
-        ],
+        testcases=testcases,
         timeout=min(100.0, max(0.1, timeout_per_case)),
         memory=memory_mb,
         isFastFail=True,
@@ -96,7 +107,7 @@ def run_problem(
     except json.JSONDecodeError:
         return False
     results = verdict.get("results", [])
-    return len(results) == len(inputs) and all(results)
+    return len(results) == len(testcases) and all(results)
 
 
 def _kill_group(proc: subprocess.Popen) -> None:
@@ -161,6 +172,36 @@ def code_reward_fn(prompt, completion, prompt_ids, completion_ids, **data):
     if io_blob is None:
         return 0.0
     input_output = json.loads(io_blob) if isinstance(io_blob, str) else io_blob
+    per_case = min(
+        100.0,
+        max(0.1, float(data.get("timeout", SINGLE_CASE_EXEC_TIMEOUT)) * 1.5),
+    )
+    return float(
+        run_problem(
+            code,
+            input_output,
+            timeout_per_case=per_case,
+            memory_mb=int(data.get("memory", 0)),
+        )
+    )
+
+
+def code_eval_reward_fn(prompt, completion, prompt_ids, completion_ids, **data):
+    """Completion-style code-benchmark reward (HumanEval/MBPP pass@k).
+
+    Candidate assembly follows the Codex eval convention: a fenced code
+    block wins if present (chat models); otherwise the completion is a raw
+    CONTINUATION of the item's `code_prompt` (the classic HumanEval
+    function-signature prefix). The item's `input_output.asserts` harness
+    runs in the sandbox subprocess (reward/_code_runner assert mode).
+    """
+    io_blob = data.get("input_output")
+    if io_blob is None:
+        return 0.0
+    input_output = json.loads(io_blob) if isinstance(io_blob, str) else io_blob
+    code = extract_code(completion or "")
+    if code is None:
+        code = str(data.get("code_prompt", "")) + (completion or "")
     per_case = min(
         100.0,
         max(0.1, float(data.get("timeout", SINGLE_CASE_EXEC_TIMEOUT)) * 1.5),

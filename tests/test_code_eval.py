@@ -1,0 +1,158 @@
+"""Code-benchmark offline eval: HumanEval/MBPP fixture loaders, the
+assert-harness sandbox mode, and pass@k through evaluate_offline with
+code_eval_reward_fn (the pipeline behind the reference's code numbers,
+functioncall/code/verify.py + eval_and_aggregate)."""
+
+import json
+
+import pytest
+
+from areal_tpu.api.cli_args import GenerationHyperparameters
+from areal_tpu.evaluation import evaluate_offline
+from areal_tpu.reward.code_verify import code_eval_reward_fn, run_problem
+from tests.test_workflows import ScriptedEngine
+
+FIXTURE = [
+    {
+        "task_id": "Fix/0",
+        "prompt": "def add(a, b):\n    \"\"\"Return a + b.\"\"\"\n",
+        "entry_point": "add",
+        "test": (
+            "def check(candidate):\n"
+            "    assert candidate(1, 2) == 3\n"
+            "    assert candidate(-1, 1) == 0\n"
+        ),
+    },
+    {
+        "task_id": "Fix/1",
+        "prompt": "def double(x):\n    \"\"\"Return 2*x.\"\"\"\n",
+        "entry_point": "double",
+        "test": (
+            "def check(candidate):\n"
+            "    assert candidate(3) == 6\n"
+            "    assert candidate(0) == 0\n"
+        ),
+    },
+    {
+        "task_id": "Fix/2",
+        "prompt": "def neg(x):\n    \"\"\"Return -x.\"\"\"\n",
+        "entry_point": "neg",
+        "test": "def check(candidate):\n    assert candidate(5) == -5\n",
+    },
+]
+
+
+@pytest.fixture()
+def fixture_path(tmp_path):
+    p = tmp_path / "humaneval_fixture.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in FIXTURE))
+    return str(p)
+
+
+def test_assert_harness_mode():
+    ok = run_problem(
+        "def f(x):\n    return x + 1\n",
+        {"asserts": ["assert f(1) == 2", "assert f(0) == 1"]},
+    )
+    assert ok is True
+    bad = run_problem(
+        "def f(x):\n    return x\n", {"asserts": ["assert f(1) == 2"]}
+    )
+    assert bad is False
+    # harness exceptions (not just AssertionError) also fail the case
+    assert run_problem("x = 1\n", {"asserts": ["undefined_name"]}) is False
+
+
+def test_humaneval_loader_fixture(fixture_path):
+    from areal_tpu.dataset import _REGISTRY
+
+    items = _REGISTRY["humaneval"](
+        path=fixture_path, split="test", type="rl", tokenizer=None
+    )
+    assert len(items) == 3
+    assert items[0]["code_prompt"].startswith("def add")
+    assert "check(add)" in items[0]["input_output"]["asserts"][0]
+    assert "```python" in items[0]["messages"][0]["content"]
+
+
+def test_mbpp_loader_fixture(tmp_path):
+    rows = [
+        {
+            "task_id": 1,
+            "text": "Write a function add(a, b) returning a+b.",
+            "code": "def add(a, b):\n    return a + b\n",
+            "test_list": ["assert add(1, 2) == 3"],
+            "test_setup_code": "",
+        }
+    ]
+    p = tmp_path / "mbpp_fixture.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    from areal_tpu.dataset import _REGISTRY
+
+    items = _REGISTRY["mbpp"](
+        path=str(p), split="test", type="rl", tokenizer=None
+    )
+    assert items[0]["input_output"]["asserts"] == ["assert add(1, 2) == 3"]
+
+
+def test_code_eval_reward_continuation_and_block():
+    item = {
+        "code_prompt": FIXTURE[0]["prompt"],
+        "input_output": {
+            "asserts": [FIXTURE[0]["test"] + "\ncheck(add)\n"]
+        },
+    }
+    # continuation style (no code fence): prompt + completion is the program
+    r = code_eval_reward_fn(
+        None, "    return a + b\n", [], [], **item
+    )
+    assert r == 1.0
+    # fenced style: the block replaces the continuation assembly
+    r2 = code_eval_reward_fn(
+        None,
+        "Here you go:\n```python\ndef add(a, b):\n    return a + b\n```",
+        [],
+        [],
+        **item,
+    )
+    assert r2 == 1.0
+    assert code_eval_reward_fn(None, "    return a - b\n", [], [], **item) == 0.0
+
+
+class CodeTokenizer:
+    """Token id 1 decodes to a correct continuation, 2 to a wrong one."""
+
+    def decode(self, ids):
+        return "    return a + b\n" if ids == [1] else "    return a * 9\n"
+
+    def encode(self, text):
+        return [7, 8]
+
+    def apply_chat_template(self, messages, **kw):
+        return [7, 8]
+
+
+def test_evaluate_offline_code_pass_at_k(fixture_path):
+    from areal_tpu.dataset import _REGISTRY
+
+    items = _REGISTRY["humaneval"](
+        path=fixture_path, split="test", type="rl", tokenizer=None
+    )
+    # 3 problems x 2 samples; every problem's add-style continuation
+    # "return a + b" is correct ONLY for problem 0, so script per-problem:
+    # p0 -> [correct, wrong], p1/p2 -> [wrong, wrong]
+    eng = ScriptedEngine([[1], [2], [2], [2], [2], [2]])
+    res = evaluate_offline(
+        eng,
+        items,
+        reward_fn=code_eval_reward_fn,
+        gconfig=GenerationHyperparameters(max_new_tokens=8),
+        tokenizer=CodeTokenizer(),
+        n_samples=2,
+        ks=(1, 2),
+        max_concurrency=1,  # keep the scripted order deterministic
+    )
+    assert res.n_problems == 3 and res.n_samples == 2
+    # p0: 1 of 2 correct -> pass@1 contribution 0.5; p1, p2: 0
+    assert abs(res.pass_at_1 - 0.5 / 3) < 1e-9
+    assert abs(res.pass_at_k[2] - 1.0 / 3) < 1e-9
