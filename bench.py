@@ -477,13 +477,20 @@ def _bench_grpo_run(
     )
     loader = CycleLoader()
 
-    def one_step(version: int):
+    loader_it = iter(loader)
+
+    def one_step(version: int, sync: bool = False):
         # time_perf breakdown (reference accounting,
         # benchmark/verl_v0_3_0_post1_76084d3/README.md:33-43): e2e =
         # rollout-wait + train + weight-push. Rollout-wait is what the
-        # trainer BLOCKS on — generation itself overlaps ≥2 batches deep.
+        # trainer BLOCKS on — async generation overlaps ≥2 batches deep;
+        # sync mode submits THIS step's prompts and waits for them (the
+        # reference's synchronous-RL baseline, blog/AReaL_v0_3.md:10).
         t0 = time.perf_counter()
-        batch = rollout.prepare_batch(loader, workflow=workflow)
+        if sync:
+            batch = rollout.rollout_batch(next(loader_it), workflow=workflow)
+        else:
+            batch = rollout.prepare_batch(loader, workflow=workflow)
         rollout_wait_s = time.perf_counter() - t0
         t1 = time.perf_counter()
         batch["prox_logp"] = actor.compute_logp(batch)
@@ -501,15 +508,30 @@ def _bench_grpo_run(
         total_tokens = int(batch["attention_mask"].sum())
         return gen_tokens, total_tokens, rollout_wait_s, train_s, push_s, stats
 
-    for v in range(warmup_steps):
-        one_step(v + 1)
+    version = 0
+    for _ in range(warmup_steps):
+        version += 1
+        one_step(version, sync=True)  # sync warmup compiles every program
+
+    # Sync baseline FIRST (an async phase leaves >=2 batches in flight,
+    # which would subsidize a later sync measurement).
+    sync_steps = max(2, steps - 1)
+    t0 = time.perf_counter()
+    for _ in range(sync_steps):
+        version += 1
+        one_step(version, sync=True)
+    sync_e2e = time.perf_counter() - t0
+
+    version += 1
+    one_step(version)  # untimed: fill the async pipeline
 
     gen_tot = tok_tot = 0
     wait_tot = train_tot = push_tot = 0.0
     t0 = time.perf_counter()
-    for v in range(steps):
+    for _ in range(steps):
+        version += 1
         gen_tokens, total_tokens, wait_s, train_s, push_s, _ = one_step(
-            warmup_steps + v + 1
+            version
         )
         gen_tot += gen_tokens
         tok_tot += total_tokens
@@ -519,6 +541,8 @@ def _bench_grpo_run(
     e2e = time.perf_counter() - t0
     n_chips = max(jax.device_count(), 1)
     return dict(
+        grpo_sync_step_time_s=sync_e2e / sync_steps,
+        grpo_async_vs_sync_speedup=(sync_e2e / sync_steps) / (e2e / steps),
         grpo_samples_per_sec_per_chip=samples_per_step * steps / e2e / n_chips,
         grpo_rollout_tokens_per_sec_per_chip=gen_tot / e2e / n_chips,
         grpo_effective_tokens_per_sec_per_chip=tok_tot / e2e / n_chips,
@@ -824,6 +848,41 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("train"):
+            # Scale evidence: the largest model one v5e chip fits per the
+            # HBM estimator (utils/hbm.py) — Qwen2.5-3B geometry with LoRA
+            # (bf16 base 6.2 GiB, adamw state only on adapters; full-FT
+            # 1.5B needs 18.6 GiB and does NOT fit). Bonus metric: failure
+            # must not cost the primary line.
+            def lora3b():
+                m = ModelConfig(
+                    vocab_size=151936,
+                    hidden_size=2048,
+                    intermediate_size=11008,
+                    num_hidden_layers=36,
+                    num_attention_heads=16,
+                    num_key_value_heads=2,
+                    tie_word_embeddings=True,
+                    dtype="bfloat16",
+                    param_dtype="bfloat16",
+                    remat=True,
+                    scan_layers=True,
+                    lora_rank=32,
+                    lora_alpha=64.0,
+                )
+                return bench_train(
+                    m, tokens_per_step=16384, seq_len=1024, mb_tokens=4096,
+                    warmup=1, iters=3,
+                )
+
+            try:
+                r = _retry_transport(
+                    lora3b, what="bench_train_3b_lora", attempts=2,
+                    base_delay=15.0,
+                )
+                train.update({f"lora3b_{k}": v for k, v in r.items()})
+            except Exception as e:  # noqa: BLE001
+                print(f"[bench] 3B-LoRA bonus phase failed: {e}", file=sys.stderr)
         metric = "trainer_mfu_qwen2.5-0.5b_bf16_packed_sft"
     else:  # CPU smoke fallback so the harness always emits a line
         model = ModelConfig(
