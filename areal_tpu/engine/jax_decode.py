@@ -1904,6 +1904,154 @@ class JaxDecodeEngine(InferenceEngine):
     def continue_generation(self):
         self._gen_paused.clear()
 
+    def prewarm(
+        self,
+        prompt_len: int = 256,
+        new_tokens: int = 1,
+        gconfig: GenerationHyperparameters | None = None,
+        include_fork: bool = True,
+        sampler_top_ps: tuple[float, ...] = (1.0, 0.95),
+    ) -> float:
+        """Deterministically compile the hot decode-path jit variants
+        before serving traffic; returns wall seconds spent.
+
+        Which batched-prefill variant (B in {8,4,2,1} per prompt bucket)
+        gets compiled during a live load burst depends on request-arrival
+        interleaving — a "warmed-by-traffic" engine can still hit a
+        multi-second first-compile mid-serving (observed as an 80x
+        throughput flake in bench_decode's timed window). This uses only
+        public APIs to force exact wave sizes: queue exactly W requests
+        while generation is paused, then resume — the scheduler admits
+        them as one wave of W (same-bucket waves dispatch as one vmapped
+        prefill of B=W). Running them to completion also compiles the
+        decode chunk at every KV bucket the context growth reaches, the
+        sampler variant `gconfig` selects, and the retire path.
+
+        Wave sizes that the chunked-prefill budget would split live
+        (W * bucket > max_prefill_tokens) are skipped — they cannot occur
+        in live traffic either, for the same reason. `include_fork` adds a
+        2-wave of identical prompts to compile the duplicate-prompt
+        fork's block-copy kernel.
+
+        The decode chunk is keyed on the sampler variant too
+        (use_topp, use_freq, nb): `sampler_top_ps` lists the top_p
+        settings to warm — the default covers both the RL-rollout setting
+        (top_p == 1, plain categorical) and filtered sampling (top_p < 1,
+        the top-k-truncated path); each additional entry costs one extra
+        single-request pass through the full generation length. When
+        `gconfig` is given, its top_p/temperature/penalties define the
+        (single) variant warmed and `sampler_top_ps` is ignored, as is
+        `new_tokens` — the caller's gconfig is used as-is.
+
+        Call on an idle engine (e.g. decode-server startup, before
+        registering with the router); concurrent live traffic would make
+        the wave sizes nondeterministic again.
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        assert self._thread is not None, "prewarm requires initialize()"
+        # run_wave toggles the pause gate itself; entering with an EXTERNAL
+        # pause held would cancel it (the weight-update flows promise an
+        # external pause_generation survives them — prewarm cannot keep
+        # that promise, so it refuses instead of silently breaking it)
+        assert not self._gen_paused.is_set(), (
+            "prewarm requires an un-paused idle engine"
+        )
+        if gconfig is not None:
+            new_tokens = gconfig.max_new_tokens
+            sampler_top_ps = (gconfig.top_p,)
+        if prompt_len + new_tokens > self.config.context_length:
+            raise ValueError(
+                f"prewarm: prompt_len ({prompt_len}) + new_tokens "
+                f"({new_tokens}) exceeds context_length "
+                f"({self.config.context_length}) — every warmup request "
+                "would be length-rejected before compiling anything"
+            )
+        t0 = time.monotonic()
+        # min_new_tokens == max: a tokenizer-equipped engine must not stop a
+        # warm generation at a sampled EOS, or the chunk fn is silently never
+        # compiled at the deeper KV buckets this prewarm promises to cover
+        g = gconfig or GenerationHyperparameters(
+            max_new_tokens=new_tokens,
+            min_new_tokens=new_tokens,
+            temperature=1.0,
+            top_p=sampler_top_ps[0],
+        )
+        rng = np.random.RandomState(0xC0FFEE)
+        vocab = self.model_config.vocab_size
+        bucket = min(
+            _next_bucket(prompt_len - 1) if prompt_len > 1 else _PREFILL_BUCKET,
+            self.config.context_length,
+        )
+        budget = max(int(self.config.max_prefill_tokens), _PREFILL_BUCKET)
+        R = self.config.max_running_requests
+        waves = [
+            w for w in (8, 4, 2, 1) if w <= R and w * bucket <= budget
+        ] or [1]
+        if include_fork and R >= 2:
+            waves.append(-2)  # 2-wave of identical prompts: dup-fork path
+
+        def run_wave(
+            pool: ThreadPoolExecutor, n: int, prompts: list, wg
+        ) -> None:
+            self.pause_generation()
+            try:
+                futs = [
+                    pool.submit(
+                        self.generate,
+                        ModelRequest(input_ids=p, gconfig=wg),
+                        self.inference_config.request_timeout,
+                    )
+                    for p in prompts
+                ]
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    queued = self._request_q.qsize() + len(self._overflow)
+                    if queued >= n:
+                        break
+                    time.sleep(0.005)
+                else:
+                    logger.warning(
+                        f"prewarm: only {queued}/{n} requests enqueued "
+                        "within 30s — this wave admits at a smaller size "
+                        "and its intended batched-prefill variant will NOT "
+                        "be compiled"
+                    )
+            finally:
+                self.continue_generation()
+            for f in futs:
+                f.result()
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            for w in waves:
+                if w == -2:
+                    shared = rng.randint(1, vocab, (prompt_len,)).tolist()
+                    run_wave(pool, 2, [list(shared), list(shared)], g)
+                else:
+                    prompts = [
+                        rng.randint(1, vocab, (prompt_len,)).tolist()
+                        for _ in range(w)
+                    ]
+                    run_wave(pool, w, prompts, g)
+            # extra sampler variants: the chunk fn is keyed on use_topp, so
+            # each distinct top_p class needs one full-length pass (wave
+            # size 1 — prefill variants are sampler-independent)
+            warmed_topp = g.top_p < 1.0
+            for tp in sampler_top_ps[1:]:
+                if (tp < 1.0) == warmed_topp:
+                    continue
+                g2 = dataclasses.replace(g, top_p=tp)
+                run_wave(
+                    pool, 1, [rng.randint(1, vocab, (prompt_len,)).tolist()], g2
+                )
+                warmed_topp = warmed_topp or tp < 1.0
+        dt = time.monotonic() - t0
+        logger.info(
+            f"prewarm: waves {waves} at bucket {bucket} "
+            f"(+{new_tokens} tokens, top_ps {sampler_top_ps}) in {dt:.1f}s"
+        )
+        return dt
+
     def abort_all(self) -> int:
         """Retire every in-flight and queued request with stop_reason
         "interrupt", returning partial outputs to their callers.

@@ -356,6 +356,20 @@ async def _serve(args: argparse.Namespace) -> None:
     await server.start(args.host, args.port)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
+    # engine.initialize() (inside server.start) guarantees params are
+    # installed or raises — no silent-skip path here
+    if args.prewarm_prompt_len > 0:
+        # Deterministic jit warmup BEFORE registering with the router: live
+        # traffic must never pay a first-compile (see JaxDecodeEngine.prewarm
+        # — which batched-prefill variant traffic compiles is arrival-timing
+        # dependent, so serving-warmed engines still hit compile stalls).
+        await loop.run_in_executor(
+            None,
+            lambda: server.engine.prewarm(
+                prompt_len=args.prewarm_prompt_len,
+                new_tokens=args.prewarm_new_tokens,
+            ),
+        )
     if args.experiment_name and args.trial_name:
         server.register(
             args.experiment_name, args.trial_name, args.server_id or server.addr
@@ -401,6 +415,23 @@ def main(argv: list[str] | None = None) -> None:
         default="",
         help="JSON ModelConfig dict: serve a from-scratch tiny model "
              "(offline smoke / launcher E2E) instead of loading --model-path",
+    )
+    p.add_argument(
+        "--prewarm-prompt-len",
+        type=int,
+        default=0,
+        help="if >0, deterministically compile the hot decode-path jit "
+             "variants at this prompt length before registering with the "
+             "router (JaxDecodeEngine.prewarm); production servers should "
+             "set this to their typical prompt length",
+    )
+    p.add_argument(
+        "--prewarm-new-tokens",
+        type=int,
+        default=1,
+        help="generation length of the prewarm requests (raise to the "
+             "typical response length to also compile the decode chunk at "
+             "every KV bucket the context growth reaches)",
     )
     args = p.parse_args(argv)
     # join the experiment's shared discovery store (launcher-provided env)

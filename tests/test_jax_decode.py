@@ -832,3 +832,47 @@ def test_batched_prefill_wave_unique_prompts(cpu_devices):
         )
     finally:
         eng.destroy()
+
+
+@pytest.mark.slow
+def test_prewarm_compiles_all_wave_variants(cpu_devices):
+    """prewarm() must deterministically populate every jit-variant cache a
+    live load burst could hit — batched prefill at each admissible wave
+    size, the decode chunk, and the dup-fork block copy — and must leave
+    the engine fully serviceable (greedy parity afterwards)."""
+    cfg = JaxDecodeConfig(
+        context_length=96,
+        max_running_requests=4,
+        new_tokens_per_chunk=8,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    try:
+        dt = eng.prewarm(prompt_len=16, new_tokens=4)
+        assert dt > 0.0
+        # prompt_len 16 -> 64-token prefill bucket; max_running 4 caps the
+        # admissible wave sizes at {4, 2, 1}
+        assert set(eng._batched_prefill_fns) >= {(64, 4), (64, 2), (64, 1)}
+        # both sampler variants (top_p == 1 and top_p < 1) compiled
+        assert {k[0] for k in eng._chunk_fns} == {False, True}, eng._chunk_fns
+        assert True in eng._fork_fns, "dup-fork block copy not compiled"
+        # misconfiguration must fail loudly, not silently warm nothing
+        with pytest.raises(ValueError, match="length-rejected"):
+            eng.prewarm(prompt_len=96, new_tokens=4)
+        # engine state must be untouched: fresh greedy request still exact
+        prompt = [3, 7, 11, 2, 9]
+        resp = eng.generate(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(
+                    greedy=True, max_new_tokens=6
+                ),
+            ),
+            timeout=300,
+        )
+        assert resp.output_tokens == greedy_reference(eng.params, prompt, 6)
+    finally:
+        eng.destroy()

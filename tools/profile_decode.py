@@ -1,0 +1,142 @@
+"""Decompose decode-engine wall time on the current backend.
+
+Phases (all on a WARM engine — compiles paid before any timed region):
+  1. prefill-only   : N requests, new_tokens=1  -> prefill + admission cost
+  2. full           : N requests, new_tokens=T  -> total wall time
+
+The "decode-attributed" rate printed for phase 2 divides the generated
+tokens by (full - prefill-only) wall time: an upper-ish bound on the pure
+decode rate, since admission/prefill interleaving overlaps differently
+under the two loads.
+
+Usage (needs the chip to itself):
+  python tools/profile_decode.py [--requests 128] [--prompt 512] [--new 256]
+"""
+
+import argparse
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+
+def build_engine(model, prompt_len, new_tokens, max_running):
+    import jax
+
+    from areal_tpu.api.cli_args import InferenceEngineConfig, JaxDecodeConfig
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.models.qwen2 import init_params
+
+    dcfg = JaxDecodeConfig(
+        context_length=prompt_len + new_tokens + 128,
+        max_running_requests=max_running,
+        new_tokens_per_chunk=min(128, new_tokens),
+        dtype=model.dtype,
+        kv_cache_dtype=model.dtype,
+    )
+    eng = JaxDecodeEngine(
+        dcfg, InferenceEngineConfig(max_concurrent_rollouts=4096)
+    )
+    eng.set_model(init_params(model, jax.random.PRNGKey(0)), model)
+    eng.initialize()
+    return eng
+
+
+def run_load(eng, model, n, prompt_len, new_tokens, seed):
+    from areal_tpu.api.cli_args import GenerationHyperparameters
+    from areal_tpu.api.io_struct import ModelRequest
+
+    rng = np.random.RandomState(seed)
+    prompts = [
+        rng.randint(1, model.vocab_size, (prompt_len,)).tolist()
+        for _ in range(n)
+    ]
+    g = GenerationHyperparameters(
+        max_new_tokens=new_tokens, temperature=1.0, top_p=1.0
+    )
+
+    def one(i):
+        return eng.generate(
+            ModelRequest(input_ids=prompts[i], gconfig=g), timeout=1800
+        )
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=n) as pool:
+        results = list(pool.map(one, range(n)))
+    dt = time.perf_counter() - t0
+    toks = sum(len(r.output_tokens) for r in results)
+    return dt, toks
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=128)
+    ap.add_argument("--prompt", type=int, default=512)
+    ap.add_argument("--new", type=int, default=256)
+    ap.add_argument("--max-running", type=int, default=64)
+    args = ap.parse_args()
+
+    from areal_tpu.platforms import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+
+    from areal_tpu.models.qwen2 import ModelConfig
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})")
+
+    model = ModelConfig(
+        vocab_size=151936,
+        hidden_size=896,
+        intermediate_size=4864,
+        num_hidden_layers=24,
+        num_attention_heads=14,
+        num_key_value_heads=2,
+        tie_word_embeddings=True,
+        dtype="bfloat16",
+        param_dtype="bfloat16",
+    )
+
+    eng = build_engine(model, args.prompt, args.new, args.max_running)
+    try:
+        # Warm pass: full shape coverage (prefill waves, chunk nb growth,
+        # retire path). Untimed.
+        dt, toks = run_load(
+            eng, model, args.requests, args.prompt, args.new, seed=0
+        )
+        print(f"warm pass: {dt:.2f}s  ({toks / dt:.0f} tok/s, cold compiles)")
+
+        # Phase 1: prefill-only.
+        dt1, _ = run_load(eng, model, args.requests, args.prompt, 1, seed=1)
+        # its chunk fns differ (new_tokens_per_chunk still 128); warm again
+        dt1, _ = run_load(eng, model, args.requests, args.prompt, 1, seed=2)
+        print(f"prefill-only (new=1): {dt1:.2f}s")
+
+        # Phase 2: full, warm, twice.
+        for rep in range(2):
+            dt2, toks2 = run_load(
+                eng, model, args.requests, args.prompt, args.new, seed=3 + rep
+            )
+            print(
+                f"full rep{rep}: {dt2:.2f}s -> {toks2 / dt2:.0f} tok/s "
+                f"(decode-attributed {toks2 / max(dt2 - dt1, 1e-9):.0f} tok/s)"
+            )
+
+        # Roofline context: weights bytes read per decode step.
+        try:
+            from areal_tpu.utils.hbm import _dtype_bytes, param_count
+
+            pbytes = param_count(model) * _dtype_bytes(model.param_dtype)
+            print(f"param bytes: {pbytes / 1e9:.2f} GB")
+        except Exception:
+            pass
+        # Scheduler counters, if present.
+        m = eng.get_metrics() if hasattr(eng, "get_metrics") else {}
+        print(f"engine metrics: {m}")
+    finally:
+        eng.destroy()
+
+
+if __name__ == "__main__":
+    main()
