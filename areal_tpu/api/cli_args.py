@@ -149,6 +149,16 @@ class JaxEngineConfig:
     scan_layers: bool = True
     # Offload optimizer state to host memory (jax.device_put w/ host sharding).
     offload_params: bool = False
+    # Pipeline schedule under pp>1: "1f1b" interleaves each micro-batch's
+    # backward right behind its forward (live activation stash capped at
+    # 2*pp-1 per stage, so bigger M — smaller bubble — fits in fixed HBM);
+    # "gpipe" is the all-forward-then-all-backward reference/fallback path.
+    pipeline_schedule: str = "1f1b"
+    # Zig-zag context-parallel layout: shard the packed token axis as paired
+    # chunks (i, 2n-1-i) so every ring-attention shard does equal causal
+    # work. Exact (a pure relabeling, inverted on outputs); applies only
+    # when attention resolves to the ring path.
+    cp_zigzag: bool = True
 
 
 @dataclass

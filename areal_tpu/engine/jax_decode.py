@@ -1949,14 +1949,17 @@ class JaxDecodeEngine(InferenceEngine):
         """
         from concurrent.futures import ThreadPoolExecutor
 
-        assert self._thread is not None, "prewarm requires initialize()"
+        # RuntimeError, not assert: these guards are load-bearing (skipping
+        # them under `python -O` would silently cancel an externally held
+        # pause or run against an uninitialized engine).
+        if self._thread is None:
+            raise RuntimeError("prewarm requires initialize()")
         # run_wave toggles the pause gate itself; entering with an EXTERNAL
         # pause held would cancel it (the weight-update flows promise an
         # external pause_generation survives them — prewarm cannot keep
         # that promise, so it refuses instead of silently breaking it)
-        assert not self._gen_paused.is_set(), (
-            "prewarm requires an un-paused idle engine"
-        )
+        if self._gen_paused.is_set():
+            raise RuntimeError("prewarm requires an un-paused idle engine")
         if gconfig is not None:
             new_tokens = gconfig.max_new_tokens
             sampler_top_ps = (gconfig.top_p,)
@@ -2033,6 +2036,7 @@ class JaxDecodeEngine(InferenceEngine):
                         for _ in range(w)
                     ]
                     run_wave(pool, w, prompts, g)
+                    self._warn_wave_not_compiled(bucket, w)
             # extra sampler variants: the chunk fn is keyed on use_topp, so
             # each distinct top_p class needs one full-length pass (wave
             # size 1 — prefill variants are sampler-independent)
@@ -2051,6 +2055,20 @@ class JaxDecodeEngine(InferenceEngine):
             f"(+{new_tokens} tokens, top_ps {sampler_top_ps}) in {dt:.1f}s"
         )
         return dt
+
+    def _warn_wave_not_compiled(self, bucket: int, w: int) -> None:
+        """Post-wave prewarm check: a wave can admit below its intended size
+        when KV-pool pressure (or retire timing) splits it — the promised
+        batched-prefill variant then silently never compiles and live
+        traffic pays the first-compile this prewarm exists to prevent.
+        Surface that instead of letting the prewarm claim coverage."""
+        if w >= 2 and (bucket, w) not in self._batched_prefill_fns:
+            logger.warning(
+                f"prewarm: batched-prefill variant (bucket={bucket}, B={w}) "
+                f"was not compiled — the {w}-wave was split (KV-pool "
+                "pressure?); live traffic at that wave size will hit a "
+                "first-compile stall"
+            )
 
     def abort_all(self) -> int:
         """Retire every in-flight and queued request with stop_reason

@@ -231,6 +231,7 @@ class JaxTrainEngine(TrainEngine):
                 scan_layers=cfg.jax.scan_layers,
                 is_critic=cfg.is_critic,
                 attn_impl=attn_impl,
+                cp_zigzag=cfg.jax.cp_zigzag,
             )
             if cfg.use_lora:
                 if not cfg.jax.scan_layers:
@@ -851,12 +852,30 @@ class JaxTrainEngine(TrainEngine):
         return bool(getattr(fn, "hidden_loss", False))
 
     def _get_pipelined_grad_step(self, loss_fn: Callable) -> Callable:
-        """One jitted program: GPipe trunk over the pp axis for all M
-        micro-batches, per-mb loss in a head scan, ONE backward. Replaces
-        the per-mb grad-accumulation loop when pp > 1 (the python loop
-        would leave every stage idle (pp-1)/pp of the time; the pipeline
-        keeps stages busy after the fill steps)."""
-        key = ("pp", id(loss_fn))
+        """One jitted program running ALL micro-batches through the pp
+        stages (fill/steady/drain) with ONE optimizer-ready gradient.
+        Replaces the per-mb grad-accumulation loop when pp > 1 (the python
+        loop would leave every stage idle (pp-1)/pp of the time).
+
+        `jax.pipeline_schedule` picks the schedule:
+        - "1f1b" (default): parallel/pipeline.pipeline_1f1b_grads — each
+          microbatch's backward is interleaved right behind its forward, so
+          the live activation stash is capped at 2·pp-1 stage inputs
+          instead of growing with M; bigger M (smaller bubble) fits in
+          fixed HBM.
+        - "gpipe": the all-forward-then-all-backward reference path
+          (autodiff through the trunk scan); numerically the oracle the
+          1f1b path is tested against.
+        """
+        schedule = getattr(self.config.jax, "pipeline_schedule", "1f1b")
+        from areal_tpu.parallel.pipeline import PIPELINE_SCHEDULES
+
+        if schedule not in PIPELINE_SCHEDULES:
+            raise ValueError(
+                f"jax.pipeline_schedule={schedule!r} not in "
+                f"{PIPELINE_SCHEDULES}"
+            )
+        key = ("pp", schedule, id(loss_fn))
         if key in self._grad_step_cache:
             return self._grad_step_cache[key]
         from areal_tpu.models.qwen2 import forward_pipelined
@@ -871,6 +890,48 @@ class JaxTrainEngine(TrainEngine):
         hidden_mode = self._wants_hidden(loss_fn)
         aux_mode = self._returns_aux(loss_fn)
         lora_mode = self._lora
+
+        if schedule == "1f1b":
+            from areal_tpu.models.qwen2 import forward_pipelined_grads
+
+            if aux_mode:
+                per_mb = lambda out, mb: loss_fn(out, mb)  # noqa: E731
+            else:
+                per_mb = lambda out, mb: (loss_fn(out, mb), {})  # noqa: E731
+
+            def pip_1f1b_step(params, stacked, weights):
+                if lora_mode:
+                    trainable = params["lora"]
+                    frozen = {k: v for k, v in params.items() if k != "lora"}
+                else:
+                    trainable, frozen = params, {}
+                losses, stats, _aux_total, grads = forward_pipelined_grads(
+                    trainable,
+                    frozen,
+                    stacked["input_ids"],
+                    stacked["position_ids"],
+                    stacked["segment_ids"],
+                    model_cfg,
+                    mesh,
+                    per_mb,
+                    stacked,
+                    weights,
+                    head_mode="hidden" if hidden_mode else "logits",
+                    lora_mode=lora_mode,
+                )
+                grads = jax.lax.with_sharding_constraint(grads, param_sh)
+                return losses, stats, grads
+
+            fn = jax.jit(
+                pip_1f1b_step,
+                out_shardings=(
+                    mesh_lib.replicated(self.mesh),
+                    mesh_lib.replicated(self.mesh),
+                    param_sh,
+                ),
+            )
+            self._grad_step_cache[key] = fn
+            return fn
 
         def loss_of(trainable, frozen, stacked, weights):
             params = (

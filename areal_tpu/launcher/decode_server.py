@@ -275,9 +275,23 @@ class DecodeServer:
         app.router.add_post("/set_version", self._set_version)
         return app
 
-    async def start(self, host: str = "0.0.0.0", port: int = 0) -> str:
+    async def start(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        prewarm: dict[str, Any] | None = None,
+    ) -> str:
+        """Initialize the engine, optionally prewarm, THEN bind the HTTP
+        listener. `prewarm` (kwargs for `engine.prewarm`) must run before
+        the port exists: once the listener is up, a /generate or /pause
+        arriving mid-warmup would make the wave sizes nondeterministic
+        (or trip prewarm's external-pause guard and kill startup)."""
         if self._owns_engine:
             self.engine.initialize()
+        if prewarm is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self.engine.prewarm(**prewarm)
+            )
         self._runner = web.AppRunner(self.build_app())
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -353,23 +367,24 @@ async def _serve(args: argparse.Namespace) -> None:
             }
         )
         server.engine.set_model(init_params(mc, _jax.random.PRNGKey(args.seed)), mc)
-    await server.start(args.host, args.port)
+    # Deterministic jit warmup BEFORE the HTTP listener binds (and so also
+    # before registering with the router): live traffic must never pay a
+    # first-compile (see JaxDecodeEngine.prewarm — which batched-prefill
+    # variant traffic compiles is arrival-timing dependent, so
+    # serving-warmed engines still hit compile stalls), and a request or
+    # /pause arriving mid-warmup would break wave determinism or trip
+    # prewarm's external-pause guard.
+    prewarm = (
+        dict(
+            prompt_len=args.prewarm_prompt_len,
+            new_tokens=args.prewarm_new_tokens,
+        )
+        if args.prewarm_prompt_len > 0
+        else None
+    )
+    await server.start(args.host, args.port, prewarm=prewarm)
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
-    # engine.initialize() (inside server.start) guarantees params are
-    # installed or raises — no silent-skip path here
-    if args.prewarm_prompt_len > 0:
-        # Deterministic jit warmup BEFORE registering with the router: live
-        # traffic must never pay a first-compile (see JaxDecodeEngine.prewarm
-        # — which batched-prefill variant traffic compiles is arrival-timing
-        # dependent, so serving-warmed engines still hit compile stalls).
-        await loop.run_in_executor(
-            None,
-            lambda: server.engine.prewarm(
-                prompt_len=args.prewarm_prompt_len,
-                new_tokens=args.prewarm_new_tokens,
-            ),
-        )
     if args.experiment_name and args.trial_name:
         server.register(
             args.experiment_name, args.trial_name, args.server_id or server.addr

@@ -117,6 +117,12 @@ class ModelConfig:
     # online-softmax kernel (areal_tpu/ops/flash_attention.py) — O(T) memory,
     # required for long-context packs; "auto" picks flash on TPU.
     attn_impl: str = "auto"
+    # Zig-zag context-parallel layout: when attention resolves to "ring"
+    # and the token axis is 2n-chunk divisible, forward() permutes the
+    # packed stream so every CP shard holds one early + one late chunk
+    # (equal causal work) and inverts the permutation on its outputs.
+    # Exact — a pure relabeling (ops/ring_attention.py zig-zag positions).
+    cp_zigzag: bool = False
     # critic/reward mode: scalar value head instead of the LM head
     # (parity: the reference's AutoModelForTokenClassification path,
     # areal/engine/base_hf_engine.py:180-187)
@@ -1070,9 +1076,17 @@ def attention(
 
         out = flash_attention(q, k, v, segment_ids)
     elif impl == "ring":
-        from areal_tpu.ops.ring_attention import ring_flash_attention
+        from areal_tpu.ops.ring_attention import (
+            ring_flash_attention,
+            zigzag_eligible,
+        )
 
-        out = ring_flash_attention(q, k, v, segment_ids)
+        # Same predicate forward() used when (not) permuting the stream —
+        # the two sites must agree or positions would be misread.
+        out = ring_flash_attention(
+            q, k, v, segment_ids,
+            zigzag=cfg.cp_zigzag and zigzag_eligible(T),
+        )
     elif impl == "chunked":
         from areal_tpu.ops.chunked_attention import chunked_attention
 
@@ -1303,6 +1317,30 @@ def forward(
     tensor never exists.
     """
     compute_dtype = jnp.dtype(cfg.dtype)
+    # Zig-zag context parallelism: when ring attention will shard the token
+    # axis, permute the stream ONCE here (and invert on the way out) so
+    # each CP shard holds a balanced (early, late) chunk pair. Positions /
+    # segment ids ride along, so rope and packing see original values; all
+    # per-token math in between is order-agnostic, making this exact.
+    zz_inv = None
+    if cfg.cp_zigzag and resolve_attn_impl(cfg) == "ring":
+        from areal_tpu.ops.ring_attention import (
+            cp_ring_shards,
+            zigzag_eligible,
+        )
+        from areal_tpu.utils.data import (
+            zigzag_indices,
+            zigzag_inverse_indices,
+        )
+
+        T_total = input_ids.shape[0]
+        if zigzag_eligible(T_total):
+            n_cp = cp_ring_shards(T_total)
+            zz_perm = jnp.asarray(zigzag_indices(T_total, n_cp))
+            zz_inv = jnp.asarray(zigzag_inverse_indices(T_total, n_cp))
+            input_ids = _cstr(input_ids[zz_perm], "tokens")
+            position_ids = _cstr(position_ids[zz_perm], "tokens")
+            segment_ids = _cstr(segment_ids[zz_perm], "tokens")
     # Gather from a table whose hidden dim is UNSHARDED: leaving the fsdp
     # (dp) shards on the hidden dim makes SPMD pass them through the gather
     # output, which then collides with the tokens-over-(dp,sp) layout every
@@ -1354,68 +1392,51 @@ def forward(
     x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
     if return_hidden:
         assert not cfg.is_critic, "fused head path is for LM heads only"
-        out = _cstr(x, "tokens", "act_embed")
+        out_axes: tuple[str | None, ...] = ("tokens", "act_embed")
+        out = _cstr(x, *out_axes)
     elif cfg.is_critic:
         values = (
             jnp.einsum("th,hk->tk", x, params["value_head"]["kernel"])
             + params["value_head"]["bias"]
         )
         out = values[:, 0].astype(jnp.float32)
+        out_axes = ("tokens",)
     elif cfg.tie_word_embeddings:
         out = jnp.einsum(
             "th,vh->tv", x, params["embed"]["embedding"].astype(compute_dtype)
         ).astype(jnp.float32)
-        out = _cstr(out, "tokens", "act_vocab")
+        out_axes = ("tokens", "act_vocab")
+        out = _cstr(out, *out_axes)
     else:
         out = jnp.einsum(
             "th,hv->tv", x, params["lm_head"]["kernel"]
         ).astype(jnp.float32)
-        out = _cstr(out, "tokens", "act_vocab")
+        out_axes = ("tokens", "act_vocab")
+        out = _cstr(out, *out_axes)
+    if zz_inv is not None:
+        # Invert the zig-zag layout so loss functions / callers see the
+        # contiguous packed order they built the micro-batch in.
+        out = _cstr(out[zz_inv], *out_axes)
     if with_aux:
         return out, aux_total
     return out
 
 
-def forward_pipelined(
-    params: dict,
-    input_ids: jax.Array,
-    position_ids: jax.Array,
-    segment_ids: jax.Array,
-    cfg: ModelConfig,
-    mesh,
-    per_mb_fn,
-    mb_data: dict | None = None,
-    *,
-    with_aux: bool = False,
-    head_mode: str = "logits",
-):
-    """Pipelined packed forward over M stacked microbatches.
-
-    The pp>1 counterpart of `forward` (parity: the reference's pipelined
-    train/generation schedules, realhf .../static_schedule.py:159): the
-    decoder trunk runs through parallel/pipeline.py's GPipe shard_map with
-    the scanned layer stack sharded over the "pp" mesh axis; embedding runs
-    vectorized over all microbatches up front, and the lm_head + caller's
-    `per_mb_fn(logits_f32 [T, V], mb_slice)` run in a scan over
-    microbatches afterward so only one [T, V] logits buffer is ever live.
-
-    Args: input_ids/position_ids/segment_ids are [M, T]; `mb_data` is a
-    pytree of [M, ...] arrays whose m-th slice is handed to per_mb_fn.
-    Returns stacked per-mb outputs (and the summed MoE aux loss when
-    `with_aux`).
-    """
-    from areal_tpu.parallel import mesh as mesh_lib
-    from areal_tpu.parallel.pipeline import pipeline_trunk
-
+def _pp_embed(params: dict, input_ids: jax.Array, position_ids: jax.Array,
+              cfg: ModelConfig) -> jax.Array:
+    """Embedding for the pipelined paths: [M, T] ids → [M, T, H]."""
     compute_dtype = jnp.dtype(cfg.dtype)
-    assert cfg.scan_layers, "pipeline parallelism requires scan_layers=True"
-
     table = _cstr(params["embed"]["embedding"], "vocab", None)
-    x = _scale_embed(table[input_ids].astype(compute_dtype), cfg)  # [M, T, H]
+    x = _scale_embed(table[input_ids].astype(compute_dtype), cfg)
     if cfg.pos_embed == "learned":
         ptab = _cstr(params["pos_embed"]["embedding"], None, None)
         x = x + ptab[position_ids].astype(compute_dtype)
+    return x
 
+
+def _pp_stage_fn(cfg: ModelConfig):
+    """One pipeline stage: a scan over the stage-local [L/pp, ...] layers.
+    aux_t = (position_ids, segment_ids) for the stage's current microbatch."""
     layer_fn = _maybe_remat(decoder_layer, cfg)
 
     def stage_fn(layers_local, h, aux_t):
@@ -1432,49 +1453,188 @@ def forward_pipelined(
         )
         return h, aux_sum
 
-    # Trace the stage body WITHOUT the ambient mesh: (a) activation-layout
-    # constraints (`_cstr`) would name auto axes through a NamedSharding
-    # bound to the full mesh, which partial-manual shard_map rejects;
-    # (b) attention must not resolve to ring (its own shard_map does not
-    # nest inside the pp-manual region) — with no mesh it resolves to
-    # flash/dense, both GSPMD-partitionable along the auto axes.
+    return stage_fn
+
+
+def _pp_head_out(p: dict, y: jax.Array, cfg: ModelConfig, head_mode: str):
+    """Final norm + output head on one microbatch's trunk output. `p` may be
+    the full param tree or the non-layer head subtree — only head leaves are
+    read. head_mode "hidden" returns the normed hidden states (fused-loss
+    callers wrap them in an LMHead)."""
+    compute_dtype = jnp.dtype(cfg.dtype)
+    h = _norm(y, p["final_norm"], cfg, p.get("final_norm_bias"))
+    if head_mode == "hidden":
+        return h
+    if cfg.is_critic:
+        values = (
+            jnp.einsum("th,hk->tk", h, p["value_head"]["kernel"])
+            + p["value_head"]["bias"]
+        )
+        return values[:, 0].astype(jnp.float32)
+    if cfg.tie_word_embeddings:
+        return jnp.einsum(
+            "th,vh->tv", h, p["embed"]["embedding"].astype(compute_dtype)
+        ).astype(jnp.float32)
+    return jnp.einsum(
+        "th,hv->tv", h, p["lm_head"]["kernel"]
+    ).astype(jnp.float32)
+
+
+def forward_pipelined(
+    params: dict,
+    input_ids: jax.Array,
+    position_ids: jax.Array,
+    segment_ids: jax.Array,
+    cfg: ModelConfig,
+    mesh,
+    per_mb_fn,
+    mb_data: dict | None = None,
+    *,
+    with_aux: bool = False,
+    head_mode: str = "logits",
+):
+    """Pipelined packed forward over M stacked microbatches (GPipe trunk).
+
+    The pp>1 counterpart of `forward` (parity: the reference's pipelined
+    train/generation schedules, realhf .../static_schedule.py:159): the
+    decoder trunk runs through parallel/pipeline.py's stage-stacked GPipe
+    schedule with the scanned layer stack sharded over the "pp" mesh axis;
+    embedding runs vectorized over all microbatches up front, and the
+    lm_head + caller's `per_mb_fn(logits_f32 [T, V], mb_slice)` run in a
+    scan over microbatches afterward so only one [T, V] logits buffer is
+    ever live. Gradients (when taken) follow the GPipe
+    all-forward-then-all-backward schedule via plain autodiff — the
+    memory-capped alternative is `forward_pipelined_grads` (1F1B).
+
+    Args: input_ids/position_ids/segment_ids are [M, T]; `mb_data` is a
+    pytree of [M, ...] arrays whose m-th slice is handed to per_mb_fn.
+    Returns stacked per-mb outputs (and the summed MoE aux loss when
+    `with_aux`).
+    """
+    from areal_tpu.parallel import mesh as mesh_lib
+    from areal_tpu.parallel.pipeline import pipeline_trunk
+
+    assert cfg.scan_layers, "pipeline parallelism requires scan_layers=True"
+    x = _pp_embed(params, input_ids, position_ids, cfg)  # [M, T, H]
+
+    # Trace the stage body WITHOUT the ambient mesh: (a) the stage runs
+    # under a vmap whose leading dim is the pp axis, where token-axis
+    # constraints would fight the stage-stacked layout pins; (b) attention
+    # must not resolve to ring (its own shard_map does not nest under the
+    # stage vmap) — with no mesh it resolves to flash/dense, both
+    # GSPMD-partitionable along the non-pp axes.
     with mesh_lib.mesh_scope(None):
         ys, aux_total = pipeline_trunk(
             mesh,
-            stage_fn,
+            _pp_stage_fn(cfg),
             combine_layers_with_lora(params, cfg),
             x,
             (position_ids, segment_ids),
         )
 
-    def head_of(y):
-        h = _norm(y, params["final_norm"], cfg, params.get("final_norm_bias"))
-        if head_mode == "hidden":
-            # fused-loss path: per_mb_fn consumes hidden states directly
-            # (wrapping them in an LMHead) — no logits here either.
-            return h
-        if cfg.is_critic:
-            values = (
-                jnp.einsum("th,hk->tk", h, params["value_head"]["kernel"])
-                + params["value_head"]["bias"]
-            )
-            return values[:, 0].astype(jnp.float32)
-        if cfg.tie_word_embeddings:
-            return jnp.einsum(
-                "th,vh->tv", h, params["embed"]["embedding"].astype(compute_dtype)
-            ).astype(jnp.float32)
-        return jnp.einsum(
-            "th,hv->tv", h, params["lm_head"]["kernel"]
-        ).astype(jnp.float32)
-
     def head_scan(_, inp):
         y, mb_m = inp
-        return None, per_mb_fn(head_of(y), mb_m)
+        return None, per_mb_fn(_pp_head_out(params, y, cfg, head_mode), mb_m)
 
     _, outs = jax.lax.scan(head_scan, None, (ys, mb_data))
     if with_aux:
         return outs, aux_total
     return outs
+
+
+def forward_pipelined_grads(
+    trainable: dict,
+    frozen: dict,
+    input_ids: jax.Array,
+    position_ids: jax.Array,
+    segment_ids: jax.Array,
+    cfg: ModelConfig,
+    mesh,
+    per_mb_loss_fn,
+    mb_data: dict,
+    weights: jax.Array,
+    *,
+    head_mode: str = "logits",
+    lora_mode: bool = False,
+):
+    """Pipelined loss AND gradients under the 1F1B schedule.
+
+    Unlike `forward_pipelined` (differentiated from outside), this composes
+    explicit vjps: the trunk loop (parallel/pipeline.pipeline_1f1b_grads)
+    interleaves each microbatch's backward into the forward stream — live
+    activation stash capped at 2·pp-1 stage inputs instead of growing with
+    M — and hands back gradients w.r.t. (stacked layers, head subtree,
+    embedded activations), which are pulled back here through the
+    embedding / lora-combine / head-selection vjps onto `trainable`.
+
+    Args:
+      trainable/frozen: the engine's param split (frozen = {} unless LoRA).
+      per_mb_loss_fn: (head_out, mb_m) -> (scalar_loss, stats_dict) where
+        head_out is logits [T, V] / values [T] / an LMHead per `head_mode`.
+      weights: [M] float32; gradients equal
+        d(Σ_m weights[m]·loss_m + router_coef·aux)/d(trainable).
+
+    Returns (losses [M], stats pytree of [M, ...], aux_total, grads) with
+    `grads` shaped like `trainable`.
+    """
+    from areal_tpu.parallel import mesh as mesh_lib
+    from areal_tpu.parallel.pipeline import pipeline_1f1b_grads
+
+    assert cfg.scan_layers, "pipeline parallelism requires scan_layers=True"
+
+    def full(t):
+        return {**frozen, "lora": t} if lora_mode else t
+
+    # Each piece of the model around the trunk loop gets its own vjp; their
+    # cotangents are what the 1F1B loop produces. Under LoRA the embedding
+    # and head close over `frozen` only, so their pullbacks are symbolic
+    # zeros XLA eliminates — matching the stop_gradient semantics of the
+    # GPipe path.
+    xs, embed_vjp = jax.vjp(
+        lambda t: _pp_embed(full(t), input_ids, position_ids, cfg), trainable
+    )
+    layers, layers_vjp = jax.vjp(
+        lambda t: combine_layers_with_lora(full(t), cfg), trainable
+    )
+    head_params, head_vjp = jax.vjp(
+        lambda t: {
+            k: v for k, v in full(t).items() if k not in ("layers", "lora")
+        },
+        trainable,
+    )
+
+    def head_loss(hp, y, mb_m):
+        out = _pp_head_out(hp, y, cfg, head_mode)
+        if head_mode == "hidden":
+            out = LMHead(out, hp, cfg)
+        return per_mb_loss_fn(out, mb_m)
+
+    aux_coef = (
+        float(cfg.router_aux_loss_coef)
+        if (cfg.num_experts and cfg.router_aux_loss_coef > 0)
+        else 0.0
+    )
+    with mesh_lib.mesh_scope(None):
+        losses, stats, aux_total, g_layers, g_head, g_xs = pipeline_1f1b_grads(
+            mesh,
+            _pp_stage_fn(cfg),
+            head_loss,
+            layers,
+            head_params,
+            xs,
+            (position_ids, segment_ids),
+            mb_data,
+            weights,
+            aux_coef=aux_coef,
+        )
+
+    grads = jax.tree.map(
+        lambda a, b, c: a + b + c,
+        embed_vjp(g_xs)[0],
+        layers_vjp(g_layers)[0],
+        head_vjp(g_head)[0],
+    )
+    return losses, stats, aux_total, grads
 
 
 def segment_ids_from_cu_seqlens(cu_seqlens: np.ndarray, total: int) -> np.ndarray:

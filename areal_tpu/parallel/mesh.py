@@ -151,6 +151,9 @@ def default_rules(fsdp: bool = True, pp: bool = False) -> LogicalRules:
         ("batch", AXIS_DP),
         ("seq", AXIS_SP),
         ("tokens", (AXIS_DP, AXIS_SP)),  # packed 1-D token streams
+        # pipeline: the leading stage dim of stage-stacked activations /
+        # layer stacks ([pp, ...] arrays inside parallel/pipeline.py)
+        ("stages", AXIS_PP),
         ("act_embed", None),
         ("act_heads", AXIS_TP),
         ("act_kv_heads", AXIS_TP),
@@ -211,7 +214,9 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
-def constrain(x: jax.Array, *logical_axes: str | None) -> jax.Array:
+def constrain(
+    x: jax.Array, *logical_axes: str | None, mesh: Mesh | None = None
+) -> jax.Array:
     """Pin an activation's layout by logical axis names (no-op without an
     ambient mesh).
 
@@ -220,8 +225,13 @@ def constrain(x: jax.Array, *logical_axes: str | None) -> jax.Array:
     where "involuntary full rematerialization" reshards come from: XLA
     derives one layout for a scan residual from the forward and a different
     one from the gradient flow, then replicates to bridge them.
+
+    `mesh` overrides the ambient mesh — parallel/pipeline.py pins its
+    stage-stacked carries against the engine mesh while the stage bodies
+    trace under mesh_scope(None).
     """
-    mesh = current_mesh()
+    if mesh is None:
+        mesh = current_mesh()
     if mesh is None:
         return x
     spec = logical_to_mesh_axes(logical_axes, default_rules())
@@ -242,4 +252,25 @@ def constrain(x: jax.Array, *logical_axes: str | None) -> jax.Array:
         fixed.append(axes if dim % size == 0 else PartitionSpec.UNCONSTRAINED)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, PartitionSpec(*fixed))
+    )
+
+
+def manual_shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """Fully-manual shard_map across jax API generations.
+
+    jax >= 0.6 exposes `jax.shard_map` (with `check_vma`); older releases
+    (this container ships 0.4.x) only have
+    `jax.experimental.shard_map.shard_map` (with `check_rep`). Both are the
+    same primitive for the fully-manual case ring attention needs — every
+    mesh axis manual, replication checking off.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
     )

@@ -1,30 +1,55 @@
-"""GPipe-style SPMD pipeline parallelism over the "pp" mesh axis.
+"""SPMD pipeline parallelism over the "pp" mesh axis — stage-stacked GSPMD.
 
 Parity target: the reference's native pipeline engine —
 realhf/impl/model/parallelism/pipeline_parallel/static_schedule.py:159
 (instruction schedules) + pipe_runner.py:778 (executors) and Megatron's
 forward_backward_func (areal/engine/megatron_engine.py:846). The TPU
 re-design replaces instruction lists + p2p send/recv with a single jitted
-program: a `jax.shard_map` manual over the "pp" axis (auto over dp/sp/tp,
-so GSPMD still handles FSDP/TP/SP inside each stage) where
+program over *stage-stacked* arrays:
 
-- the stacked layer parameters [L, ...] are sharded over pp on dim 0, so
-  each stage holds L/pp layers (the memory scaling PP exists for),
-- M microbatches stream through the stages: at step t, stage s runs
-  microbatch (t - s); activations hop stage→stage with one
-  `lax.ppermute` per step (the ICI analogue of Megatron's p2p),
-- the loop runs M + pp - 1 steps (fill + drain), outputs are collected on
-  the last stage and replicated with one masked psum.
+- the stacked layer parameters [L, ...] are reshaped to [pp, L/pp, ...] and
+  sharded over the "pp" mesh axis on dim 0, so each stage holds L/pp layers
+  (the memory scaling PP exists for),
+- pipeline state is [pp, T, H]: row s is the activation stage s works on.
+  One `jax.vmap(stage_fn)` over the leading dim runs every stage in
+  parallel — GSPMD partitions the vmapped program over "pp" (and keeps
+  handling dp/sp/tp automatically inside each stage),
+- activations hop stage→stage with `jnp.roll(y, 1, axis=0)` — a static
+  rotation XLA lowers to the same neighbour collective-permute a manual
+  ppermute would emit. (An earlier revision used a partial-manual
+  `shard_map` with explicit ppermutes; the stage-stacked form is
+  numerically identical, and — unlike partial-auto shard_map — also
+  compiles on the 0.4.x jax this repo must still run on.)
 
-Autodiff runs straight through (ppermute transposes to the reverse
-permutation), which yields the backward pipeline automatically — no 1F1B
-instruction table. XLA overlaps the ppermute with the next step's compute
-where the schedule allows.
+Two schedules:
+
+- `pipeline_trunk` — GPipe: all M forwards stream through (M + pp - 1
+  steps), outputs collect on the last stage, autodiff runs straight back
+  through the scan. Simple and the numerics reference, but the backward
+  scan holds residuals for every step, so live activation memory grows
+  with M.
+- `pipeline_1f1b_grads` — 1F1B: one interleaved loop of M + 2·pp - 2
+  rounds where every round runs one forward AND one backward per stage
+  (warmup/cooldown rounds masked). The backward is explicit — a per-stage
+  `jax.vjp` that recomputes the stage forward from a stashed input — so
+  nothing autodiffs through the round scan and the live stash is capped at
+  2·pp - 1 stage inputs per stage regardless of M. Microbatch m's loss
+  gradient is seeded in the same round its forward reaches the last stage
+  (head + loss + vjp run inline on that stage's output), which is what
+  lets the stash recycle. Larger M therefore fits in fixed HBM and the
+  bubble fraction (pp-1)/(M+pp-1) shrinks at fixed memory — the point of
+  1F1B (GPipe stays available via `pipeline_schedule: gpipe`).
+
+Schedule timetable (round r, stage s, microbatch m, P = pp):
+    F(m, s) at r = m + s              (forward wavefront, GPipe-like)
+    B(m, s) at r = m + 2P - 2 - s     (backward wavefront, mirrored)
+so F(m, P-1) and B(m, P-1) land in the SAME round (loss seeds backward
+immediately) and stage s holds at most 2(P-1-s)+1 <= 2P-1 stashed inputs.
 
 Attention inside a stage must not itself shard tokens over (dp, sp) with a
-kernel that can't be partitioned (ring attention's own shard_map does not
-nest inside the pp-manual region); the model resolves attention to a
-pp-compatible impl while tracing the stage body (see forward_pipelined).
+kernel that can't be partitioned (ring attention's shard_map cannot nest
+under the stage vmap); the model resolves attention to a pp-compatible impl
+while tracing the stage body (see qwen2.forward_pipelined).
 """
 
 from __future__ import annotations
@@ -33,9 +58,63 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from areal_tpu.parallel import mesh as mesh_lib
+
+# Engine-facing names for the two trunk schedules (api/cli_args.py
+# JaxEngineConfig.pipeline_schedule).
+PIPELINE_SCHEDULES = ("gpipe", "1f1b")
+
+
+def _stage_stack(layers: Any, pp: int) -> Any:
+    """[L, ...] stacked layer pytree → [pp, L/pp, ...].
+
+    The reshape splits the pp-sharded leading dim on its sharded factor, so
+    GSPMD keeps each stage's L/pp layers on its own shard — no data moves.
+    """
+
+    def split(leaf):
+        L = leaf.shape[0]
+        assert L % pp == 0, (L, pp)
+        return leaf.reshape(pp, L // pp, *leaf.shape[1:])
+
+    return jax.tree.map(split, layers)
+
+
+def _index_mb(tree: Any, m: jax.Array) -> Any:
+    """Slice the m-th microbatch out of a pytree of [M, ...] arrays."""
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, keepdims=False), tree
+    )
+
+
+def _gather_per_stage(tree: Any, m_per_stage: jax.Array) -> Any:
+    """Per-stage microbatch selection: tree of [M, ...] → tree of [pp, ...]
+    where row s is the m_per_stage[s]-th microbatch."""
+    return jax.vmap(lambda m: _index_mb(tree, m))(m_per_stage)
+
+
+def _masked_row_write(
+    buf: jax.Array, val: jax.Array, idx: jax.Array, valid: jax.Array
+) -> jax.Array:
+    """buf[idx] = val where valid, else keep — the write-or-keep idiom that
+    makes clipped (out-of-schedule) indices harmless."""
+    prev = jax.lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+    return jax.lax.dynamic_update_index_in_dim(
+        buf, jnp.where(valid, val, prev).astype(buf.dtype), idx, 0
+    )
+
+
+def _pin_stagewise(
+    mesh: Mesh, x: jax.Array, token_dim: int = 1
+) -> jax.Array:
+    """Pin a stage-stacked pipeline carry: dim 0 over "pp", `token_dim`
+    over (dp, sp); remaining dims pinned replicated."""
+    axes: list[str | None] = [None] * x.ndim
+    axes[0] = "stages"
+    axes[token_dim] = "tokens"
+    return mesh_lib.constrain(x, *axes, mesh=mesh)
 
 
 def pipeline_trunk(
@@ -45,7 +124,7 @@ def pipeline_trunk(
     xs: jax.Array,
     aux_inputs: Any,
 ) -> tuple[jax.Array, jax.Array]:
-    """Run `stage_fn` over pp stages for M microbatches.
+    """GPipe schedule: run `stage_fn` over pp stages for M microbatches.
 
     Args:
       mesh: the engine mesh; must contain a "pp" axis of size >= 2.
@@ -57,67 +136,201 @@ def pipeline_trunk(
       aux_inputs: pytree of [M, ...] per-microbatch side inputs (positions,
         segment ids, ...) indexed — not circulated — per step.
 
-    Returns (ys [M, T, H], total_aux_loss), both replicated over pp.
+    Returns (ys [M, T, H], total_aux_loss). Autodiff runs straight through
+    (the backward pipeline falls out of the scan's reverse), which is the
+    reference path `pipeline_1f1b_grads` is validated against.
     """
     pp = mesh.shape[mesh_lib.AXIS_PP]
     M = xs.shape[0]
     steps = M + pp - 1
-    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    stages = jnp.arange(pp)
+    layers_s = _stage_stack(layers, pp)
 
-    def staged(layers_local, xs, aux_inputs):
-        stage = jax.lax.axis_index(mesh_lib.AXIS_PP)
-
-        def step(carry, t):
-            state, outbuf, aux_sum = carry
-            # stage s works on microbatch m = t - s (valid when 0 <= m < M)
-            m = jnp.clip(t - stage, 0, M - 1)
-            fresh = jax.lax.dynamic_index_in_dim(
-                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
-            )
-            x_in = jnp.where(stage == 0, fresh, state)
-            aux_t = jax.tree.map(
-                lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
-                aux_inputs,
-            )
-            y, aux = stage_fn(layers_local, x_in, aux_t)
-            valid = (t - stage >= 0) & (t - stage < M)
-            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
-            out_m = jnp.clip(t - (pp - 1), 0, M - 1)
-            is_out = (stage == pp - 1) & (t >= pp - 1)
-            prev_row = jax.lax.dynamic_index_in_dim(
-                outbuf, out_m, 0, keepdims=False
-            )
-            outbuf = jax.lax.dynamic_update_index_in_dim(
-                outbuf,
-                jnp.where(is_out, y, prev_row).astype(outbuf.dtype),
-                out_m,
-                0,
-            )
-            state = jax.lax.ppermute(y, mesh_lib.AXIS_PP, perm)
-            return (state, outbuf, aux_sum), None
-
-        init = (
-            jnp.zeros_like(xs[0]),
-            jnp.zeros_like(xs),
-            jnp.float32(0.0),
+    def step(carry, t):
+        state, outbuf, aux_sum = carry
+        # stage s works on microbatch m = t - s (valid when 0 <= m < M)
+        mf = t - stages
+        f_valid = (mf >= 0) & (mf < M)
+        mf_c = jnp.clip(mf, 0, M - 1)
+        fresh = jax.lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
         )
-        (_, outbuf, aux_sum), _ = jax.lax.scan(
-            step, init, jnp.arange(steps)
+        x_in = jnp.where((stages == 0)[:, None, None], fresh[None], state)
+        y, aux = jax.vmap(stage_fn)(
+            layers_s, x_in, _gather_per_stage(aux_inputs, mf_c)
         )
-        # Only the last stage's buffer holds real outputs; a masked psum
-        # replicates it across pp (one collective per step, not per token).
-        outbuf = jax.lax.psum(
-            jnp.where(stage == pp - 1, outbuf, jnp.zeros_like(outbuf)),
-            mesh_lib.AXIS_PP,
-        )
-        aux_sum = jax.lax.psum(aux_sum, mesh_lib.AXIS_PP)
-        return outbuf, aux_sum
+        aux_sum = aux_sum + jnp.sum(jnp.where(f_valid, aux, 0.0))
+        # the last stage finishes microbatch t - (pp - 1)
+        out_m = jnp.clip(t - (pp - 1), 0, M - 1)
+        outbuf = _masked_row_write(outbuf, y[pp - 1], out_m, t >= pp - 1)
+        state = _pin_stagewise(mesh, jnp.roll(y, 1, axis=0))
+        return (state, outbuf, aux_sum), None
 
-    return jax.shard_map(
-        staged,
-        mesh=mesh,
-        in_specs=(P(mesh_lib.AXIS_PP), P(), P()),
-        out_specs=(P(), P()),
-        axis_names=frozenset({mesh_lib.AXIS_PP}),
-        check_vma=False,
-    )(layers, xs, aux_inputs)
+    init = (
+        _pin_stagewise(mesh, jnp.zeros((pp,) + xs.shape[1:], xs.dtype)),
+        jnp.zeros_like(xs),
+        jnp.float32(0.0),
+    )
+    (_, outbuf, aux_sum), _ = jax.lax.scan(step, init, jnp.arange(steps))
+    return outbuf, aux_sum
+
+
+def pipeline_1f1b_grads(
+    mesh: Mesh,
+    stage_fn: Callable[[Any, jax.Array, Any], tuple[jax.Array, jax.Array]],
+    head_loss_fn: Callable[[Any, jax.Array, Any], tuple[jax.Array, Any]],
+    layers: Any,
+    head_params: Any,
+    xs: jax.Array,
+    aux_inputs: Any,
+    mb_data: Any,
+    weights: jax.Array,
+    *,
+    aux_coef: float = 0.0,
+) -> tuple[jax.Array, Any, jax.Array, Any, Any, jax.Array]:
+    """1F1B schedule with the backward interleaved into the forward loop.
+
+    This does NOT return a differentiable value — it returns the gradients
+    themselves, computed by explicit per-stage `jax.vjp` (recompute from a
+    stashed stage input, so the stage body is effectively rematerialised).
+    Callers (models/qwen2.forward_pipelined_grads) compose these trunk
+    gradients with the embedding / lora-combine / head-selection vjps.
+
+    Args:
+      stage_fn / layers / xs / aux_inputs: as `pipeline_trunk`.
+      head_loss_fn: (head_params, y [T, H], mb_m) -> (scalar_loss, stats)
+        — the final-norm + lm-head + caller loss for ONE microbatch, run on
+        the last stage's output in the same round it is produced.
+      head_params: pytree the head reads (final norm / lm head / tied
+        embeddings ...), replicated over pp.
+      mb_data: pytree of [M, ...] per-microbatch loss inputs.
+      weights: [M] float32 loss weights; gradients equal
+        d(sum_m weights[m]·loss_m + aux_coef·aux_total)/dθ.
+      aux_coef: cotangent seeded into each stage's scalar aux output (MoE
+        router load-balance coefficient; 0 when unused).
+
+    Returns (losses [M], stats pytree of [M, ...], aux_total,
+    g_layers [L, ...], g_head, g_xs [M, T, H]).
+    """
+    pp = mesh.shape[mesh_lib.AXIS_PP]
+    M = xs.shape[0]
+    S = 2 * pp - 1  # stash slots: max in-flight microbatches on stage 0
+    rounds = M + 2 * pp - 2
+    stages = jnp.arange(pp)
+    layers_s = _stage_stack(layers, pp)
+
+    # Probe the stats pytree structure so the [M]-buffers can be carried
+    # through the scan (eval_shape only — nothing runs here).
+    _, stats_shape = jax.eval_shape(
+        head_loss_fn, head_params, jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype),
+        jax.eval_shape(lambda t: _index_mb(t, 0), mb_data),
+    )
+
+    def round_fn(carry, r):
+        (fwd_in, bwd_in, stash, g_layers, g_head, dxs, losses, stats,
+         aux_sum) = carry
+
+        # ---- one forward per stage: F(m, s) at r = m + s ----------------
+        mf = r - stages
+        f_valid = (mf >= 0) & (mf < M)
+        mf_c = jnp.clip(mf, 0, M - 1)
+        fresh = jax.lax.dynamic_index_in_dim(
+            xs, jnp.clip(r, 0, M - 1), 0, keepdims=False
+        )
+        x_in = jnp.where((stages == 0)[:, None, None], fresh[None], fwd_in)
+        y, aux_f = jax.vmap(stage_fn)(
+            layers_s, x_in, _gather_per_stage(aux_inputs, mf_c)
+        )
+        aux_sum = aux_sum + jnp.sum(jnp.where(f_valid, aux_f, 0.0))
+        # Stash the stage INPUT (not output): the explicit backward re-runs
+        # the stage from it. Invalid rounds must keep, not clobber — the
+        # clipped slot may still be live.
+        stash = jax.vmap(_masked_row_write)(stash, x_in, mf_c % S, f_valid)
+
+        # ---- head + loss + seed on the last stage's fresh output --------
+        m_last = r - (pp - 1)
+        l_valid = (m_last >= 0) & (m_last < M)
+        m_last_c = jnp.clip(m_last, 0, M - 1)
+        mb_m = _index_mb(mb_data, m_last_c)
+        w_m = jnp.where(
+            l_valid,
+            jax.lax.dynamic_index_in_dim(weights, m_last_c, 0, keepdims=False),
+            0.0,
+        )
+        loss_m, head_vjp, stats_m = jax.vjp(
+            lambda hp, y_: head_loss_fn(hp, y_, mb_m),
+            head_params,
+            y[pp - 1],
+            has_aux=True,
+        )
+        # vjp is linear in the cotangent: a zero weight on out-of-schedule
+        # rounds zeroes both the head grads and the backward seed.
+        g_head_m, dy = head_vjp(jnp.zeros_like(loss_m) + w_m)
+        g_head = jax.tree.map(jnp.add, g_head, g_head_m)
+        losses = _masked_row_write(losses, loss_m, m_last_c, l_valid)
+        stats = jax.tree.map(
+            lambda b, v: _masked_row_write(b, v, m_last_c, l_valid),
+            stats,
+            stats_m,
+        )
+
+        # ---- one backward per stage: B(m, s) at r = m + 2pp - 2 - s -----
+        mb_idx = r - (2 * pp - 2 - stages)
+        b_valid = (mb_idx >= 0) & (mb_idx < M)
+        mb_c = jnp.clip(mb_idx, 0, M - 1)
+        g_in = jnp.where((stages == pp - 1)[:, None, None], dy[None], bwd_in)
+        g_in = jnp.where(b_valid[:, None, None], g_in, 0.0)
+        g_aux = jnp.where(b_valid, jnp.float32(aux_coef), 0.0)
+        x_saved = jax.vmap(
+            lambda st, slot: jax.lax.dynamic_index_in_dim(
+                st, slot, 0, keepdims=False
+            )
+        )(stash, mb_c % S)
+        aux_b = _gather_per_stage(aux_inputs, mb_c)
+
+        def stage_bwd(layers_local, x, aux_t, gy, ga):
+            _, vjp = jax.vjp(
+                lambda L_, x_: stage_fn(L_, x_, aux_t), layers_local, x
+            )
+            return vjp((gy.astype(x.dtype), ga))
+
+        g_layers_m, gx = jax.vmap(stage_bwd)(
+            layers_s, x_saved, aux_b, g_in, g_aux
+        )
+        g_layers = jax.tree.map(jnp.add, g_layers, g_layers_m)
+        # stage 0's input gradient feeds the embedding backward
+        dxs = _masked_row_write(
+            dxs, gx[0], jnp.clip(r - (2 * pp - 2), 0, M - 1), b_valid[0]
+        )
+
+        fwd_in = _pin_stagewise(mesh, jnp.roll(y, 1, axis=0))
+        bwd_in = _pin_stagewise(mesh, jnp.roll(gx, -1, axis=0))
+        return (
+            (fwd_in, bwd_in, stash, g_layers, g_head, dxs, losses, stats,
+             aux_sum),
+            None,
+        )
+
+    act_shape = (pp,) + xs.shape[1:]
+    init = (
+        _pin_stagewise(mesh, jnp.zeros(act_shape, xs.dtype)),
+        _pin_stagewise(mesh, jnp.zeros(act_shape, xs.dtype)),
+        _pin_stagewise(
+            mesh, jnp.zeros((pp, S) + xs.shape[1:], xs.dtype), token_dim=2
+        ),
+        jax.tree.map(jnp.zeros_like, layers_s),
+        jax.tree.map(jnp.zeros_like, head_params),
+        jnp.zeros_like(xs),
+        jnp.zeros((M,), jnp.float32),
+        jax.tree.map(
+            lambda s: jnp.zeros((M,) + s.shape, s.dtype), stats_shape
+        ),
+        jnp.float32(0.0),
+    )
+    (_, _, _, g_layers, g_head, dxs, losses, stats, aux_sum), _ = jax.lax.scan(
+        round_fn, init, jnp.arange(rounds)
+    )
+    g_layers = jax.tree.map(
+        lambda g: g.reshape((g.shape[0] * g.shape[1],) + g.shape[2:]), g_layers
+    )
+    return losses, stats, aux_sum, g_layers, g_head, dxs

@@ -45,6 +45,8 @@ __all__ = [
     "MicroBatchList",
     "split_padded_tensor_dict_into_mb_list",
     "amend_position_ids",
+    "zigzag_indices",
+    "zigzag_inverse_indices",
     "Normalization",
     "KLEstimator",
     "cycle_dataloader",
@@ -206,6 +208,36 @@ def unpad_logits(logits: np.ndarray, pad_len: int) -> np.ndarray:
     if pad_len == 0:
         return logits
     return logits[:-pad_len]
+
+
+def zigzag_indices(total: int, n_shards: int) -> np.ndarray:
+    """Zig-zag context-parallel permutation for a length-`total` token axis.
+
+    View the axis as 2n chunks of total/(2n) tokens; shard i holds the
+    chunk pair (i, 2n-1-i), so under causal attention every shard does the
+    same work (the head of the stream pairs with the tail) — the classic
+    balanced CP layout (Megatron/TransformerEngine zig-zag;
+    ops/ring_attention.py consumes it via explicit global positions).
+
+    Returns `perm` with perm[j] = original index of the token placed at
+    permuted position j; apply as `x_zigzag = x[perm]`.
+    """
+    assert total % (2 * n_shards) == 0, (total, n_shards)
+    c = total // (2 * n_shards)
+    chunks = np.arange(total, dtype=np.int32).reshape(2 * n_shards, c)
+    order = []
+    for i in range(n_shards):
+        order.append(chunks[i])
+        order.append(chunks[2 * n_shards - 1 - i])
+    return np.concatenate(order)
+
+
+def zigzag_inverse_indices(total: int, n_shards: int) -> np.ndarray:
+    """Inverse of `zigzag_indices`: contiguous = permuted[inv]."""
+    perm = zigzag_indices(total, n_shards)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(total, dtype=np.int32)
+    return inv
 
 
 def amend_position_ids(data: dict[str, Any]) -> dict[str, Any]:

@@ -169,6 +169,18 @@ def bench_train(model, tokens_per_step, seq_len, mb_tokens, warmup, iters):
     )
 
 
+def _wait_for_running(eng, timeout_s: float, poll_s: float = 0.01) -> bool:
+    """Poll the engine until at least one request is actively decoding.
+    Returns False on deadline — callers must NOT then measure pause latency
+    against the idle engine (it would masquerade as an under-load number)."""
+    deadline = time.perf_counter() + timeout_s
+    while time.perf_counter() < deadline:
+        if eng.get_metrics()["running_requests"] > 0:
+            return True
+        time.sleep(poll_s)
+    return False
+
+
 def bench_decode(model, n_requests, prompt_len, new_tokens, max_running):
     from areal_tpu.api.cli_args import (
         GenerationHyperparameters,
@@ -216,11 +228,18 @@ def bench_decode(model, n_requests, prompt_len, new_tokens, max_running):
         # — the reference aborts mid-request; we land on chunk boundaries).
         # Wait until requests are actually decoding (a fixed sleep misses
         # the whole load window on a fast backend), then pause.
-        deadline = time.perf_counter() + 30.0
-        while time.perf_counter() < deadline:
-            if eng.get_metrics()["running_requests"] > 0:
-                break
-            time.sleep(0.01)
+        if not _wait_for_running(eng, 30.0):
+            # Pausing anyway would time an IDLE-engine pause and report it
+            # as the under-load latency — record the sentinel instead.
+            print(
+                "[bench] pause probe: no running requests within 30s; "
+                "recording pause_s=-1 (not measured) instead of an "
+                "idle-engine pause",
+                file=sys.stderr,
+                flush=True,
+            )
+            interrupt_latency["pause_s"] = -1.0
+            return
         t0 = time.perf_counter()
         eng.pause_generation()
         interrupt_latency["pause_s"] = time.perf_counter() - t0
@@ -253,6 +272,86 @@ def bench_decode(model, n_requests, prompt_len, new_tokens, max_running):
         decode_new_tokens=new_tokens,
         interrupt_pause_latency_s=interrupt_latency.get("pause_s", -1.0),
     )
+
+
+def bench_pp_schedules(model, pp, n_mbs, seq_len, warmup, iters):
+    """Pipeline-schedule micro-bench: the SAME stacked micro-batch stream
+    through the pp>1 trunk under "gpipe" vs "1f1b", reporting per-step wall
+    time and the compiled program's temp (activation) memory — the stash
+    delta the 1F1B schedule exists for (gpipe residuals grow with M; 1f1b
+    is capped at 2·pp-1 stage inputs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.jax_engine import _memory_analysis_dict
+    from areal_tpu.engine.sft.lm_engine import (
+        JaxLMEngine,
+        compute_packed_sft_loss,
+    )
+
+    ndev = jax.device_count()
+    if ndev < pp or ndev % pp:
+        return {"ppsched_skipped": f"{ndev} devices incompatible with pp={pp}"}
+
+    cfg = TrainEngineConfig(
+        experiment_name="bench",
+        trial_name="ppsched",
+        path="",
+        init_from_scratch=True,
+        dtype=model.dtype,
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=seq_len),
+        optimizer=OptimizerConfig(lr=1e-4),
+        gradient_checkpointing=model.remat,
+    )
+    eng = JaxLMEngine(cfg)
+    eng.model_config = model
+    eng.create_process_group(
+        ParallelStrategy(
+            pipeline_parallel_size=pp, data_parallel_size=ndev // pp
+        )
+    )
+    eng.initialize(None, FinetuneSpec(1, 1000, 1))
+
+    rng = np.random.RandomState(0)
+    stacked = {
+        "input_ids": np.asarray(
+            rng.randint(1, model.vocab_size, (n_mbs, seq_len)), np.int32
+        ),
+        "position_ids": np.tile(
+            np.arange(seq_len, dtype=np.int32), (n_mbs, 1)
+        ),
+        "segment_ids": np.zeros((n_mbs, seq_len), np.int32),
+        "loss_mask": np.ones((n_mbs, seq_len), np.int32),
+    }
+    stacked = {k: jnp.asarray(v) for k, v in stacked.items()}
+    weights = jnp.ones((n_mbs,), jnp.float32)
+
+    out = {"pp_size": pp, "pp_n_mbs": n_mbs, "pp_seq_len": seq_len}
+    for sched in ("gpipe", "1f1b"):
+        eng.config.jax.pipeline_schedule = sched
+        fn = eng._get_pipelined_grad_step(compute_packed_sft_loss)
+        compiled = fn.lower(eng.params, stacked, weights).compile()
+        mem = _memory_analysis_dict(compiled)
+        for _ in range(warmup):
+            jax.block_until_ready(fn(eng.params, stacked, weights))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            jax.block_until_ready(fn(eng.params, stacked, weights))
+        out[f"pp_{sched}_step_s"] = (time.perf_counter() - t0) / iters
+        out[f"pp_{sched}_temp_bytes"] = mem.get("temp_size_in_bytes", 0)
+    eng.destroy()
+    if out.get("pp_gpipe_temp_bytes"):
+        out["pp_temp_ratio_gpipe_over_1f1b"] = out["pp_gpipe_temp_bytes"] / max(
+            out["pp_1f1b_temp_bytes"], 1
+        )
+    return out
 
 
 def bench_prefix_decode(model, n_groups, group_size, prompt_len, new_tokens):
@@ -847,6 +946,18 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("ppsched"):
+            decode.update(
+                _retry_transport(
+                    lambda: bench_pp_schedules(
+                        flagship(True), pp=2, n_mbs=8, seq_len=1024,
+                        warmup=1, iters=3,
+                    ),
+                    what="bench_pp_schedules",
+                    attempts=2,
+                    base_delay=15.0,
+                )
+            )
         if want("grpo"):
             # GRPO co-locates trainer (fwd+bwd+opt) and decode engine on
             # one chip: run the actor with remat on to leave HBM headroom
@@ -935,6 +1046,12 @@ def main() -> None:
                     new_tokens=8,
                 )
             )
+        if want("ppsched"):
+            decode.update(
+                bench_pp_schedules(
+                    model, pp=2, n_mbs=8, seq_len=128, warmup=1, iters=2
+                )
+            )
         if want("grpo"):
             decode.update(
                 bench_grpo(
@@ -961,6 +1078,7 @@ def main() -> None:
             "decode": ("decode_tokens_per_sec_per_chip", "tok/s/chip"),
             "prefix": ("prefix_share_speedup", "x"),
             "grpo": ("grpo_samples_per_sec_per_chip", "samples/s/chip"),
+            "ppsched": ("pp_temp_ratio_gpipe_over_1f1b", "x"),
         }[mode]
         print(
             json.dumps(
@@ -987,7 +1105,7 @@ if __name__ == "__main__":
         p.add_argument(
             "--mode",
             default=os.environ.get("AREAL_BENCH_MODE", "all"),
-            choices=["all", "train", "decode", "prefix", "grpo"],
+            choices=["all", "train", "decode", "prefix", "grpo", "ppsched"],
             help="which measurements to run (default: all)",
         )
         args = p.parse_args()

@@ -1,0 +1,151 @@
+"""Zig-zag context-parallel layout (tier-1, CPU, fast).
+
+The zig-zag permutation is a pure relabeling of the packed token axis —
+shard i holds the chunk pair (i, 2n-1-i) — so every invariant here is
+exactness, not approximation: permute → ring-attend → unpermute must equal
+the contiguous layout, at the kernel level and through the whole model.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from areal_tpu.api.alloc_mode import ParallelStrategy
+from areal_tpu.models.qwen2 import ModelConfig, forward, init_params
+from areal_tpu.ops.ring_attention import (
+    _shard_positions,
+    cp_ring_shards,
+    ring_flash_attention,
+    zigzag_eligible,
+)
+from areal_tpu.parallel import mesh as mesh_lib
+from areal_tpu.utils.data import zigzag_indices, zigzag_inverse_indices
+from tests.test_flash_attention import dense_reference, make_inputs
+
+
+@pytest.mark.parametrize("total,n", [(256, 2), (512, 4), (96, 3)])
+def test_zigzag_permutation_roundtrip(total, n):
+    perm = zigzag_indices(total, n)
+    inv = zigzag_inverse_indices(total, n)
+    x = np.arange(total)
+    np.testing.assert_array_equal(x[perm][inv], x)
+    np.testing.assert_array_equal(np.sort(perm), x)
+    # every shard holds exactly the chunk pair (i, 2n-1-i)
+    c = total // (2 * n)
+    for i in range(n):
+        shard = perm[i * 2 * c : (i + 1) * 2 * c]
+        chunks = {int(t) // c for t in shard}
+        assert chunks == {i, 2 * n - 1 - i}
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_ring_positions_match_data_layout(n):
+    """The ring body's position maps ARE the data helper's permutation —
+    the one contract that keeps kernel causality and host layout in sync."""
+    Tl = 128 * 2 // 2  # any even local length
+    total = n * Tl
+    perm = zigzag_indices(total, n)
+    for i in range(n):
+        pos = np.asarray(
+            _shard_positions(jnp.int32(i), Tl, n, zigzag=True)
+        )
+        np.testing.assert_array_equal(pos, perm[i * Tl : (i + 1) * Tl])
+        contig = np.asarray(
+            _shard_positions(jnp.int32(i), Tl, n, zigzag=False)
+        )
+        np.testing.assert_array_equal(contig, np.arange(Tl) + i * Tl)
+
+
+@pytest.fixture()
+def cp2_mesh(cpu_devices):
+    mesh = mesh_lib.build_mesh(
+        ParallelStrategy(data_parallel_size=2), devices=cpu_devices[:2]
+    )
+    mesh_lib.set_current_mesh(mesh)
+    yield mesh
+    mesh_lib.set_current_mesh(None)
+
+
+def test_ring_zigzag_matches_dense(cp2_mesh):
+    T, nH, nKV, hd = 256, 2, 2, 32
+    q, k, v, seg = make_inputs(T, nH, nKV, hd, pad=19, n_seqs=3)
+    n = cp_ring_shards(T, cp2_mesh)
+    assert n == 2 and zigzag_eligible(T, cp2_mesh)
+    perm = zigzag_indices(T, n)
+    inv = zigzag_inverse_indices(T, n)
+    out_z = ring_flash_attention(
+        q[perm], k[perm], v[perm], seg[perm],
+        mesh=cp2_mesh, zigzag=True, interpret=True,
+    )
+    ref = dense_reference(q, k, v, seg)
+    np.testing.assert_allclose(
+        np.asarray(out_z)[inv], np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ring_zigzag_gradients_match(cp2_mesh):
+    T, nH, nKV, hd = 256, 2, 2, 32
+    q, k, v, seg = make_inputs(T, nH, nKV, hd, pad=7, seed=5, n_seqs=2)
+    n = cp_ring_shards(T, cp2_mesh)
+    perm = jnp.asarray(zigzag_indices(T, n))
+    inv = jnp.asarray(zigzag_inverse_indices(T, n))
+
+    def loss_zig(q, k, v):
+        o = ring_flash_attention(
+            q[perm], k[perm], v[perm], seg[perm],
+            mesh=cp2_mesh, zigzag=True, interpret=True,
+        )
+        return jnp.sum(jnp.sin(o[inv]))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.sin(dense_reference(q, k, v, seg)))
+
+    gz = jax.grad(loss_zig, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gz, gd, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-4, rtol=3e-4, err_msg=name
+        )
+
+
+def test_model_forward_zigzag_matches_contiguous(cp2_mesh):
+    """cp_zigzag=True permutes at forward entry and inverts on the logits:
+    byte-for-byte the same contract as the contiguous ring layout."""
+    cfg = ModelConfig(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        dtype="float32",
+        param_dtype="float32",
+        attn_impl="ring",
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    T = 256
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(1, 64, (T,)), jnp.int32)
+    seg = jnp.asarray(np.repeat(np.arange(4), T // 4), jnp.int32)
+    pos = jnp.asarray(np.tile(np.arange(T // 4, dtype=np.int32), 4))
+
+    out_plain = forward(params, ids, pos, seg, cfg)
+    out_zig = forward(
+        params, ids, pos, seg, dataclasses.replace(cfg, cp_zigzag=True)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_zig), np.asarray(out_plain), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_zigzag_requires_ring_path():
+    # No mesh bound: a zig-zag stream falling back to plain flash would be
+    # silently wrong — must raise instead.
+    T, nH, nKV, hd = 256, 2, 2, 32
+    q, k, v, seg = make_inputs(T, nH, nKV, hd, pad=0, seed=7, n_seqs=2)
+    with pytest.raises(ValueError, match="zigzag"):
+        ring_flash_attention(q, k, v, seg, mesh=None, zigzag=True)
