@@ -190,6 +190,13 @@ class TrainEngineConfig:
     grad_reduce_dtype: str = "float32"
     optimizer: OptimizerConfig | None = None
     weight_update_mode: str = "memory"  # "memory" (device_put) | "disk"
+    # LoRA delta push: when LoRA is active, the "dcn" weight push ships only
+    # the trainable adapter subtree (A/B matrices) and the decode servers
+    # fold the delta into their pristine base kernels at commit — wire bytes
+    # drop by orders of magnitude vs. pushing merged full kernels. Disable
+    # to force the full merged-tree push (e.g. decode servers that did not
+    # start from the same base checkpoint).
+    weight_sync_delta: bool = True
     backend: str = "jax"
     jax: JaxEngineConfig = field(default_factory=JaxEngineConfig)
     use_lora: bool = False
@@ -317,6 +324,15 @@ class InferenceEngineConfig:
     request_timeout: float = 3600.0
     request_retries: int = 3
     pause_grace_period: float = 0.0
+    # Overlapped weight sync: stream staged weight buckets with generation
+    # LIVE and pause only around /commit_weights, so the observed generation
+    # pause is O(device apply) instead of O(network transfer). Disable to
+    # restore the legacy pause-for-the-whole-push behavior.
+    weight_sync_overlap: bool = True
+    # How many packed weight buckets may be in flight at once during the
+    # staged push (device→host gather of bucket N+1 overlaps the HTTP POST
+    # of bucket N; bounded so host memory stays at inflight × chunk_mb).
+    weight_sync_inflight_buckets: int = 2
 
 
 @dataclass

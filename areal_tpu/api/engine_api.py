@@ -98,6 +98,17 @@ class TrainEngine(abc.ABC):
         """Push current weights to the connected inference engine."""
         raise NotImplementedError()
 
+    def update_weights_async(self, meta: WeightUpdateMeta | None = None):
+        """Start a weight push WITHOUT blocking the train loop: the stage
+        phase (host gather + bucket streaming, for transports that support
+        staging) runs on a background thread while the caller keeps
+        training. Returns a handle with `join()` (wait for staging),
+        `commit()` (join, then enter the pause window and commit — the
+        synchronization point the caller chooses) and `abort()`. Engines
+        whose transport has no stage/commit split may run the whole push on
+        the background thread and make commit() a bare join."""
+        raise NotImplementedError()
+
     def connect_engine(self, engine: "InferenceEngine", meta: WeightUpdateMeta):
         """Wire an inference engine for weight updates + rollout dispatch."""
         raise NotImplementedError()
@@ -226,10 +237,44 @@ class InferenceEngine(abc.ABC):
         raise NotImplementedError()
 
     def update_weights_from_tensor(
-        self, named: dict, version: int | None = None, chunk_mb: int = 512
+        self,
+        named: dict,
+        version: int | None = None,
+        chunk_mb: float = 512,
+        **kwargs,
     ) -> None:
         """Install host tensors keyed by `/`-joined param-tree path (the
-        "dcn" in-memory push; see areal_tpu/core/weight_transfer.py)."""
+        "dcn" in-memory push; see areal_tpu/core/weight_transfer.py).
+        `named` may also be an iterable of (name, array) pairs for
+        pipelined producers. Implementations may accept `lora_scale` (LoRA
+        delta push) and `overlap`/`inflight` (staged-push controls)."""
+        raise NotImplementedError()
+
+    # -- staged weight sync (optional; transports with a stage/commit
+    #    split — the HTTP "dcn" path — implement these so staging overlaps
+    #    live generation and only the commit pays a pause) ---------------
+    def stage_weights(
+        self,
+        named,
+        push_id: str | None = None,
+        chunk_mb: float = 512,
+        inflight: int | None = None,
+    ) -> str:
+        """Stream weight buckets into server-side staging WITHOUT pausing
+        generation; returns the push_id to commit or abort."""
+        raise NotImplementedError()
+
+    def commit_staged(
+        self,
+        push_id: str,
+        version: int | None = None,
+        lora_scale: float | None = None,
+    ) -> None:
+        """Atomically install the staged weights (the only pause window)."""
+        raise NotImplementedError()
+
+    def abort_push(self, push_id: str) -> None:
+        """Drop server-side staging for a failed/abandoned push."""
         raise NotImplementedError()
 
     def set_version(self, version: int) -> None:

@@ -122,6 +122,15 @@ class RemoteInfEngine(InferenceEngine):
         self._rid_lock = threading.Lock()
         self._version = 0
         self._executor: WorkflowExecutor | None = None
+        # weight-sync observability (client side); see get_metrics()
+        self._sync_stats = dict(
+            n_pushes=0,
+            wire_bytes=0,
+            last_push_bytes=0,
+            staging_secs=0.0,
+            commit_pause_secs=0.0,
+            aborts=0,
+        )
 
     # -- discovery ------------------------------------------------------
     def _discover_servers(self, addr: str | list[str] | None) -> list[str]:
@@ -320,7 +329,12 @@ class RemoteInfEngine(InferenceEngine):
         )
 
     # -- fanout RPCs ----------------------------------------------------
-    def _fanout(self, endpoint: str, payload: dict[str, Any] | None = None):
+    def _fanout(
+        self,
+        endpoint: str,
+        payload: dict[str, Any] | None = None,
+        timeout: float | None = None,
+    ):
         async def _run():
             try:
                 return await asyncio.gather(
@@ -330,7 +344,7 @@ class RemoteInfEngine(InferenceEngine):
                             endpoint,
                             payload=payload,
                             max_retries=self.config.request_retries,
-                            timeout=self.config.setup_timeout,
+                            timeout=timeout or self.config.setup_timeout,
                         )
                         for a in self.addresses
                     ]
@@ -357,70 +371,224 @@ class RemoteInfEngine(InferenceEngine):
             {"path": meta.path, "version": self._version},
         )
 
-    def update_weights_from_tensor(
-        self,
-        named: dict[str, Any],
-        version: int | None = None,
-        chunk_mb: int = 512,
-    ) -> None:
-        """In-memory push: stream framed weight buckets to every server,
-        then commit (pause → N×POST /update_weights_from_tensor →
-        /commit_weights → continue). The TPU analogue of the reference's
-        NCCL broadcast fast path (fsdp_engine.py:298-401), with DCN/HTTP as
-        the transport and the version stamped inside the servers' pause
-        window."""
+    @staticmethod
+    def _new_push_id() -> str:
+        """Unique AND monotonically ordered (ns timestamp prefix, fixed
+        width): servers reset staging when a *newer* push id appears and
+        reject frames from *older* pushes, so a late retransmitted frame
+        from an aborted push can never wipe the current push's staging."""
         import time as _time
         import uuid
 
+        return f"{_time.time_ns():020d}-{uuid.uuid4().hex[:8]}"
+
+    def stage_weights(
+        self,
+        named: dict[str, Any] | Any,
+        push_id: str | None = None,
+        chunk_mb: float = 512,
+        inflight: int | None = None,
+    ) -> str:
+        """Stream framed weight buckets into every server's staging area
+        with generation LIVE — no pause. The push is pipelined two ways:
+        `named` may be a lazy (name, array) producer (the trainer feeds a
+        device→host prefetching iterator), and packing runs on a feeder
+        thread so building bucket N+1 overlaps the HTTP POST of bucket N,
+        with up to `inflight` bucket broadcasts in the air (bounded queue —
+        host memory stays at ~inflight × chunk_mb).
+
+        On any failure the server-side staging for this push is dropped via
+        /abort_weights before the error propagates, so a crashed push never
+        leaks staging memory. Returns the push_id for commit_staged()."""
+        import queue as _queue
+
         from areal_tpu.core.weight_transfer import pack_buckets
 
-        # Unique AND monotonically ordered (ns timestamp prefix, fixed
-        # width): servers reset staging when a *newer* push id appears and
-        # reject frames from *older* pushes, so a late retransmitted frame
-        # from an aborted push can never wipe the current push's staging.
-        push_id = f"{_time.time_ns():020d}-{uuid.uuid4().hex[:8]}"
+        if inflight is None:
+            inflight = self.config.weight_sync_inflight_buckets
+        inflight = max(int(inflight), 1)
+        push_id = push_id or self._new_push_id()
+        t0 = time.monotonic()
+        n_bytes = 0
 
-        async def _run():
+        # feeder thread: device_get (inside pack's np.ascontiguousarray)
+        # + frame packing, decoupled from the event loop by a bounded queue
+        q: _queue.Queue = _queue.Queue(maxsize=inflight)
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            # stop-aware put: never deadlocks against a dead consumer
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def _produce():
             try:
-                # Stream: one bucket in memory at a time, broadcast to all
-                # servers before building the next.
                 for b in pack_buckets(named, chunk_mb=chunk_mb):
-                    await asyncio.gather(
-                        *[
-                            arequest_with_retry(
-                                a,
-                                f"/update_weights_from_tensor?push_id={push_id}",
-                                data=b,
-                                max_retries=self.config.request_retries,
-                                timeout=self.config.request_timeout,
-                            )
-                            for a in self.addresses
-                        ]
-                    )
+                    if not _put(b):
+                        return
+                _put(None)
+            except Exception as e:  # noqa: BLE001 — relayed to the consumer
+                _put(e)
+
+        feeder = threading.Thread(target=_produce, daemon=True)
+        feeder.start()
+
+        async def _drain():
+            nonlocal n_bytes
+            loop = asyncio.get_running_loop()
+
+            async def _broadcast(b: bytes):
                 await asyncio.gather(
                     *[
                         arequest_with_retry(
                             a,
-                            "/commit_weights",
-                            payload={"version": version},
+                            f"/update_weights_from_tensor?push_id={push_id}",
+                            data=b,
                             max_retries=self.config.request_retries,
                             timeout=self.config.request_timeout,
                         )
                         for a in self.addresses
                     ]
                 )
+
+            tasks: set[asyncio.Task] = set()
+            try:
+                while True:
+                    item = await loop.run_in_executor(None, q.get)
+                    if item is None:
+                        break
+                    if isinstance(item, Exception):
+                        raise item
+                    if len(tasks) >= inflight:
+                        done, tasks = await asyncio.wait(
+                            tasks, return_when=asyncio.FIRST_COMPLETED
+                        )
+                        for t in done:
+                            t.result()  # surface transfer errors
+                    n_bytes += len(item) * len(self.addresses)
+                    tasks.add(asyncio.create_task(_broadcast(item)))
+                if tasks:
+                    await asyncio.gather(*tasks)
+                    tasks = set()
             finally:
+                for t in tasks:
+                    t.cancel()
                 await close_current_session()
 
+        try:
+            asyncio.run(_drain())
+        except BaseException:
+            stop.set()
+            self._sync_stats["aborts"] += 1
+            self.abort_push(push_id)
+            raise
+        finally:
+            feeder.join(timeout=10)
+        self._sync_stats["staging_secs"] += time.monotonic() - t0
+        self._sync_stats["wire_bytes"] += n_bytes
+        self._sync_stats["last_push_bytes"] = n_bytes
+        return push_id
+
+    def _commit_fanout(
+        self,
+        push_id: str | None,
+        version: int | None,
+        lora_scale: float | None,
+    ) -> None:
+        payload: dict[str, Any] = {"version": version}
+        if push_id is not None:
+            payload["push_id"] = push_id
+        if lora_scale is not None:
+            payload["lora_scale"] = float(lora_scale)
+        self._fanout(
+            "/commit_weights", payload, timeout=self.config.request_timeout
+        )
+        if version is not None:
+            self._version = int(version)
+            if self._executor is not None:
+                self._executor.set_version(int(version))
+
+    def commit_staged(
+        self,
+        push_id: str,
+        version: int | None = None,
+        lora_scale: float | None = None,
+    ) -> None:
+        """The ONLY pause window of an overlapped push: pause on chunk
+        boundaries, commit the staged weights on every server (version
+        stamped inside the servers' pause), continue. Observed pause is
+        O(device_put apply), not O(network transfer). The commit is
+        version-fenced server-side: a stale push_id is rejected, so no
+        token can ever mix weight versions."""
+        t0 = time.monotonic()
         self.pause_generation(abort=False)
         try:
-            asyncio.run(_run())
-            if version is not None:
-                self._version = int(version)
-                if self._executor is not None:
-                    self._executor.set_version(int(version))
+            self._commit_fanout(push_id, version, lora_scale)
         finally:
             self.continue_generation()
+        self._sync_stats["commit_pause_secs"] += time.monotonic() - t0
+        self._sync_stats["n_pushes"] += 1
+
+    def abort_push(self, push_id: str) -> None:
+        """Drop server-side staging for a failed/abandoned push (explicit
+        release — otherwise multi-GiB staging lingers until the next push's
+        id happens to reset it)."""
+        try:
+            self._fanout("/abort_weights", {"push_id": push_id})
+        except Exception as e:  # noqa: BLE001 — cleanup is best-effort
+            logger.warning(f"abort_weights({push_id}) failed: {e!r}")
+
+    def update_weights_from_tensor(
+        self,
+        named: dict[str, Any] | Any,
+        version: int | None = None,
+        chunk_mb: float = 512,
+        lora_scale: float | None = None,
+        overlap: bool | None = None,
+        inflight: int | None = None,
+    ) -> None:
+        """In-memory push: stream framed weight buckets to every server,
+        then commit. The TPU analogue of the reference's NCCL broadcast
+        fast path (fsdp_engine.py:298-401), with DCN/HTTP as the transport.
+
+        Overlapped mode (default, `weight_sync_overlap`): buckets stage
+        with generation LIVE and only /commit_weights runs inside a pause —
+        decode servers keep emitting tokens for the whole multi-GiB
+        transfer. Legacy mode (overlap=False) pauses for the entire push.
+        `lora_scale` marks a LoRA delta push: `named` carries only the
+        adapter subtree and servers fold base + scale·A@B at commit."""
+        if overlap is None:
+            overlap = self.config.weight_sync_overlap
+        push_id = self._new_push_id()
+        if overlap:
+            self.stage_weights(
+                named, push_id=push_id, chunk_mb=chunk_mb, inflight=inflight
+            )
+            self.commit_staged(push_id, version=version, lora_scale=lora_scale)
+            return
+        t0 = time.monotonic()
+        self.pause_generation(abort=False)
+        try:
+            self.stage_weights(
+                named, push_id=push_id, chunk_mb=chunk_mb, inflight=inflight
+            )
+            self._commit_fanout(push_id, version, lora_scale)
+        finally:
+            self.continue_generation()
+        # legacy mode: the whole push sat inside the pause window
+        self._sync_stats["commit_pause_secs"] += time.monotonic() - t0
+        self._sync_stats["n_pushes"] += 1
+
+    def get_metrics(self) -> dict:
+        """Client-side weight-sync observability: push counts, wire bytes,
+        staging seconds (generation live) vs commit-pause seconds (the only
+        window generation actually stops)."""
+        return dict(self._sync_stats)
 
     def update_weights_from_distributed(self, meta: WeightUpdateMeta, **kw):
         raise NotImplementedError(

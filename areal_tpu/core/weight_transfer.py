@@ -21,9 +21,10 @@ pipeline across servers.
 
 from __future__ import annotations
 
+import collections
 import json
 import struct
-from typing import Any, Iterable
+from typing import Any, Iterable, Iterator
 
 import numpy as np
 
@@ -59,6 +60,52 @@ def flatten_named(tree: Any, prefix: tuple[str, ...] = ()) -> dict[str, np.ndarr
     return out
 
 
+def named_leaves(
+    tree: Any, prefix: tuple[str, ...] = ()
+) -> Iterator[tuple[str, Any]]:
+    """Lazy flatten_named: yield ("a/b/c", leaf) WITHOUT converting leaves.
+
+    The pipelined push path needs the names before it touches the bytes —
+    np.asarray on a jax.Array blocks on a device→host copy, and doing that
+    eagerly for the whole tree (what flatten_named does) serializes the
+    transfer behind the first HTTP POST."""
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from named_leaves(v, prefix + (str(k),))
+    else:
+        yield "/".join(prefix), tree
+
+
+def iter_prefetched(
+    items: Iterable[tuple[str, Any]], window: int = 2
+) -> Iterator[tuple[str, np.ndarray]]:
+    """Yield (name, host ndarray) with the NEXT `window` device→host copies
+    already in flight (jax.Array.copy_to_host_async). While the consumer
+    packs/POSTs tensor N, the DMA for tensors N+1..N+window runs in the
+    background — the double-buffering half of the pipelined weight push.
+    Non-jax leaves pass through np.asarray unchanged."""
+    window = max(int(window), 1)
+    pending: collections.deque[tuple[str, Any]] = collections.deque()
+
+    def _start(name: str, leaf: Any) -> tuple[str, Any]:
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:  # pragma: no cover - backend-dependent
+                pass
+        return name, leaf
+
+    for name, leaf in items:
+        pending.append(_start(name, leaf))
+        if len(pending) > window:
+            n, l = pending.popleft()
+            yield n, np.asarray(l)
+    while pending:
+        n, l = pending.popleft()
+        yield n, np.asarray(l)
+
+
 def set_named(tree: Any, named: dict[str, np.ndarray], cast=None) -> Any:
     """Replace leaves of `tree` by name; unknown names error, missing names
     keep the old leaf. Returns a new tree of the same structure."""
@@ -82,16 +129,24 @@ def set_named(tree: Any, named: dict[str, np.ndarray], cast=None) -> Any:
 
 
 def pack_buckets(
-    named: dict[str, np.ndarray], chunk_mb: int = 512
+    named: dict[str, np.ndarray] | Iterable[tuple[str, Any]],
+    chunk_mb: float = 512,
 ) -> Iterable[bytes]:
     """Yield framed bucket payloads, each <= chunk_mb. Tensors larger than
     one bucket are split into byte-range parts (part_offset/total_nbytes in
     the manifest) so no single HTTP body ever exceeds the limit — a 2.5 GiB
     embedding streams as five 512 MiB frames. Yielding lazily keeps peak
-    extra host memory at one bucket."""
-    limit = chunk_mb * 1024 * 1024
+    extra host memory at one bucket.
+
+    `named` may be a dict or any (name, array) iterable — the pipelined push
+    feeds a prefetching generator (iter_prefetched) so device→host copies
+    overlap the HTTP POSTs downstream. Tensor bytes are sliced through a
+    zero-copy uint8 view, so a split tensor never duplicates its full buffer
+    (the old `arr.tobytes()` doubled peak host memory for the largest
+    param)."""
+    limit = max(int(chunk_mb * 1024 * 1024), 1)
     manifest: list[dict] = []
-    chunks: list[bytes] = []
+    chunks: list[Any] = []  # bytes-likes (memoryview slices)
     size = 0
 
     def flush():
@@ -101,10 +156,13 @@ def pack_buckets(
         manifest, chunks, size = [], [], 0
         return payload
 
-    for name, arr in named.items():
+    items = named.items() if hasattr(named, "items") else named
+    for name, arr in items:
         arr = np.ascontiguousarray(arr)
-        raw = arr.tobytes()
-        total = len(raw)
+        # flat byte view: slicing it below is zero-copy; the only copy is
+        # the b"".join into the outgoing frame
+        raw = memoryview(arr.reshape(-1).view(np.uint8))
+        total = arr.nbytes
         part_off = 0
         while True:
             take = min(limit - size, total - part_off)
@@ -141,20 +199,46 @@ def unpack_bucket_parts(payload: bytes) -> list[tuple[dict, bytes]]:
     ]
 
 
+def _merge_interval(
+    intervals: list[tuple[int, int]], start: int, end: int
+) -> list[tuple[int, int]]:
+    """Insert [start, end) into sorted disjoint intervals, coalescing
+    overlaps and adjacency. O(n) with n = number of disjoint ranges (small:
+    parts arrive mostly in order, so n rarely exceeds 2)."""
+    out: list[tuple[int, int]] = []
+    placed = False
+    for s, e in intervals:
+        if e < start or s > end:  # strictly disjoint (not even adjacent)
+            if s > end and not placed:
+                out.append((start, end))
+                placed = True
+            out.append((s, e))
+        else:  # overlap or touch: absorb into the new interval
+            start, end = min(s, start), max(e, end)
+    if not placed:
+        out.append((start, end))
+        out.sort()
+    return out
+
+
 class WeightStaging:
     """Server-side accumulator: feed it frames in any order; tensors
     materialise once all their byte ranges have arrived.
 
     Duplicate frames are EXPECTED: the client's arequest_with_retry re-sends
     a bucket whenever a response times out even though the server may have
-    already applied it. Received coverage is therefore tracked as a set of
-    (part_offset, nbytes) ranges — a range seen twice counts once — and
-    parts of a tensor that already materialised are dropped outright."""
+    already applied it. Received coverage is therefore tracked as MERGED
+    byte intervals — duplicates and partial overlaps count each byte once.
+    (A plain sum over (offset, nbytes) pairs double-counts overlapping
+    ranges: a retry that re-splits a tensor differently could materialise a
+    tensor with holes.) Parts of a tensor that already materialised are
+    dropped outright."""
 
     def __init__(self):
         self._bufs: dict[str, bytearray] = {}
         self._meta: dict[str, dict] = {}
-        self._parts: dict[str, set[tuple[int, int]]] = {}
+        # per tensor: sorted, disjoint [start, end) intervals of received bytes
+        self._parts: dict[str, list[tuple[int, int]]] = {}
         self.ready: dict[str, np.ndarray] = {}
 
     def __len__(self) -> int:
@@ -176,11 +260,13 @@ class WeightStaging:
             if name not in self._bufs:
                 self._bufs[name] = bytearray(total)
                 self._meta[name] = spec
-                self._parts[name] = set()
+                self._parts[name] = []
             off = spec["part_offset"]
             self._bufs[name][off : off + len(raw)] = raw
-            self._parts[name].add((off, len(raw)))
-            covered = sum(n for _, n in self._parts[name])
+            self._parts[name] = _merge_interval(
+                self._parts[name], off, off + len(raw)
+            )
+            covered = sum(e - s for s, e in self._parts[name])
             if covered >= total:
                 m = self._meta[name]
                 self.ready[name] = np.frombuffer(

@@ -186,6 +186,10 @@ class JaxDecodeEngine(InferenceEngine):
         # GQA-under-tp: kv heads repeated _kv_repeat times at install
         # (_maybe_repeat_kv_heads); original config kept for HF reloads.
         self._kv_repeat = 1
+        # LoRA delta push: pristine base kernels snapshotted at the first
+        # delta commit, so repeated deltas always fold onto the ORIGINAL
+        # base (merged = base + scale*A@B), never onto a previous merge.
+        self._lora_base: dict[str, jax.Array] = {}
         self._orig_model_config: ModelConfig | None = None
         # Vision tower (VLM serving): installed via set_vision_model or
         # loaded from an HF checkpoint whose config has "vision_config".
@@ -2149,6 +2153,7 @@ class JaxDecodeEngine(InferenceEngine):
                     self.params = jax.tree.map(
                         lambda x: jnp.copy(jnp.asarray(x)), params
                     )
+                self._lora_base.clear()  # whole tree replaced
                 self._invalidate_parked()
                 if model_config is not None:
                     decode_cfg = dataclasses.replace(
@@ -2171,16 +2176,81 @@ class JaxDecodeEngine(InferenceEngine):
             if not was_paused:
                 self.continue_generation()
 
+    def _apply_lora_delta(
+        self, named: dict, scale: float
+    ) -> dict[str, jax.Array]:
+        """LoRA delta push: `lora/<sub>/<leaf>_lora_{a,b}` wire tensors →
+        merged kernels {"layers/<sub>/<leaf>": base + scale·A@B}.
+
+        The pristine base kernel is snapshotted on the FIRST delta commit
+        for each target, so every later delta folds onto the original base
+        — applying onto a previously-merged kernel would accumulate stale
+        deltas. Mirrors models/qwen2.merge_lora's einsums (stacked [L, ...]
+        scan layout, which LoRA training requires)."""
+        if self.model_config is not None and not self.model_config.scan_layers:
+            raise ValueError(
+                "lora delta push requires a scan-layers param layout"
+            )
+        groups: dict[tuple[str, str], dict[str, np.ndarray]] = {}
+        for path, arr in named.items():
+            parts = path.split("/")
+            leafname = parts[-1]
+            if len(parts) != 3 or not leafname.endswith(("_lora_a", "_lora_b")):
+                raise KeyError(
+                    f"malformed lora delta name {path!r} (expected "
+                    "lora/<sub>/<leaf>_lora_a|b)"
+                )
+            leaf, which = leafname[:-7], leafname[-1]
+            groups.setdefault((parts[1], leaf), {})[which] = np.asarray(arr)
+        out: dict[str, jax.Array] = {}
+        for (sub, leaf), ab in sorted(groups.items()):
+            if set(ab) != {"a", "b"}:
+                raise RuntimeError(
+                    f"lora delta for {sub}/{leaf} incomplete: got {sorted(ab)}"
+                )
+            base_path = f"layers/{sub}/{leaf}"
+            base = self._lora_base.get(base_path)
+            if base is None:
+                base = self.params["layers"][sub][leaf]
+                self._lora_base[base_path] = base
+            a = jnp.asarray(ab["a"], jnp.float32)
+            b = jnp.asarray(ab["b"], jnp.float32)
+            if leaf == "o_kernel":
+                delta = jnp.einsum("lir,lrh->lih", a, b).reshape(base.shape)
+            elif leaf in ("q_kernel", "k_kernel", "v_kernel"):
+                delta = jnp.einsum("lhr,lrnd->lhnd", a, b)
+                if self._kv_repeat > 1 and leaf in ("k_kernel", "v_kernel"):
+                    # wire deltas carry the trainer's (unrepeated) kv heads
+                    delta = jnp.repeat(delta, self._kv_repeat, axis=-2)
+            else:
+                delta = jnp.einsum("lir,lro->lio", a, b)
+            out[base_path] = (
+                base.astype(jnp.float32) + scale * delta
+            ).astype(base.dtype)
+        return out
+
     def update_weights_from_tensor(
-        self, named: dict, version: int | None = None, chunk_mb: int = 512
+        self,
+        named: dict,
+        version: int | None = None,
+        chunk_mb: float = 512,
+        lora_scale: float | None = None,
     ) -> None:
         """Install host tensors shipped over the wire (the "dcn" fast path;
         see areal_tpu/core/weight_transfer.py). Names are `/`-joined tree
-        paths matching this engine's own param tree. Preserves an external
-        pause, and stamps the new version inside the same pause window so no
-        token mixes new weights with the old version."""
+        paths matching this engine's own param tree; `lora/...` names are a
+        LoRA delta push (requires `lora_scale` = alpha/rank) folded onto the
+        pristine base kernels. Preserves an external pause, and stamps the
+        new version inside the same pause window so no token mixes new
+        weights with the old version."""
         from areal_tpu.core.weight_transfer import set_named
 
+        lora_named = {k: v for k, v in named.items() if k.startswith("lora/")}
+        plain = {k: v for k, v in named.items() if not k.startswith("lora/")}
+        if lora_named and lora_scale is None:
+            raise ValueError(
+                "lora delta push requires lora_scale (= lora_alpha / rank)"
+            )
         was_paused = self._gen_paused.is_set()
         self.pause_generation()
         try:
@@ -2188,16 +2258,26 @@ class JaxDecodeEngine(InferenceEngine):
                 dtype = jnp.dtype(self.config.dtype)
 
                 def cast(new, old):
-                    arr = jnp.asarray(np.asarray(new), dtype=dtype)
+                    if isinstance(new, jax.Array):
+                        arr = new.astype(dtype)  # merged delta: on device
+                    else:
+                        arr = jnp.asarray(np.asarray(new), dtype=dtype)
                     assert arr.shape == old.shape, (arr.shape, old.shape)
                     if isinstance(old, jax.Array) and hasattr(old, "sharding"):
                         arr = jax.device_put(arr, old.sharding)
                     return arr
 
                 # wire tensors carry the trainer's (unrepeated) kv heads
-                self.params = set_named(
-                    self.params, self._repeat_kv_named(named), cast=cast
-                )
+                install = self._repeat_kv_named(plain)
+                # a full-tree push overwrites kernels a delta snapshot may
+                # reference — those snapshots are stale, drop them
+                for k in install:
+                    self._lora_base.pop(k, None)
+                if lora_named:
+                    install.update(
+                        self._apply_lora_delta(lora_named, float(lora_scale))
+                    )
+                self.params = set_named(self.params, install, cast=cast)
                 self._invalidate_parked()
                 if version is not None:
                     self._version = int(version)
@@ -2228,6 +2308,7 @@ class JaxDecodeEngine(InferenceEngine):
                     )
                 else:
                     self.params = jax.tree.map(jnp.asarray, host)
+                self._lora_base.clear()  # whole tree replaced
                 self._invalidate_parked()
         finally:
             if not was_paused:

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 from typing import Any, Callable
 
@@ -158,6 +159,82 @@ def fused_lm_loss_enabled(engine) -> bool:
     loss functions — the one probe shared by the SFT engine and PPO actor."""
     cfg = getattr(engine, "config", None)
     return bool(getattr(getattr(cfg, "jax", None), "fused_lm_loss", False))
+
+
+class DcnWeightPush:
+    """Handle for an in-flight staged "dcn" weight push.
+
+    `stage_fn` (bucket streaming, generation live) runs on a daemon thread
+    started at construction; the learner keeps training meanwhile. The
+    caller picks the synchronization point: `commit()` joins the staging
+    thread and runs `commit_fn` — the only pause the decode fleet sees.
+    A staging error surfaces at join/commit; `abort()` drops server-side
+    staging for a push that will never commit. Either field may be None
+    (non-streaming ranks of a multi-host learner; legacy single-shot
+    transports where commit is a bare join)."""
+
+    def __init__(
+        self,
+        stage_fn: Callable[[], None] | None,
+        commit_fn: Callable[[], None] | None,
+        abort_fn: Callable[[], None] | None = None,
+    ):
+        self._error: BaseException | None = None
+        self._commit_fn = commit_fn
+        self._abort_fn = abort_fn
+        self._t0 = time.monotonic()
+        self.stage_secs = 0.0
+        self.commit_secs = 0.0
+        self.committed = False
+        if stage_fn is None:
+            self._thread = None
+        else:
+
+            def _run():
+                try:
+                    stage_fn()
+                except BaseException as e:  # noqa: BLE001 — raised at join
+                    self._error = e
+                finally:
+                    self.stage_secs = time.monotonic() - self._t0
+
+            self._thread = threading.Thread(
+                target=_run, daemon=True, name="dcn-weight-push"
+            )
+            self._thread.start()
+
+    def join(self, timeout: float | None = None) -> None:
+        """Wait for staging to finish; re-raise its error, if any."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("dcn weight push still staging")
+        if self._error is not None:
+            raise self._error
+
+    def commit(self) -> None:
+        """join(), then enter the pause window and commit (idempotent)."""
+        if self.committed:
+            return
+        self.join()
+        if self._commit_fn is not None:
+            t0 = time.monotonic()
+            self._commit_fn()
+            self.commit_secs = time.monotonic() - t0
+        self.committed = True
+        logger.info(
+            f"dcn weight push: staged {self.stage_secs:.2f}s (generation "
+            f"live) + commit pause {self.commit_secs:.2f}s"
+        )
+
+    def abort(self) -> None:
+        """Best-effort: drop server-side staging for this push."""
+        try:
+            self.join()
+        except BaseException:  # noqa: BLE001 — aborting a failed push is fine
+            pass
+        if self._abort_fn is not None and not self.committed:
+            self._abort_fn()
 
 
 class JaxTrainEngine(TrainEngine):
@@ -715,46 +792,129 @@ class JaxTrainEngine(TrainEngine):
                 f"disk weight update took {time.monotonic() - start:.2f}s"
             )
         elif meta.type == "dcn":
-            # In-memory network push: gather bf16 host copies of every param
-            # and stream them to the decode servers over HTTP — the DCN
-            # replacement for the reference's cross-system NCCL broadcast
-            # (fsdp_engine.py:298-401). On a multi-host learner the params are
-            # fsdp-sharded across processes, so the gather is a *collective*:
-            # every process participates in process_allgather (ICI/DCN
-            # all-gather under jit), then only process 0 streams the fully
-            # assembled tensors out over HTTP.
-            assert self.rollout_engine is not None
-            start = time.monotonic()
-            if self._push_cast_fn is None:
-                self._push_cast_fn = jax.jit(
-                    lambda t: jax.tree.map(
-                        lambda x: x.astype(jnp.bfloat16)
-                        if jnp.issubdtype(x.dtype, jnp.floating)
-                        else x,
-                        t,
-                    )
-                )
-            casted = self._push_cast_fn(self._export_params())
-            if jax.process_count() > 1:  # pragma: no cover - multi-host only
-                from jax.experimental import multihost_utils
-
-                host = multihost_utils.process_allgather(casted, tiled=True)
-            else:
-                host = jax.tree.map(jax.device_get, casted)
-            del casted
-            if jax.process_index() == 0:
-                from areal_tpu.core.weight_transfer import flatten_named
-
-                self.rollout_engine.update_weights_from_tensor(
-                    flatten_named(host),
-                    version=self.get_version(),
-                    chunk_mb=getattr(meta, "chunk_mb", 512),
-                )
-            logger.info(
-                f"dcn weight push took {time.monotonic() - start:.2f}s"
-            )
+            # In-memory network push — staged: see update_weights_async.
+            # The synchronous entry stages and commits back-to-back; the
+            # decode fleet still generates through the whole bucket
+            # transfer and only pauses for the commit/apply.
+            self.update_weights_async(meta).commit()
         else:
             raise NotImplementedError(f"weight update type {meta.type}")
+
+    def _dcn_payload(self, inflight: int):
+        """(named, lora_scale) for a dcn push.
+
+        Under LoRA (+ weight_sync_delta) only the trainable adapter
+        subtree goes on the wire (`lora/...` names; servers fold
+        base + scale·A@B at commit) — orders of magnitude fewer bytes than
+        the merged full tree. Otherwise the full (merged) tree is pushed.
+
+        On a multi-host learner params are fsdp-sharded across processes,
+        so the gather is a *collective*: every process participates in
+        process_allgather (ICI/DCN all-gather under jit) and only process 0
+        streams. Single-host, the result is a LAZY (name, array) producer:
+        device→host copies of the next `inflight` tensors run asynchronously
+        while earlier buckets are packed and POSTed (one batched transfer
+        per tensor via copy_to_host_async instead of the old per-leaf
+        serial jax.device_get tree_map)."""
+        from areal_tpu.core.weight_transfer import (
+            flatten_named,
+            iter_prefetched,
+            named_leaves,
+        )
+
+        if self._push_cast_fn is None:
+            self._push_cast_fn = jax.jit(
+                lambda t: jax.tree.map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if jnp.issubdtype(x.dtype, jnp.floating)
+                    else x,
+                    t,
+                )
+            )
+        delta = self._lora and getattr(self.config, "weight_sync_delta", True)
+        if delta:
+            casted = self._push_cast_fn({"lora": self.params["lora"]})
+            lora_scale = self.model_config.lora_alpha / max(
+                self.model_config.lora_rank, 1
+            )
+        else:
+            casted = self._push_cast_fn(self._export_params())
+            lora_scale = None
+        if jax.process_count() > 1:  # pragma: no cover - multi-host only
+            from jax.experimental import multihost_utils
+
+            host = multihost_utils.process_allgather(casted, tiled=True)
+            return flatten_named(host), lora_scale
+        return (
+            iter_prefetched(named_leaves(casted), window=max(inflight, 2)),
+            lora_scale,
+        )
+
+    def update_weights_async(
+        self, meta: WeightUpdateMeta | None = None
+    ) -> "DcnWeightPush":
+        """Start a dcn weight push WITHOUT blocking the train loop: the
+        stage phase (host gather + bucket streaming, generation live) runs
+        on a background thread, so the learner can enter its next
+        train_batch while buckets drain. Call `.commit()` on the returned
+        handle at the chosen synchronization point — it joins the staging
+        thread, then pauses the decode fleet only for the commit/apply.
+
+        Safe against donation: the on-device bf16 cast (`_push_cast_fn`)
+        runs synchronously here, producing buffers the optimizer never
+        donates — the staging thread reads those copies, not live params,
+        so the next train_batch may mutate/donate `self.params` freely.
+        On multi-host learners the allgather collective also runs
+        synchronously (every process must participate); only the HTTP
+        streaming is backgrounded, on process 0."""
+        meta = meta or self.weight_update_meta
+        assert meta is not None and meta.type == "dcn", (
+            "update_weights_async supports the staged 'dcn' transport; use "
+            "update_weights for disk/memory"
+        )
+        engine = self.rollout_engine
+        assert engine is not None, "connect_engine first"
+        inflight = getattr(
+            getattr(engine, "config", None), "weight_sync_inflight_buckets", 2
+        )
+        chunk_mb = getattr(meta, "weight_chunked_mem_mb", None) or 512
+        named, lora_scale = self._dcn_payload(inflight)
+        version = self.get_version()
+        if jax.process_index() != 0:  # pragma: no cover - multi-host only
+            return DcnWeightPush(None, None)  # collective already done
+        staged_api = hasattr(engine, "stage_weights") and hasattr(
+            engine, "commit_staged"
+        )
+        if not staged_api:
+            # legacy/stub engines: whole push on the background thread
+            if not hasattr(named, "items"):
+                from areal_tpu.core.weight_transfer import flatten_named
+
+                named = dict(named)
+            return DcnWeightPush(
+                lambda: engine.update_weights_from_tensor(
+                    named, version=version, chunk_mb=chunk_mb
+                ),
+                None,
+            )
+        push_id = engine._new_push_id() if hasattr(
+            engine, "_new_push_id"
+        ) else f"push-{version}"
+
+        def _stage():
+            engine.stage_weights(
+                named, push_id=push_id, chunk_mb=chunk_mb, inflight=inflight
+            )
+
+        def _commit():
+            engine.commit_staged(
+                push_id, version=version, lora_scale=lora_scale
+            )
+
+        def _abort():
+            engine.abort_push(push_id)
+
+        return DcnWeightPush(_stage, _commit, _abort)
 
     # -- compute --------------------------------------------------------
     def _host_mb(self, mb: dict[str, Any]) -> dict[str, np.ndarray]:

@@ -15,6 +15,12 @@ Endpoints:
                                    stop_reason="interrupt" (partial rollout)
   POST /continue_generation
   POST /update_weights_from_disk  {"path": ..., "version": optional}
+  POST /update_weights_from_tensor?push_id=ID   framed weight bucket; stages
+                                   with generation LIVE (no pause)
+  POST /commit_weights            {"version", "push_id", "lora_scale"?} —
+                                   the only pause window: install + stamp
+                                   version atomically; stale push_id -> 409
+  POST /abort_weights             {"push_id"} — drop staging for a failed push
   POST /set_version               {"version": N}
 
 Generation runs on the engine's background scheduler thread; the aiohttp
@@ -29,6 +35,7 @@ import asyncio
 import dataclasses
 import os
 import socket
+import time
 from typing import Any
 
 from aiohttp import web
@@ -82,7 +89,17 @@ class DecodeServer:
 
         self._weight_staging = WeightStaging()
         self._staging_push_id: str | None = None
+        self._staging_t0: float | None = None
         self._last_commit_version: int | None = None
+        self._last_commit_push_id: str | None = None
+        # weight-sync observability (server side); merged into /metrics
+        self._sync_stats = dict(
+            n_pushes=0,
+            wire_bytes=0,
+            staging_secs=0.0,
+            commit_pause_secs=0.0,
+            aborted_pushes=0,
+        )
 
     # -- handlers -------------------------------------------------------
     async def _health(self, request: web.Request) -> web.Response:
@@ -131,7 +148,11 @@ class DecodeServer:
             # 404, not {}: the router must fall back to its own estimates
             # rather than record a phantom zero load
             raise web.HTTPNotFound(reason="engine exports no metrics")
-        return web.json_response(get())
+        out = dict(get())
+        out["weight_sync"] = dict(
+            self._sync_stats, staged_tensors=len(self._weight_staging)
+        )
+        return web.json_response(out)
 
     async def _pause(self, request: web.Request) -> web.Response:
         try:
@@ -187,6 +208,10 @@ class DecodeServer:
         return web.json_response({"status": "ok"})
 
     # -- "dcn" in-memory weight push (areal_tpu/core/weight_transfer.py) --
+    # Buckets stage with generation LIVE (the handler never pauses the
+    # engine — the scheduler thread keeps emitting tokens while bytes
+    # accumulate); only the commit's install pays a pause, inside
+    # engine.update_weights_from_tensor.
     async def _update_weights_from_tensor(
         self, request: web.Request
     ) -> web.Response:
@@ -207,7 +232,11 @@ class DecodeServer:
                 if push_id != cur:
                     self._weight_staging.reset()
                     self._staging_push_id = push_id
+                    self._staging_t0 = time.monotonic()
+            elif self._staging_t0 is None:
+                self._staging_t0 = time.monotonic()
             self._weight_staging.add_bucket(payload)
+            self._sync_stats["wire_bytes"] += len(payload)
         return web.json_response(
             {"status": "ok", "staged": len(self._weight_staging)}
         )
@@ -215,7 +244,28 @@ class DecodeServer:
     async def _commit_weights(self, request: web.Request) -> web.Response:
         body = await request.json()
         version = body.get("version")
+        push_id = body.get("push_id")
+        lora_scale = body.get("lora_scale")
         async with self._ctl_lock:
+            # Version fence: a commit may only land for the push whose
+            # buckets are currently staged. A commit carrying a stale
+            # push_id (its staging was superseded or aborted) must be
+            # rejected — committing whatever newer push happens to be
+            # staged would mix weight versions.
+            if push_id is not None and push_id != self._staging_push_id:
+                if (
+                    push_id == self._last_commit_push_id
+                    and version is not None
+                    and self._last_commit_version == int(version)
+                ):
+                    # idempotent retry of an already-applied commit
+                    return web.json_response(
+                        {"status": "ok", "version": self.engine.get_version()}
+                    )
+                return web.json_response(
+                    {"status": "error", "message": "stale push_id"},
+                    status=409,
+                )
             if not len(self._weight_staging):
                 # Idempotent retry: a commit whose response got lost leaves
                 # empty staging + the version already stamped — succeed.
@@ -234,27 +284,74 @@ class DecodeServer:
                 staged = self._weight_staging.finalize()
 
                 def _install():
+                    kw = {}
+                    if lora_scale is not None:
+                        kw["lora_scale"] = float(lora_scale)
                     self.engine.update_weights_from_tensor(
-                        staged, version=version
+                        staged, version=version, **kw
                     )
 
+                t_commit = time.monotonic()
                 await asyncio.get_running_loop().run_in_executor(
                     None, _install
                 )
+                self._sync_stats["commit_pause_secs"] += (
+                    time.monotonic() - t_commit
+                )
             except Exception as e:
                 # A wedged staging area would poison every later push —
-                # clear it so the learner can retry from scratch.
+                # clear it so the learner can retry from scratch. Malformed
+                # pushes (bad names/shapes/missing lora_scale) are 400 so
+                # the client surfaces the real message instead of retrying
+                # a 500 into a confusing stale-push 409.
                 self._weight_staging.reset()
                 self._staging_push_id = None
+                self._staging_t0 = None
+                status = 400 if isinstance(e, (ValueError, KeyError)) else 500
                 return web.json_response(
-                    {"status": "error", "message": str(e)}, status=500
+                    {"status": "error", "message": str(e)}, status=status
                 )
+            if self._staging_t0 is not None:
+                # transfer window: first bucket arrival → commit start
+                self._sync_stats["staging_secs"] += (
+                    t_commit - self._staging_t0
+                )
+                self._staging_t0 = None
+            self._sync_stats["n_pushes"] += 1
             self._last_commit_version = (
                 int(version) if version is not None else None
             )
+            self._last_commit_push_id = push_id
+            self._staging_push_id = None
         return web.json_response(
             {"status": "ok", "version": self.engine.get_version()}
         )
+
+    async def _abort_weights(self, request: web.Request) -> web.Response:
+        """Explicitly drop staging for a failed/abandoned push. Without
+        this, a crashed client leaves multi-GiB staging resident until the
+        next push's id happens to reset it."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        push_id = body.get("push_id")
+        async with self._ctl_lock:
+            if push_id is not None and self._staging_push_id not in (
+                None,
+                push_id,
+            ):
+                # a newer push owns the staging area now — nothing to drop
+                return web.json_response({"status": "ok", "dropped": 0})
+            dropped = len(self._weight_staging._bufs) + len(
+                self._weight_staging
+            )
+            self._weight_staging.reset()
+            self._staging_push_id = None
+            self._staging_t0 = None
+            if dropped:
+                self._sync_stats["aborted_pushes"] += 1
+        return web.json_response({"status": "ok", "dropped": dropped})
 
     # -- lifecycle ------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -272,6 +369,7 @@ class DecodeServer:
             "/update_weights_from_tensor", self._update_weights_from_tensor
         )
         app.router.add_post("/commit_weights", self._commit_weights)
+        app.router.add_post("/abort_weights", self._abort_weights)
         app.router.add_post("/set_version", self._set_version)
         return app
 

@@ -274,6 +274,150 @@ def bench_decode(model, n_requests, prompt_len, new_tokens, max_running):
     )
 
 
+def bench_weightsync(model, n_pushes, chunk_mb, prompt_len, new_tokens):
+    """Staged weight-sync bench: transfer time vs commit-pause time.
+
+    Spins a real decode server (HTTP, loopback) + RemoteInfEngine client,
+    keeps a background stream of generation running, and pushes fresh
+    full-tree weights `n_pushes` times through the staged path. Reports the
+    two windows the overlapped protocol splits: staging/transfer seconds
+    (generation LIVE — tokens keep flowing) and commit-pause seconds (the
+    only window generation stops), plus wire throughput and the tokens
+    generated during the staging windows as direct overlap evidence.
+    """
+    import asyncio
+    import threading
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.core.weight_transfer import flatten_named
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.launcher.decode_server import DecodeServer
+    from areal_tpu.models.qwen2 import init_params
+
+    dcfg = JaxDecodeConfig(
+        context_length=prompt_len + new_tokens + 128,
+        max_running_requests=8,
+        # fine-grained chunks: the commit pause lands on a chunk boundary,
+        # so chunk size sets the floor of the measured pause window
+        new_tokens_per_chunk=min(8, new_tokens),
+        dtype=model.dtype,
+        kv_cache_dtype=model.dtype,
+    )
+    eng = JaxDecodeEngine(dcfg, InferenceEngineConfig())
+    params = init_params(model, jax.random.PRNGKey(0))
+    eng.set_model(params, model)
+    eng.initialize()
+
+    # serve over a private event loop in a daemon thread
+    server = DecodeServer(JaxDecodeConfig(), engine=eng)
+    loop = asyncio.new_event_loop()
+    ready = threading.Event()
+    addr_box = {}
+
+    def _serve():
+        asyncio.set_event_loop(loop)
+
+        async def _start():
+            addr_box["addr"] = await server.start(host="127.0.0.1", port=0)
+            ready.set()
+
+        loop.run_until_complete(_start())
+        loop.run_forever()
+
+    srv_thread = threading.Thread(target=_serve, daemon=True)
+    srv_thread.start()
+    assert ready.wait(60), "decode server failed to start"
+
+    client = RemoteInfEngine(
+        InferenceEngineConfig(setup_timeout=60, request_timeout=600)
+    )
+    client.initialize(addr=addr_box["addr"])
+
+    # background generation stream: proves tokens flow through staging
+    stop = threading.Event()
+    rng = np.random.RandomState(7)
+    prompts = [
+        rng.randint(1, model.vocab_size, (prompt_len,)).tolist()
+        for _ in range(64)
+    ]
+    g = GenerationHyperparameters(max_new_tokens=new_tokens, temperature=1.0)
+
+    def _gen_loop(j):
+        k = j
+        while not stop.is_set():
+            try:
+                eng.generate(
+                    ModelRequest(input_ids=prompts[k % len(prompts)], gconfig=g),
+                    timeout=600,
+                )
+            except Exception:  # noqa: BLE001 — engine shutting down
+                return
+            k += 4
+        return
+
+    gen_threads = [
+        threading.Thread(target=_gen_loop, args=(j,), daemon=True)
+        for j in range(4)
+    ]
+    for t in gen_threads:
+        t.start()
+
+    # let generation reach steady state first: the commit pause waits for
+    # the in-flight chunk, so measuring against a cold engine would charge
+    # first-compile time to the pause window
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if eng.get_metrics()["generated_tokens_total"] > 4 * new_tokens:
+            break
+        time.sleep(0.1)
+
+    named = flatten_named(params)
+    wire_bytes = sum(a.nbytes for a in named.values())
+    # untimed warm push (compiles nothing, but primes HTTP pools + staging)
+    client.update_weights_from_tensor(named, version=1, chunk_mb=chunk_mb)
+    base = client.get_metrics()
+    tokens_during_staging = 0
+    for i in range(n_pushes):
+        tok0 = eng.get_metrics()["generated_tokens_total"]
+        push_id = client.stage_weights(named, chunk_mb=chunk_mb)
+        tok1 = eng.get_metrics()["generated_tokens_total"]
+        client.commit_staged(push_id, version=i + 2)
+        tokens_during_staging += tok1 - tok0
+    m = client.get_metrics()
+    stop.set()
+    for t in gen_threads:
+        t.join(timeout=30)
+    client.destroy()
+
+    async def _stop():
+        await server.stop()
+        loop.stop()
+
+    asyncio.run_coroutine_threadsafe(_stop(), loop)
+    srv_thread.join(timeout=30)
+    eng.destroy()
+
+    transfer_s = (m["staging_secs"] - base["staging_secs"]) / n_pushes
+    commit_s = (m["commit_pause_secs"] - base["commit_pause_secs"]) / n_pushes
+    return dict(
+        weightsync_transfer_s=transfer_s,
+        weightsync_commit_pause_s=commit_s,
+        weightsync_pause_share=commit_s / max(transfer_s + commit_s, 1e-9),
+        weightsync_wire_mb=wire_bytes / 1024 / 1024,
+        weightsync_mb_per_s=wire_bytes / 1024 / 1024 / max(transfer_s, 1e-9),
+        weightsync_tokens_during_staging=float(tokens_during_staging)
+        / n_pushes,
+    )
+
+
 def bench_pp_schedules(model, pp, n_mbs, seq_len, warmup, iters):
     """Pipeline-schedule micro-bench: the SAME stacked micro-batch stream
     through the pp>1 trunk under "gpipe" vs "1f1b", reporting per-step wall
@@ -958,6 +1102,18 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("weightsync"):
+            decode.update(
+                _retry_transport(
+                    lambda: bench_weightsync(
+                        model, n_pushes=3, chunk_mb=64, prompt_len=128,
+                        new_tokens=128,
+                    ),
+                    what="bench_weightsync",
+                    attempts=2,
+                    base_delay=15.0,
+                )
+            )
         if want("grpo"):
             # GRPO co-locates trainer (fwd+bwd+opt) and decode engine on
             # one chip: run the actor with remat on to leave HBM headroom
@@ -1052,6 +1208,13 @@ def main() -> None:
                     model, pp=2, n_mbs=8, seq_len=128, warmup=1, iters=2
                 )
             )
+        if want("weightsync"):
+            decode.update(
+                bench_weightsync(
+                    model, n_pushes=2, chunk_mb=0.01, prompt_len=16,
+                    new_tokens=32,
+                )
+            )
         if want("grpo"):
             decode.update(
                 bench_grpo(
@@ -1079,6 +1242,7 @@ def main() -> None:
             "prefix": ("prefix_share_speedup", "x"),
             "grpo": ("grpo_samples_per_sec_per_chip", "samples/s/chip"),
             "ppsched": ("pp_temp_ratio_gpipe_over_1f1b", "x"),
+            "weightsync": ("weightsync_commit_pause_s", "s"),
         }[mode]
         print(
             json.dumps(
@@ -1105,7 +1269,10 @@ if __name__ == "__main__":
         p.add_argument(
             "--mode",
             default=os.environ.get("AREAL_BENCH_MODE", "all"),
-            choices=["all", "train", "decode", "prefix", "grpo", "ppsched"],
+            choices=[
+                "all", "train", "decode", "prefix", "grpo", "ppsched",
+                "weightsync",
+            ],
             help="which measurements to run (default: all)",
         )
         args = p.parse_args()

@@ -1,0 +1,475 @@
+"""Overlapped weight sync: stage while generating, pause only for commit.
+
+The tentpole invariants of the staged "dcn" push:
+- bucket staging NEVER pauses generation — tokens keep flowing until the
+  commit, whose pause window covers only the install/apply;
+- commits are version-fenced: a stale push_id is rejected (409), so no
+  token can mix weight versions;
+- a failed/aborted push drops server-side staging (explicit /abort_weights)
+  instead of leaking multi-GiB buffers;
+- weight-sync observability on both ends (n_pushes, wire bytes, staging
+  seconds vs commit-pause seconds), with commit-pause « transfer time;
+- LoRA delta pushes ship only the trainable adapter subtree and fold
+  base + scale*A@B onto the PRISTINE base kernels at commit.
+"""
+
+import asyncio
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from areal_tpu.api.cli_args import InferenceEngineConfig, JaxDecodeConfig
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.core.weight_transfer import flatten_named
+from areal_tpu.engine.jax_decode import JaxDecodeEngine
+from areal_tpu.models.qwen2 import init_lora_params, init_params, merge_lora
+from areal_tpu.utils.http import HttpRequestError
+from tests.test_remote_inf_engine import TINY, _ServerThread, _greedy_req
+
+
+@pytest.fixture(scope="module")
+def served(cpu_devices):
+    cfg = JaxDecodeConfig(
+        context_length=160,
+        max_running_requests=4,
+        new_tokens_per_chunk=2,  # many small dispatches -> long decode window
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    st = _ServerThread(eng)
+    client = RemoteInfEngine(
+        InferenceEngineConfig(setup_timeout=30, request_timeout=60)
+    )
+    client.initialize(addr=st.addr)
+    yield eng, st, client
+    client.destroy()
+    st.stop()
+    eng.destroy()
+
+
+def _fresh_named(seed: int):
+    return flatten_named(init_params(TINY, jax.random.PRNGKey(seed)))
+
+
+def test_staging_keeps_tokens_flowing_until_commit(served):
+    """Generation must run uninterrupted through the whole bucket transfer;
+    the only pause is the commit, and version stamps stay consistent."""
+    eng, _, client = served
+    old_version = eng.get_version()
+    pauses = []
+    orig_pause = eng.pause_generation
+
+    def counting_pause(*a, **kw):
+        pauses.append(time.monotonic())
+        return orig_pause(*a, **kw)
+
+    eng.pause_generation = counting_pause
+    try:
+        result = {}
+
+        def _bg():
+            result["resp"] = asyncio.run(
+                client.agenerate(_greedy_req([5, 3, 1], 64))
+            )
+
+        t = threading.Thread(target=_bg)
+        t.start()
+        # wait until the request is actually decoding
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if any(s is not None for s in eng._slots):
+                break
+            time.sleep(0.005)
+        tok0 = eng._gen_token_count
+        n_pauses_before = len(pauses)
+        # tiny buckets -> dozens of staged frames, generation live throughout
+        push_id = client.stage_weights(_fresh_named(3), chunk_mb=0.02)
+        assert len(pauses) == n_pauses_before, (
+            "bucket staging paused generation"
+        )
+        # fully staged but uncommitted: tokens must KEEP flowing
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not result.get("resp"):
+            if eng._gen_token_count > tok0:
+                break
+            time.sleep(0.005)
+        assert result.get("resp") or eng._gen_token_count > tok0, (
+            "no tokens generated while a fully-staged push awaited commit"
+        )
+        t.join(timeout=60)
+        assert not t.is_alive()
+        # everything generated pre-commit carries the OLD version
+        assert result["resp"].output_versions == [old_version] * 64
+        client.commit_staged(push_id, version=old_version + 7)
+        assert len(pauses) > n_pauses_before  # commit is the pause window
+        assert eng.get_version() == old_version + 7
+        after = asyncio.run(client.agenerate(_greedy_req([5, 3, 1], 4)))
+        assert after.output_versions == [old_version + 7] * 4
+    finally:
+        eng.pause_generation = orig_pause
+
+
+def test_commit_version_fencing_rejects_stale_push(served):
+    eng, _, client = served
+    v = eng.get_version()
+    push_a = client.stage_weights(_fresh_named(4), chunk_mb=0.05)
+    # a newer push supersedes A's staging server-side
+    push_b = client.stage_weights(_fresh_named(5), chunk_mb=0.05)
+    with pytest.raises(HttpRequestError) as ei:
+        client.commit_staged(push_a, version=v + 1)
+    assert ei.value.status == 409
+    assert eng.get_version() == v  # stale commit must not move the version
+    client.commit_staged(push_b, version=v + 1)
+    assert eng.get_version() == v + 1
+    np.testing.assert_allclose(
+        np.asarray(eng.params["final_norm"]),
+        _fresh_named(5)["final_norm"],
+        atol=1e-6,
+    )
+
+
+def test_abort_weights_drops_staging(served):
+    eng, st, client = served
+    push_id = client.stage_weights(_fresh_named(6), chunk_mb=0.05)
+    assert len(st.server._weight_staging) > 0
+    client.abort_push(push_id)
+    assert len(st.server._weight_staging) == 0
+    assert not st.server._weight_staging._bufs
+    with pytest.raises(HttpRequestError):
+        client.commit_staged(push_id, version=99)
+
+
+def test_failed_push_auto_aborts_server_staging(served):
+    """A client crash mid-stream must POST /abort_weights so the server
+    does not sit on partial staging until the next push."""
+    eng, st, client = served
+
+    def _explodes():
+        yield "p0", np.ones((4096,), np.float32)  # flushes several buckets
+        yield "p1", np.ones((4096,), np.float32)
+        raise RuntimeError("producer died mid-push")
+
+    aborts_before = client.get_metrics()["aborts"]
+    with pytest.raises(RuntimeError, match="producer died"):
+        client.stage_weights(_explodes(), chunk_mb=0.005)
+    assert client.get_metrics()["aborts"] == aborts_before + 1
+    # server-side staging fully released (no leaked buffers/tensors)
+    assert len(st.server._weight_staging) == 0
+    assert not st.server._weight_staging._bufs
+
+
+def test_sync_metrics_commit_pause_much_less_than_transfer(served):
+    eng, st, client = served
+    before = client.get_metrics()
+    v = eng.get_version()
+    # ~hundreds of tiny buckets: the transfer window dwarfs the apply
+    client.update_weights_from_tensor(
+        _fresh_named(7), version=v + 1, chunk_mb=0.005
+    )
+    m = client.get_metrics()
+    assert m["n_pushes"] == before["n_pushes"] + 1
+    assert m["last_push_bytes"] > 0
+    assert m["wire_bytes"] > before["wire_bytes"]
+    staging = m["staging_secs"] - before["staging_secs"]
+    commit = m["commit_pause_secs"] - before["commit_pause_secs"]
+    assert staging > 0 and commit > 0
+    # the headline claim: the observed pause is the apply, not the transfer
+    assert commit < staging, (commit, staging)
+    # server-side mirror via /metrics
+    from areal_tpu.utils.http import aget_with_retry
+
+    srv = asyncio.run(aget_with_retry(st.addr, "/metrics"))
+    ws = srv["weight_sync"]
+    assert ws["n_pushes"] >= 1
+    assert ws["wire_bytes"] > 0
+    assert ws["commit_pause_secs"] < ws["staging_secs"]
+    assert ws["staged_tensors"] == 0  # nothing left behind
+
+
+def test_legacy_non_overlap_mode_still_works(served):
+    eng, _, client = served
+    v = eng.get_version()
+    client.update_weights_from_tensor(
+        _fresh_named(8), version=v + 1, chunk_mb=1, overlap=False
+    )
+    assert eng.get_version() == v + 1
+    np.testing.assert_allclose(
+        np.asarray(eng.params["final_norm"]),
+        _fresh_named(8)["final_norm"],
+        atol=1e-6,
+    )
+
+
+# -- LoRA delta push ----------------------------------------------------
+
+LORA_CFG = dataclasses.replace(
+    TINY, lora_rank=4, lora_alpha=8.0, lora_targets=("q_proj", "v_proj")
+)
+
+
+@pytest.fixture()
+def lora_served(cpu_devices):
+    cfg = JaxDecodeConfig(
+        context_length=96,
+        max_running_requests=4,
+        new_tokens_per_chunk=4,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    base = init_params(TINY, jax.random.PRNGKey(0))
+    eng.set_model(base, TINY)
+    eng.initialize()
+    st = _ServerThread(eng)
+    client = RemoteInfEngine(
+        InferenceEngineConfig(setup_timeout=30, request_timeout=60)
+    )
+    client.initialize(addr=st.addr)
+    yield eng, base, client
+    client.destroy()
+    st.stop()
+    eng.destroy()
+
+
+def _rand_lora(seed: int):
+    lora = init_lora_params(LORA_CFG, jax.random.PRNGKey(seed))
+    # B initialises to zero (delta = 0); perturb so the delta is nonzero
+    leaves, td = jax.tree.flatten(lora)
+    rng = np.random.RandomState(seed)
+    leaves = [
+        np.asarray(l) + rng.randn(*np.shape(l)).astype(np.float32) * 0.05
+        for l in leaves
+    ]
+    return jax.tree.unflatten(td, leaves)
+
+
+def test_lora_delta_push_wire_bytes_and_numerics(lora_served):
+    eng, base, client = lora_served
+    scale = LORA_CFG.lora_alpha / LORA_CFG.lora_rank
+    full_bytes = sum(a.nbytes for a in flatten_named(base).values())
+
+    lora = _rand_lora(11)
+    client.update_weights_from_tensor(
+        flatten_named({"lora": lora}), version=3, lora_scale=scale
+    )
+    m = client.get_metrics()
+    lora_bytes = sum(
+        np.asarray(l).nbytes for l in jax.tree.leaves(lora)
+    )
+    # only trainable-subtree bytes went over the wire (+ manifest framing)
+    assert m["last_push_bytes"] < full_bytes / 4
+    assert m["last_push_bytes"] < lora_bytes * 2
+    expected = merge_lora({**base, "lora": lora}, LORA_CFG)
+    for sub, leaf in (("attn", "q_kernel"), ("attn", "v_kernel")):
+        np.testing.assert_allclose(
+            np.asarray(eng.params["layers"][sub][leaf]),
+            np.asarray(expected["layers"][sub][leaf]),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+    # untouched leaves stay bit-identical
+    np.testing.assert_array_equal(
+        np.asarray(eng.params["final_norm"]), np.asarray(base["final_norm"])
+    )
+    assert eng.get_version() == 3
+
+    # second delta folds onto the PRISTINE base, not the previous merge
+    lora2 = _rand_lora(12)
+    client.update_weights_from_tensor(
+        flatten_named({"lora": lora2}), version=4, lora_scale=scale
+    )
+    expected2 = merge_lora({**base, "lora": lora2}, LORA_CFG)
+    np.testing.assert_allclose(
+        np.asarray(eng.params["layers"]["attn"]["q_kernel"]),
+        np.asarray(expected2["layers"]["attn"]["q_kernel"]),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_lora_delta_requires_scale(lora_served):
+    eng, base, client = lora_served
+    with pytest.raises(HttpRequestError, match="lora_scale"):
+        client.update_weights_from_tensor(
+            flatten_named({"lora": _rand_lora(13)}), version=5
+        )
+
+
+# -- trainer-side: update_weights_async + delta push ---------------------
+
+
+def _train_engine(use_lora: bool):
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.api.cli_args import (
+        MicroBatchSpec,
+        OptimizerConfig,
+        TrainEngineConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec
+    from areal_tpu.engine.sft.lm_engine import JaxLMEngine
+    from areal_tpu.models.qwen2 import ModelConfig
+
+    cfg = TrainEngineConfig(
+        experiment_name="ws",
+        trial_name="t",
+        path="",
+        init_from_scratch=True,
+        dtype="float32",
+        mb_spec=MicroBatchSpec(max_tokens_per_mb=64),
+        optimizer=OptimizerConfig(
+            lr=5e-2,
+            warmup_steps_proportion=0.0,
+            lr_scheduler_type="constant",
+            gradient_clipping=1.0,
+        ),
+        gradient_checkpointing=False,
+        use_lora=use_lora,
+        lora_rank=4,
+        lora_alpha=8,
+        target_modules=["q_proj", "v_proj"],
+    )
+    eng = JaxLMEngine(cfg)
+    eng.model_config = ModelConfig(
+        vocab_size=64,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        dtype="float32",
+        param_dtype="float32",
+        lora_rank=4 if use_lora else 0,
+        lora_alpha=8.0,
+        lora_targets=("q_proj", "v_proj"),
+    )
+    eng.create_process_group(
+        ParallelStrategy(data_parallel_size=2, tensor_parallel_size=2,
+                         context_parallel_size=2)
+    )
+    eng.initialize(None, FinetuneSpec(1, 100, 4))
+    return eng
+
+
+def _train_batch(vocab=64, seed=0):
+    from areal_tpu.utils.data import pad_sequences_to_tensors
+
+    rng = np.random.RandomState(seed)
+    seqs = []
+    for L in (11, 9, 13, 7):
+        ids = rng.randint(1, vocab, (L,))
+        mask = np.zeros(L, dtype=np.int32)
+        mask[1:] = 1
+        seqs.append(dict(input_ids=ids, loss_mask=mask))
+    return pad_sequences_to_tensors(seqs)
+
+
+def _serve_for_trainer(base_params):
+    cfg = JaxDecodeConfig(
+        context_length=96,
+        max_running_requests=4,
+        new_tokens_per_chunk=4,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(base_params, TINY)
+    eng.initialize()
+    st = _ServerThread(eng)
+    client = RemoteInfEngine(
+        InferenceEngineConfig(setup_timeout=30, request_timeout=60)
+    )
+    client.initialize(addr=st.addr)
+    return eng, st, client
+
+
+def test_trainer_update_weights_async_overlaps_training(cpu_devices):
+    from areal_tpu.api.io_struct import WeightUpdateMeta
+
+    trainer = _train_engine(use_lora=False)
+    dec, st, client = _serve_for_trainer(
+        init_params(TINY, jax.random.PRNGKey(0))
+    )
+    try:
+        trainer.connect_engine(client, WeightUpdateMeta(type="dcn"))
+        trainer.set_version(5)
+        handle = trainer.update_weights_async()
+        # the learner trains its next batch while buckets drain
+        stats = trainer.train_lm(_train_batch())
+        assert np.isfinite(stats["loss"])
+        handle.commit()
+        assert handle.committed
+        handle.commit()  # idempotent
+        assert dec.get_version() == 5
+        assert client.get_metrics()["n_pushes"] == 1
+        # the pushed snapshot predates the concurrent train step (bf16 wire)
+        np.testing.assert_allclose(
+            np.asarray(dec.params["final_norm"], np.float32),
+            np.asarray(
+                jax.numpy.asarray(trainer.params["final_norm"]).astype(
+                    jax.numpy.bfloat16
+                ),
+                np.float32,
+            ),
+            rtol=2e-2,
+            atol=2e-2,
+        )
+    finally:
+        client.destroy()
+        st.stop()
+        dec.destroy()
+        trainer.destroy()
+
+
+def test_trainer_lora_push_is_delta_only(cpu_devices):
+    """With LoRA active the dcn push ships ONLY the adapter subtree —
+    asserted on wire-byte metrics — and the server folds the delta."""
+    from areal_tpu.api.io_struct import WeightUpdateMeta
+
+    trainer = _train_engine(use_lora=True)
+    base_host = jax.tree.map(
+        lambda x: np.asarray(x),
+        {k: v for k, v in trainer.params.items() if k != "lora"},
+    )
+    dec, st, client = _serve_for_trainer(base_host)
+    try:
+        trainer.connect_engine(client, WeightUpdateMeta(type="dcn"))
+        # make adapters nonzero so the delta actually changes kernels
+        for _ in range(2):
+            trainer.train_lm(_train_batch())
+        q_before = np.asarray(dec.params["layers"]["attn"]["q_kernel"]).copy()
+        trainer.set_version(2)
+        trainer.update_weights(WeightUpdateMeta(type="dcn"))
+        m = client.get_metrics()
+        full_bytes = sum(a.nbytes for a in flatten_named(base_host).values())
+        lora_bytes = sum(
+            np.asarray(l).nbytes
+            for l in jax.tree.leaves(trainer.params["lora"])
+        )
+        assert m["last_push_bytes"] < full_bytes / 4
+        assert m["last_push_bytes"] < lora_bytes * 2  # bf16 wire halves it
+        assert dec.get_version() == 2
+        # targeted kernels moved, untouched leaves stayed bit-identical
+        assert (
+            np.abs(
+                np.asarray(dec.params["layers"]["attn"]["q_kernel"])
+                - q_before
+            ).max()
+            > 0
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dec.params["final_norm"]),
+            np.asarray(base_host["final_norm"]),
+        )
+    finally:
+        client.destroy()
+        st.stop()
+        dec.destroy()
+        trainer.destroy()

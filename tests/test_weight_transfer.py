@@ -174,6 +174,70 @@ def test_staging_ignores_duplicate_frames():
     np.testing.assert_array_equal(out["small"], named["small"])
 
 
+def test_staging_overlapping_resplit_never_materializes_holes():
+    """Coverage is tracked as MERGED intervals: a retry that re-splits a
+    tensor differently must not let the SUM of part lengths reach total
+    while the union still has a hole (the old sum-accounting materialized
+    tensors with zero-filled gaps)."""
+    import json
+    import struct
+
+    from areal_tpu.core.weight_transfer import WeightStaging
+
+    rng = np.random.RandomState(5)
+    data = rng.randint(0, 255, 100, dtype=np.uint8).tobytes()
+
+    def frame(parts):
+        """Build one wire frame holding byte ranges [(off, n), ...]."""
+        manifest, chunks, size = [], [], 0
+        for off, n in parts:
+            manifest.append(
+                dict(
+                    name="w", shape=[100], dtype="uint8", offset=size,
+                    nbytes=n, part_offset=off, total_nbytes=100,
+                )
+            )
+            chunks.append(data[off : off + n])
+            size += n
+        mjson = json.dumps(manifest).encode()
+        return struct.pack("<Q", len(mjson)) + mjson + b"".join(chunks)
+
+    st = WeightStaging()
+    # split A delivers [0, 60); split B (a re-chunked retry) delivers
+    # [0, 40) — summed lengths 100 >= total, union only covers [0, 60)
+    st.add_bucket(frame([(0, 60)]))
+    st.add_bucket(frame([(0, 40)]))
+    assert "w" not in st.ready, "tensor materialized with a 40-byte hole"
+    with pytest.raises(RuntimeError, match="incomplete"):
+        st.finalize()
+    # the missing range arrives -> correct bytes
+    st.add_bucket(frame([(40, 60)]))
+    out = st.finalize()
+    np.testing.assert_array_equal(out["w"], np.frombuffer(data, np.uint8))
+
+
+def test_pack_buckets_accepts_iterables_and_noncontiguous():
+    """pack_buckets takes lazy (name, array) producers (the pipelined push
+    path) and handles non-contiguous views through its zero-copy slicing."""
+    rng = np.random.RandomState(6)
+    base = rng.randn(64, 48).astype(np.float32)
+    named = {"t": base.T, "s": base[::2, 1:5]}  # non-contiguous views
+
+    def produce():
+        for k, v in named.items():
+            yield k, v
+
+    from areal_tpu.core.weight_transfer import WeightStaging
+
+    st = WeightStaging()
+    for b in pack_buckets(produce(), chunk_mb=0.005):
+        st.add_bucket(b)
+    merged = st.finalize()
+    assert set(merged) == set(named)
+    for k in named:
+        np.testing.assert_array_equal(merged[k], np.asarray(named[k]))
+
+
 def test_staging_reset_clears_partial_state():
     from areal_tpu.core.weight_transfer import WeightStaging
 
