@@ -298,6 +298,15 @@ class JaxDecodeConfig:
     # tokens generated per decode-loop dispatch; interrupts land on chunk
     # boundaries (parity: partial rollout `new_tokens_per_chunk`)
     new_tokens_per_chunk: int = 128
+    # Run-ahead decode scheduling: how many chunks the scheduler may keep
+    # dispatched on the device while the host consumes the previous
+    # chunk's results (stop-string scan, retire, admission, prefill
+    # planning all overlap the in-flight chunk; per-slot sampling keys
+    # keep the output bit-identical to the synchronous schedule). 0
+    # restores the legacy dispatch-then-block loop. A slot the host
+    # retires mid-run-ahead has its speculative tokens discarded and its
+    # KV length rewound at the next dispatch.
+    decode_runahead_chunks: int = 1
     enable_prefix_caching: bool = True
     disable_radix_cache: bool = False
     schedule_policy: str = "fcfs"

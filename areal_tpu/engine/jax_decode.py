@@ -25,6 +25,18 @@ TPU-first design:
   428-478). Unlike the reference's abort+regenerate dance over HTTP, the
   in-process engine just continues with new weights — same data semantics,
   no KV re-computation.
+- **Run-ahead scheduling** (`decode_runahead_chunks`, default 1): chunk
+  k+1 is dispatched against device-chained state before the host consumes
+  chunk k, so the per-chunk host work (non-blocking token fetch, stop
+  scan, retire, admission, prefill planning) overlaps the in-flight
+  device chunk instead of idling the accelerator. Per-slot sampling
+  state lives in persistent device buffers mutated only at admit/retire
+  boundaries; per-slot `fold_in(base_key, length)` sampling keys make the
+  emitted tokens/logprobs bit-identical to the synchronous path (0). A
+  slot retired while its run-ahead chunk is in flight reconciles at
+  arrival: the speculative tokens are discarded and the device lengths
+  rewound. pause_generation drains every dispatched chunk, fencing weight
+  commits and abort_all.
 - **Sampling on device**: temperature / top-p / greedy per slot inside the
   jit; logprob of the chosen token returned per step.
 
@@ -42,6 +54,7 @@ import queue
 import threading
 import time
 import traceback
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -111,6 +124,31 @@ class _Slot:
     stop_reason: str | None = None
 
 
+@dataclass
+class _Inflight:
+    """One dispatched-but-unconsumed decode chunk.
+
+    `items` snapshots the _Slot object occupying each slot at dispatch
+    time: at consume time a slot whose occupant changed (retired, maybe
+    re-admitted) has its run-ahead tokens discarded — the identity check
+    is the reconcile step that keeps run-ahead output equal to the
+    synchronous schedule's.
+    """
+
+    toks: Any  # jax [n_chunk, R]
+    logps: Any  # jax [n_chunk, R]
+    items: list  # list[_Slot | None], snapshot at dispatch
+    active: np.ndarray  # [R] bool, the mask the chunk ran with
+    # admission epoch per slot at dispatch: an object-identity check alone
+    # would mis-attribute tokens when a preempted item re-admits into the
+    # SAME slot while an older chunk of its previous occupancy is still
+    # unconsumed (possible at runahead depth >= 2)
+    epochs: np.ndarray
+    version: int  # weight version the chunk was produced under
+    t_dispatch: float
+    n_chunk: int
+
+
 class JaxDecodeEngine(InferenceEngine):
     def __init__(
         self,
@@ -175,8 +213,48 @@ class JaxDecodeEngine(InferenceEngine):
         self._n_suffix_prefills = 0  # partial-prefix hits (multi-turn)
         self._n_preemptions = 0  # pool-pressure internal requeues
         self._alloc: KVBlockAllocator | None = None  # set in initialize
-        self._gen_token_count = 0  # total tokens generated since init
+        self._gen_token_count = 0  # total consumed tokens since init
         self._rng = None
+        # -- run-ahead scheduler state ---------------------------------
+        # Dispatched-but-unconsumed chunks, oldest first. The scheduler
+        # keeps up to `decode_runahead_chunks` of these in flight on the
+        # device while it does the host work (stop scan, retire,
+        # admission) for the chunk before them.
+        self._inflight: deque = deque()
+        # Per-slot sampling base keys (np uint32 [R, 2]), assigned once at
+        # admission. The chunk kernel derives each step's sample key as
+        # fold_in(base_key, slot_length), so a slot's token stream depends
+        # only on (admission order, token index) — never on how tokens
+        # were grouped into chunks. That is what makes run-ahead output
+        # bit-identical to the synchronous path.
+        self._slot_keys = None
+        # admission epoch per slot (see _Inflight.epochs)
+        self._slot_epoch = None
+        # Device-resident control arrays (active/temps/top_ps/greedy/
+        # rope_delta/freq_pens/base_keys): uploaded only when a slot was
+        # admitted/retired since the last dispatch, instead of six
+        # jnp.asarray uploads per chunk.
+        self._ctl_cache: dict | None = None
+        self._ctl_dirty = True
+        # cached device copy of the effective (saturation-refined) active
+        # mask + its host mirror for change detection
+        self._dev_active = None
+        self._dev_active_host = None
+        # Device-chained per-slot state (last sampled token, slot length):
+        # outputs of chunk k feed chunk k+1 directly. Slots whose host
+        # truth diverged (retire rewind, fresh admission) are listed in
+        # _patch_slots and overridden via _get_patch_fn at next dispatch.
+        self._dev_last = None
+        self._dev_lengths = None
+        self._patch_slots: set[int] = set()
+        self._patch_fn: Callable | None = None
+        # decode-loop timing: device-busy vs device-idle (host gap) split
+        self._dev_busy_s = 0.0
+        self._dev_idle_s = 0.0
+        self._last_ready_t: float | None = None
+        self._chunk_itl_ms: deque = deque(maxlen=512)
+        self._chunks_dispatched = 0
+        self._runahead_discarded = 0  # run-ahead tokens dropped at reconcile
         self._chunk_fns: dict[bool, Callable] = {}
         self._prefill_fns: dict[int, Callable] = {}
         self._batched_prefill_fns: dict[tuple[int, int], Callable] = {}
@@ -291,6 +369,22 @@ class JaxDecodeEngine(InferenceEngine):
         self._prefix_lookup = {}
         self._slot_prefix = [None] * R
         self._rng = jax.random.PRNGKey(self.config.random_seed)
+        self._slot_keys = np.zeros((R, 2), dtype=np.uint32)
+        self._slot_epoch = np.zeros(R, dtype=np.int64)
+        self._inflight = deque()
+        self._ctl_cache = None
+        self._ctl_dirty = True
+        self._dev_active = None
+        self._dev_active_host = None
+        self._dev_last = None
+        self._dev_lengths = None
+        self._patch_slots = set()
+        self._dev_busy_s = 0.0
+        self._dev_idle_s = 0.0
+        self._last_ready_t = None
+        self._chunk_itl_ms = deque(maxlen=512)
+        self._chunks_dispatched = 0
+        self._runahead_discarded = 0
 
         from areal_tpu.core.workflow_executor import WorkflowExecutor
 
@@ -319,6 +413,13 @@ class JaxDecodeEngine(InferenceEngine):
         # vision tower + compiled-fn caches hold device buffers too
         self._vision_params = None
         self._freq_counts = None
+        self._inflight.clear()
+        self._ctl_cache = None
+        self._dev_active = None
+        self._dev_active_host = None
+        self._dev_last = None
+        self._dev_lengths = None
+        self._patch_fn = None
         self._vision_fns.clear()
         self._embed_prefill_fns.clear()
         self._chunk_fns.clear()
@@ -716,6 +817,14 @@ class JaxDecodeEngine(InferenceEngine):
         `use_freq`: frequency penalty (OpenAI semantics — logits minus
         penalty * per-token generation counts); the [R, V] count buffer
         only exists for batches where some slot requested it.
+
+        PRNG: each slot carries a base key assigned at admission
+        (`_slot_keys`); the step key is `fold_in(base_key, slot_length)`,
+        a pure function of the slot's logical token position. Sampled
+        streams are therefore invariant to chunk boundaries, to which
+        other slots share the batch, and to run-ahead scheduling — the
+        property the run-ahead reconcile relies on for bit-identical
+        output (`decode_runahead_chunks` 0 vs 1).
         """
         key_ = (use_topp, use_freq, nb)
         if key_ in self._chunk_fns:
@@ -723,12 +832,12 @@ class JaxDecodeEngine(InferenceEngine):
         cfg = self.model_config
         n_chunk = self.config.new_tokens_per_chunk
 
-        def sample(logits, key, temps, top_ps, greedy):
+        def sample(logits, subkeys, temps, top_ps, greedy):
             logits = logits.astype(jnp.float32)
             logprobs_all = jax.nn.log_softmax(logits, axis=-1)
             greedy_tok = jnp.argmax(logits, axis=-1)
             scaled = logits / jnp.maximum(temps[:, None], 1e-6)
-            key, sub = jax.random.split(key)
+            cat = jax.vmap(jax.random.categorical)  # per-slot keys
             if use_topp:
                 # Per-slot exactness: co-scheduled top_p == 1 slots keep the
                 # FULL distribution (plain categorical); only slots that
@@ -739,23 +848,31 @@ class JaxDecodeEngine(InferenceEngine):
                 cum = jnp.cumsum(probs, axis=-1)
                 keep = cum - probs < top_ps[:, None]
                 vals = jnp.where(keep, vals, -1e30)
-                key, sub2 = jax.random.split(key)
-                s = jax.random.categorical(sub, vals, axis=-1)
+                # top_p == 1 slots sample with the PRIMARY subkey — the same
+                # key the use_topp=False variant uses — so a slot's stream
+                # does not depend on which chunk variant its batchmates
+                # forced (bit-identity across schedules); the truncated
+                # branch derives a secondary key instead
+                sub2 = jax.vmap(jax.random.fold_in)(
+                    subkeys, jnp.ones(subkeys.shape[0], jnp.int32)
+                )
+                s = cat(sub2, vals)
                 sampled_topp = jnp.take_along_axis(idx, s[:, None], axis=-1)[:, 0]
-                sampled_full = jax.random.categorical(sub2, scaled, axis=-1)
+                sampled_full = cat(subkeys, scaled)
                 sampled = jnp.where(top_ps < 1.0, sampled_topp, sampled_full)
             else:
-                sampled = jax.random.categorical(sub, scaled, axis=-1)
+                sampled = cat(subkeys, scaled)
             tok = jnp.where(greedy, greedy_tok, sampled)
             logp = jnp.take_along_axis(logprobs_all, tok[:, None], axis=-1)[:, 0]
-            return tok, logp, key
+            return tok, logp
 
         # ONE step body for both variants: use_freq is python-static, so the
         # counts carry and the penalty lines only trace when requested —
         # shared decode logic cannot diverge between the two compiled fns.
         def make_chunk(freq: bool):
-            def chunk(params, kp, vp, bt, last_tokens, lengths, active, key,
-                      temps, top_ps, greedy, rope_delta, *freq_args):
+            def chunk(params, kp, vp, bt, last_tokens, lengths, active,
+                      base_keys, temps, top_ps, greedy, rope_delta,
+                      *freq_args):
                 freq_pens, counts0 = freq_args if freq else (None, None)
                 # gather each slot's blocks into a contiguous workspace
                 L, _, bsz, nkv, hd = kp.shape
@@ -769,27 +886,28 @@ class JaxDecodeEngine(InferenceEngine):
                 )
 
                 def step(carry, _):
-                    tokens, lengths, kc, vc, key, counts = carry
+                    tokens, lengths, kc, vc, counts = carry
                     logits, kc, vc = decode_step(
                         params, tokens, lengths, kc, vc, cfg, active=active,
                         rope_offset=rope_delta,
                     )
                     if freq:
                         logits = logits - freq_pens[:, None] * counts
-                    tok, logp, key = sample(logits, key, temps, top_ps, greedy)
+                    subkeys = jax.vmap(jax.random.fold_in)(base_keys, lengths)
+                    tok, logp = sample(logits, subkeys, temps, top_ps, greedy)
                     tok = jnp.where(active, tok, tokens)
                     if freq:
                         counts = counts + jax.nn.one_hot(
                             tok, counts.shape[-1], dtype=counts.dtype
                         ) * active[:, None].astype(counts.dtype)
                     lengths = lengths + active.astype(lengths.dtype)
-                    return (tok, lengths, kc, vc, key, counts), (tok, logp)
+                    return (tok, lengths, kc, vc, counts), (tok, logp)
 
                 init = (
-                    last_tokens, lengths, kc, vc, key,
+                    last_tokens, lengths, kc, vc,
                     counts0 if freq else jnp.zeros((), jnp.float32),
                 )
-                (last, lengths, kc, vc, key, counts), (toks, logps) = (
+                (last, lengths, kc, vc, counts), (toks, logps) = (
                     jax.lax.scan(step, init, None, length=n_chunk)
                 )
                 # scatter the workspace blocks back into the pool
@@ -800,8 +918,8 @@ class JaxDecodeEngine(InferenceEngine):
                     vc.reshape(L, R * nb, bsz, nkv, hd)
                 )
                 if freq:
-                    return kp, vp, last, lengths, key, toks, logps, counts
-                return kp, vp, last, lengths, key, toks, logps
+                    return kp, vp, last, lengths, toks, logps, counts
+                return kp, vp, last, lengths, toks, logps
 
             return chunk
 
@@ -811,6 +929,62 @@ class JaxDecodeEngine(InferenceEngine):
         )
         self._chunk_fns[key_] = fn
         return fn
+
+    def _get_patch_fn(self):
+        """Override selected slots of the device-chained (last, lengths)
+        arrays with host values — the reconcile step applied at dispatch
+        for slots whose host truth diverged from the device chain (retire
+        rewinds a run-ahead slot's length; a fresh admission replaces
+        both). Fixed [R] shapes, compiles once."""
+        if self._patch_fn is None:
+
+            def patch(last, lengths, mask, plast, plen):
+                return (
+                    jnp.where(mask, plast, last),
+                    jnp.where(mask, plen, lengths),
+                )
+
+            self._patch_fn = jax.jit(patch)
+        return self._patch_fn
+
+    def _mark_slot_dirty(self, slot_idx: int) -> None:
+        """A slot's occupancy/sampling state changed: re-upload the control
+        arrays and patch the device-chained last/lengths at next dispatch."""
+        self._ctl_dirty = True
+        self._patch_slots.add(slot_idx)
+
+    def _refresh_ctl(self) -> dict:
+        """Device control arrays for the chunk dispatch. Rebuilt + uploaded
+        only when a slot was admitted/retired/preempted since the last
+        dispatch; steady-state chunks reuse the cached device buffers (the
+        sync path used to upload six host arrays every chunk)."""
+        if self._ctl_cache is not None and not self._ctl_dirty:
+            return self._ctl_cache
+        R = self.config.max_running_requests
+        temps = np.ones(R, dtype=np.float32)
+        top_ps = np.ones(R, dtype=np.float32)
+        greedy = np.zeros(R, dtype=bool)
+        freq_pens = np.zeros(R, dtype=np.float32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            temps[i] = max(s.gconfig.temperature, 1e-6)
+            top_ps[i] = s.gconfig.top_p
+            greedy[i] = s.gconfig.greedy
+            freq_pens[i] = s.gconfig.frequency_penalty
+        # np.array copies for the mirrors mutated in place at later
+        # admissions (jnp.asarray zero-copies aligned numpy on CPU — an
+        # aliased upload would let a host mutation race the in-flight chunk)
+        self._ctl_cache = dict(
+            temps=jnp.asarray(temps),
+            top_ps=jnp.asarray(top_ps),
+            greedy=jnp.asarray(greedy),
+            rope_delta=jnp.asarray(np.array(self._slot_rope_delta)),
+            base_keys=jnp.asarray(np.array(self._slot_keys)),
+            freq_pens=jnp.asarray(freq_pens),
+        )
+        self._ctl_dirty = False
+        return self._ctl_cache
 
     def _get_prefill_fn(self, bucket: int):
         """Cache-warm only: writes the prompt's KV rows at a slot offset.
@@ -1108,6 +1282,7 @@ class JaxDecodeEngine(InferenceEngine):
         item = self._slots[slot]
         self._slots[slot] = None
         self._release_slot_blocks(slot)
+        self._mark_slot_dirty(slot)
         if item is not None:
             self._overflow.insert(0, item)
             self._n_preemptions += 1
@@ -1403,6 +1578,12 @@ class JaxDecodeEngine(InferenceEngine):
                     )
             self._slots[slot_idx] = item
             self._slot_lengths[slot_idx] = P - 1
+            self._slot_epoch[slot_idx] += 1
+            # one base key per admission, in admission (FIFO) order — the
+            # key stream is identical for the sync and run-ahead schedules
+            self._rng, sub = jax.random.split(self._rng)
+            self._slot_keys[slot_idx] = np.asarray(sub, dtype=np.uint32)
+            self._mark_slot_dirty(slot_idx)
             admitted = True
         self._flush_wave(wave_pending, wave_forks)
         return admitted
@@ -1568,6 +1749,7 @@ class JaxDecodeEngine(InferenceEngine):
     def _retire(self, slot_idx: int) -> None:
         item = self._slots[slot_idx]
         self._slots[slot_idx] = None
+        self._mark_slot_dirty(slot_idx)
         if item is not None and item.stop_reason == "interrupt":
             # Park the slot's KV: the client will resume this rid with
             # prompt + partial tokens, whose KV (minus the final token) is
@@ -1616,6 +1798,7 @@ class JaxDecodeEngine(InferenceEngine):
         debug = bool(os.environ.get("AREAL_DECODE_DEBUG"))
         last_dbg = time.monotonic()
         R = self.config.max_running_requests
+        runahead = max(int(self.config.decode_runahead_chunks), 0)
         try:
             while not self._shutdown.is_set():
                 if debug and time.monotonic() - last_dbg > 5.0:
@@ -1634,15 +1817,38 @@ class JaxDecodeEngine(InferenceEngine):
                 # mesh after the thread starts.
                 with mesh_lib.mesh_scope(self.mesh), self._sched_lock:
                     if self._gen_paused.is_set():
+                        # fence: never leave a chunk dispatched while a
+                        # pause holder swaps weights/aborts under us
+                        self._drain_inflight_locked()
                         paused, worked = True, False
                     else:
                         paused = False
                         admitted = self._admit()
                         active = self._active_mask()
-                        worked = bool(active.any())
-                        if worked:
-                            self._run_chunk(active)
-                        worked = worked or admitted
+                        dispatched = False
+                        if active.any():
+                            rec = self._dispatch_chunk(active)
+                            if rec is not None:
+                                self._inflight.append(rec)
+                                dispatched = True
+                        # Consume down to the run-ahead depth AFTER the new
+                        # dispatch: the host work for chunk k (stop scan,
+                        # retire, completions) runs while the device
+                        # executes chunk k+1. Depth 0 degenerates to the
+                        # legacy synchronous dispatch-then-consume.
+                        while len(self._inflight) > runahead:
+                            self._consume_chunk(self._inflight.popleft())
+                        drained = False
+                        if not dispatched:
+                            # no new device work: drain stragglers so the
+                            # last completions aren't held back a pass
+                            drained = bool(self._inflight)
+                            self._drain_inflight_locked()
+                            if not self._active_mask().any():
+                                # engine idle — gaps from here on are lack
+                                # of traffic, not scheduler overhead
+                                self._last_ready_t = None
+                        worked = dispatched or admitted or drained
                 if paused:
                     time.sleep(0.005)
                 elif not worked:
@@ -1652,6 +1858,7 @@ class JaxDecodeEngine(InferenceEngine):
             logger.error(
                 f"decode scheduler died: {e}\n{traceback.format_exc()}"
             )
+            self._inflight.clear()
             # fail all outstanding futures
             for i, s in enumerate(self._slots):
                 if s is not None and s.future is not None and not s.future.done():
@@ -1670,10 +1877,45 @@ class JaxDecodeEngine(InferenceEngine):
                     item.loop.call_soon_threadsafe(item.future.set_exception, e)
 
     def _run_chunk(self, active: np.ndarray):
+        """Synchronous step: dispatch one chunk and consume it immediately
+        (the `decode_runahead_chunks=0` path; also the hand-driven test
+        entry point)."""
+        rec = self._dispatch_chunk(active)
+        if rec is not None:
+            self._consume_chunk(rec)
+
+    def _drain_inflight_locked(self) -> None:
+        """Consume every dispatched-but-unconsumed chunk. Called under
+        _sched_lock — by the scheduler on a pause flag, and by
+        pause_generation itself so its caller (weight commit, abort_all)
+        never operates while a chunk is dispatched against the current
+        weights/KV."""
+        while self._inflight:
+            self._consume_chunk(self._inflight.popleft())
+
+    def _dispatch_chunk(self, active: np.ndarray) -> "_Inflight | None":
         R = self.config.max_running_requests
         n_chunk = self.config.new_tokens_per_chunk
         S = self.config.context_length
-        # Every active slot needs blocks through this chunk's growth.
+        # Saturation mask: a slot whose full max_new_tokens output is
+        # already covered by dispatched (possibly unconsumed) chunks gets
+        # nothing from another chunk — masking it out skips the run-ahead
+        # path's trailing garbage chunk for length-terminated requests
+        # (the common RL-rollout shape). Output-invariant: the slot's
+        # stream is complete, and per-slot keys decouple its batchmates.
+        active = active.copy()
+        for i in np.nonzero(active)[0]:
+            s = self._slots[i]
+            if s is None:
+                active[i] = False
+                continue
+            projected_gen = int(self._slot_lengths[i]) - (len(s.prompt) - 1)
+            if projected_gen >= s.gconfig.max_new_tokens:
+                active[i] = False
+        if not active.any():
+            return None
+        # Every active slot needs blocks through this chunk's growth
+        # (self._slot_lengths already projects all dispatched chunks).
         # Shortest-first so pool pressure preempts as few slots as
         # possible; a preempted request requeues invisibly (see
         # _preempt_slot). The pool always fits one full-context slot
@@ -1703,22 +1945,41 @@ class JaxDecodeEngine(InferenceEngine):
                 self._preempt_slot(v)
                 preempted.add(v)
         if preempted:
-            active = self._active_mask()
+            active = active & self._active_mask()
             if not active.any():
-                return
-        last = np.zeros(R, dtype=np.int32)
-        temps = np.ones(R, dtype=np.float32)
-        top_ps = np.ones(R, dtype=np.float32)
-        greedy = np.zeros(R, dtype=bool)
-        for i, s in enumerate(self._slots):
-            if s is None:
-                continue
-            # fresh slots decode their prompt's final token first (its KV
-            # is deliberately not prefilled — see _get_prefill_fn)
-            last[i] = s.tokens[-1] if s.tokens else s.prompt[-1]
-            temps[i] = max(s.gconfig.temperature, 1e-6)
-            top_ps[i] = s.gconfig.top_p
-            greedy[i] = s.gconfig.greedy
+                return None
+        # device-chained (last, lengths): init on first dispatch, then
+        # patch only the slots whose host truth diverged since
+        if self._dev_last is None or self._dev_lengths is None:
+            last = np.zeros(R, dtype=np.int32)
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    # fresh slots decode their prompt's final token first
+                    # (its KV is deliberately not prefilled — see
+                    # _get_prefill_fn)
+                    last[i] = s.tokens[-1] if s.tokens else s.prompt[-1]
+            self._dev_last = jnp.asarray(last)
+            # np.array copy: jnp.asarray zero-copies aligned numpy buffers
+            # on CPU, and _slot_lengths is mutated in place (the run-ahead
+            # projection) while the dispatched chunk still reads this array
+            self._dev_lengths = jnp.asarray(np.array(self._slot_lengths))
+            self._patch_slots.clear()
+        elif self._patch_slots:
+            mask = np.zeros(R, dtype=bool)
+            plast = np.zeros(R, dtype=np.int32)
+            for i in self._patch_slots:
+                mask[i] = True
+                s = self._slots[i]
+                if s is not None:
+                    plast[i] = s.tokens[-1] if s.tokens else s.prompt[-1]
+            self._dev_last, self._dev_lengths = self._get_patch_fn()(
+                self._dev_last,
+                self._dev_lengths,
+                jnp.asarray(mask),
+                jnp.asarray(plast),
+                jnp.asarray(np.array(self._slot_lengths)),  # no-alias copy
+            )
+            self._patch_slots.clear()
         use_topp = bool(
             any(
                 s is not None and not s.gconfig.greedy and s.gconfig.top_p < 1.0
@@ -1731,79 +1992,125 @@ class JaxDecodeEngine(InferenceEngine):
                 for s in self._slots
             )
         )
+        ctl = self._refresh_ctl()
+        # the effective (saturation-refined) active mask gets its own
+        # cached device buffer: it changes only when a slot joins, leaves,
+        # or crosses its max_new_tokens horizon
+        if self._dev_active_host is None or not np.array_equal(
+            active, self._dev_active_host
+        ):
+            self._dev_active_host = active.copy()
+            self._dev_active = jnp.asarray(active.copy())
         s_bucket = self._chunk_bucket(active)
         nb = -(-s_bucket // self._alloc.block_size)
         chunk_fn = self._get_chunk_fn(use_topp, use_freq, nb)
         version_at_chunk = self._version
-        chunk_t0 = time.monotonic()
+        t_dispatch = time.monotonic()
         with self._weight_lock:
-            self._rng, sub = jax.random.split(self._rng)
             args = [
                 self.params,
                 self._k_cache,
                 self._v_cache,
                 jnp.asarray(self._alloc.table_slice(nb)),
-                jnp.asarray(last),
-                jnp.asarray(self._slot_lengths),
-                jnp.asarray(active),
-                sub,
-                jnp.asarray(temps),
-                jnp.asarray(top_ps),
-                jnp.asarray(greedy),
-                jnp.asarray(self._slot_rope_delta),
+                self._dev_last,
+                self._dev_lengths,
+                self._dev_active,
+                ctl["base_keys"],
+                ctl["temps"],
+                ctl["top_ps"],
+                ctl["greedy"],
+                ctl["rope_delta"],
             ]
             if use_freq:
-                freq_pens = np.zeros(R, dtype=np.float32)
                 for i, s in enumerate(self._slots):
-                    if s is not None:
-                        freq_pens[i] = s.gconfig.frequency_penalty
-                        if s.gconfig.frequency_penalty != 0.0:
-                            self._slot_used_freq[i] = True
+                    if s is not None and s.gconfig.frequency_penalty != 0.0:
+                        self._slot_used_freq[i] = True
                 if self._freq_counts is None:
                     self._freq_counts = jnp.zeros(
                         (R, self.model_config.vocab_size), jnp.float32
                     )
-                out = chunk_fn(
-                    *args, jnp.asarray(freq_pens), self._freq_counts
-                )
                 (
                     self._k_cache,
                     self._v_cache,
-                    _,
-                    lengths_out,
-                    _,
+                    self._dev_last,
+                    self._dev_lengths,
                     toks,
                     logps,
                     self._freq_counts,
-                ) = out
+                ) = chunk_fn(*args, ctl["freq_pens"], self._freq_counts)
             else:
                 (
                     self._k_cache,
                     self._v_cache,
-                    _,
-                    lengths_out,
-                    _,
+                    self._dev_last,
+                    self._dev_lengths,
                     toks,
                     logps,
                 ) = chunk_fn(*args)
-        toks = np.asarray(toks)  # [n_chunk, R]
-        logps = np.asarray(logps)
-        self._slot_lengths = np.asarray(lengths_out).copy()
-        n_chunk = toks.shape[0]
-        # np.asarray above blocked on the device work, so this wall time
-        # covers the whole chunk; amortize it per token for ITL
-        per_tok_s = (time.monotonic() - chunk_t0) / max(n_chunk, 1)
-        self._gen_token_count += int(self._active_mask().sum()) * n_chunk
-        for i, s in enumerate(self._slots):
-            if s is None:
+        # start the device-to-host copies now; _consume_chunk's np.asarray
+        # then only waits for data that isn't already on the host
+        for arr in (toks, logps):
+            copy_async = getattr(arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        # project the host lengths forward so the NEXT dispatch's pool
+        # ensure / bucket choice covers this (unconsumed) chunk's growth;
+        # retire rewinds overwrite this with the absolute true end
+        self._slot_lengths[active] += n_chunk
+        self._chunks_dispatched += 1
+        return _Inflight(
+            toks=toks,
+            logps=logps,
+            items=list(self._slots),
+            active=active.copy(),
+            epochs=self._slot_epoch.copy(),
+            version=version_at_chunk,
+            t_dispatch=t_dispatch,
+            n_chunk=n_chunk,
+        )
+
+    def _consume_chunk(self, rec: "_Inflight") -> None:
+        toks = np.asarray(rec.toks)  # [n_chunk, R]
+        logps = np.asarray(rec.logps)
+        t_ready = time.monotonic()
+        n_chunk = rec.n_chunk
+        # dispatch→ready is the device window; anything between the
+        # previous chunk's ready and this dispatch is device idle (the
+        # host gap the run-ahead path exists to hide)
+        if self._last_ready_t is not None and rec.t_dispatch > self._last_ready_t:
+            self._dev_idle_s += rec.t_dispatch - self._last_ready_t
+            busy_start = rec.t_dispatch
+        elif self._last_ready_t is not None:
+            busy_start = self._last_ready_t
+        else:
+            busy_start = rec.t_dispatch
+        dev_s = max(t_ready - busy_start, 0.0)
+        self._dev_busy_s += dev_s
+        self._last_ready_t = t_ready
+        per_tok_s = dev_s / max(n_chunk, 1)
+        self._chunk_itl_ms.append(per_tok_s * 1000.0)
+        for i, s in enumerate(rec.items):
+            if s is None or not rec.active[i]:
+                continue
+            if s is not self._slots[i] or rec.epochs[i] != self._slot_epoch[i]:
+                # reconcile: the host retired/preempted this slot after the
+                # chunk was dispatched — its run-ahead tokens never
+                # happened (the length rewind at retire already un-claimed
+                # the KV rows). The epoch check also rejects a preempted
+                # item that re-admitted into the same slot.
+                self._runahead_discarded += n_chunk
                 continue
             if s.ttft == float("inf"):
                 s.ttft = time.monotonic() - s.start_time
+            n_before = len(s.tokens)
             s.tokens.extend(toks[:, i].tolist())
             s.logprobs.extend(logps[:, i].tolist())
-            s.versions.extend([version_at_chunk] * n_chunk)
+            s.versions.extend([rec.version] * n_chunk)
             s.itl.extend([per_tok_s] * n_chunk)
             self._truncate_at_stop(s)
+            # consumed tokens only: tokens trimmed past a stop boundary
+            # never reach the client and must not inflate throughput
+            self._gen_token_count += len(s.tokens) - n_before
             if s.stop_reason is not None:
                 # rewind the slot length to the true end: KV rows cover
                 # prompt[:-1] plus every *consumed* token (cache positions
@@ -1900,10 +2207,15 @@ class JaxDecodeEngine(InferenceEngine):
     def pause_generation(self):
         """Pause on the next chunk boundary; returns once the scheduler has
         quiesced (blocks through an in-flight chunk, however long its first
-        compile takes)."""
+        compile takes) AND every run-ahead chunk has been consumed — after
+        this returns no dispatched computation references the current
+        weights or KV, so weight swaps / abort_all are fenced."""
         self._gen_paused.set()
         with self._sched_lock:
-            pass
+            # the scheduler thread drains on the pause flag too, but it may
+            # already be parked between passes — drain here so the fence
+            # holds no matter which side wins the lock first
+            self._drain_inflight_locked()
 
     def continue_generation(self):
         self._gen_paused.clear()
@@ -2053,12 +2365,127 @@ class JaxDecodeEngine(InferenceEngine):
                     pool, 1, [rng.randint(1, vocab, (prompt_len,)).tolist()], g2
                 )
                 warmed_topp = warmed_topp or tp < 1.0
+        # Run-ahead coverage: the waves compile whatever chunk variants
+        # their own retire/admission timing happened to hit; ghost-compile
+        # every (nb bucket x sampling class) the run-ahead path can reach
+        # over this generation span so the first overlapped chunk never
+        # traces mid-stream.
+        self._prewarm_chunk_variants(prompt_len, new_tokens, sampler_top_ps)
         dt = time.monotonic() - t0
         logger.info(
             f"prewarm: waves {waves} at bucket {bucket} "
             f"(+{new_tokens} tokens, top_ps {sampler_top_ps}) in {dt:.1f}s"
         )
         return dt
+
+    def _expected_chunk_buckets(self, prompt_len: int, new_tokens: int) -> list[int]:
+        """KV buckets `_chunk_bucket` will select as a request grows from
+        `prompt_len` through `prompt_len + new_tokens`."""
+        S = self.config.context_length
+        n_chunk = self.config.new_tokens_per_chunk
+        out: set[int] = set()
+        length = max(prompt_len - 1, 0)
+        end = min(prompt_len - 1 + new_tokens, S)
+        while True:
+            b = 256
+            while b < length + n_chunk + 1:
+                b *= 2
+            out.add(min(b, S))
+            if length >= end:
+                break
+            length = min(length + n_chunk, end)
+        return sorted(out)
+
+    def _prewarm_chunk_variants(
+        self,
+        prompt_len: int,
+        new_tokens: int,
+        sampler_top_ps: tuple[float, ...],
+    ) -> None:
+        """Ghost-compile missing decode-chunk variants (all-inactive mask:
+        masked writes + identity gather/scatter leave KV, lengths and the
+        key stream untouched — only the compile happens). The run-ahead
+        scheduler picks a chunk's variant from a STALE active set, so a
+        variant the synchronous waves never hit can be the first
+        overlapped dispatch; compiling it here keeps that dispatch off the
+        trace path. Warns for any variant it had to skip (same contract as
+        _warn_wave_not_compiled)."""
+        classes = sorted({tp < 1.0 for tp in sampler_top_ps})
+        buckets = self._expected_chunk_buckets(prompt_len, new_tokens)
+        self.pause_generation()
+        try:
+            with self._sched_lock, mesh_lib.mesh_scope(self.mesh):
+                R = self.config.max_running_requests
+                if self._dev_last is None or self._dev_lengths is None:
+                    self._dev_last = jnp.asarray(np.zeros(R, np.int32))
+                    self._dev_lengths = jnp.asarray(
+                        np.array(self._slot_lengths)
+                    )
+                # the run-ahead reconcile's patch fn compiles here too
+                self._dev_last, self._dev_lengths = self._get_patch_fn()(
+                    self._dev_last,
+                    self._dev_lengths,
+                    jnp.zeros(R, dtype=bool),
+                    jnp.zeros(R, dtype=jnp.int32),
+                    jnp.asarray(np.array(self._slot_lengths)),
+                )
+                for b in buckets:
+                    nb = -(-b // self._alloc.block_size)
+                    for use_topp in classes:
+                        if (use_topp, False, nb) in self._chunk_fns:
+                            continue
+                        if nb > self._alloc.max_blocks_per_slot:
+                            logger.warning(
+                                f"prewarm: chunk variant (top_p<1={use_topp}, "
+                                f"nb={nb}) skipped — exceeds the pool's "
+                                f"max_blocks_per_slot="
+                                f"{self._alloc.max_blocks_per_slot}; a live "
+                                "dispatch at this bucket will hit a "
+                                "first-compile stall"
+                            )
+                            continue
+                        try:
+                            self._ghost_chunk(use_topp, nb)
+                        except Exception as e:  # noqa: BLE001
+                            logger.warning(
+                                f"prewarm: chunk variant (top_p<1={use_topp}, "
+                                f"nb={nb}) skipped — ghost compile failed: "
+                                f"{e}; live traffic at this bucket will hit "
+                                "a first-compile stall"
+                            )
+        finally:
+            self.continue_generation()
+
+    def _ghost_chunk(self, use_topp: bool, nb: int) -> None:
+        """Dispatch one decode chunk with every slot inactive: decode_step's
+        cache writes are masked and the block gather/scatter round-trips
+        identical bytes, so engine state (KV, lengths, sampling streams) is
+        bit-unchanged — only the jit variant's compile happens."""
+        R = self.config.max_running_requests
+        chunk_fn = self._get_chunk_fn(use_topp, False, nb)
+        ctl = self._refresh_ctl()
+        with self._weight_lock:
+            (
+                self._k_cache,
+                self._v_cache,
+                self._dev_last,
+                self._dev_lengths,
+                _toks,
+                _logps,
+            ) = chunk_fn(
+                self.params,
+                self._k_cache,
+                self._v_cache,
+                jnp.asarray(self._alloc.table_slice(nb)),
+                self._dev_last,
+                self._dev_lengths,
+                jnp.zeros(R, dtype=bool),
+                ctl["base_keys"],
+                ctl["temps"],
+                ctl["top_ps"],
+                ctl["greedy"],
+                ctl["rope_delta"],
+            )
 
     def _warn_wave_not_compiled(self, bucket: int, w: int) -> None:
         """Post-wave prewarm check: a wave can admit below its intended size
@@ -2347,12 +2774,29 @@ class JaxDecodeEngine(InferenceEngine):
         for item in queued_items + list(self._overflow):
             queued += 1
             queued_tokens += len(item.prompt) + item.gconfig.max_new_tokens
+        # decode-loop timing split (run-ahead scheduler): device-busy vs
+        # device-idle (host gap between a chunk's results landing and the
+        # next dispatch), plus honest per-token ITL percentiles over the
+        # recent chunk window — dispatch→ready wall only, host work
+        # excluded (the sync path used to amortize both into one number).
+        itl = np.asarray(self._chunk_itl_ms, dtype=np.float64)
+        span = self._dev_busy_s + self._dev_idle_s
         return {
             "running_requests": running,
             "queued_requests": queued,
             "queued_tokens": queued_tokens,
             "active_tokens": active_tokens,
             "generated_tokens_total": self._gen_token_count,
+            "decode_runahead_chunks": int(self.config.decode_runahead_chunks),
+            "chunks_dispatched_total": self._chunks_dispatched,
+            "runahead_discarded_tokens_total": self._runahead_discarded,
+            "device_busy_s": round(self._dev_busy_s, 6),
+            "device_idle_s": round(self._dev_idle_s, 6),
+            "device_idle_frac": (
+                round(self._dev_idle_s / span, 6) if span > 0 else 0.0
+            ),
+            "itl_p50_ms": float(np.percentile(itl, 50)) if itl.size else 0.0,
+            "itl_p99_ms": float(np.percentile(itl, 99)) if itl.size else 0.0,
             "prefills_total": self._n_prefills,
             "prefix_forks_total": self._n_prefix_forks,
             "prefix_inplace_total": self._n_prefix_inplace,

@@ -113,6 +113,7 @@ class DecodeServer:
                 "model_path": self.config.model_path,
                 "context_length": self.config.context_length,
                 "max_running_requests": self.config.max_running_requests,
+                "decode_runahead_chunks": self.config.decode_runahead_chunks,
                 "version": self.engine.get_version(),
             }
         )
@@ -140,9 +141,12 @@ class DecodeServer:
 
     async def _metrics(self, request: web.Request) -> web.Response:
         """Live engine load counters (running/queued requests, active KV
-        tokens, generated-token totals, prefix-cache hit mix). The router's
-        least_token_usage policy polls this — parity with the per-server
-        token accounting of realhf/system/gserver_manager.py:261-339."""
+        tokens, generated-token totals, prefix-cache hit mix) plus the
+        decode-loop timing split (itl_p50_ms/itl_p99_ms: device-only
+        inter-token latency; device_idle_frac: host-gap fraction the
+        run-ahead scheduler hides). The router's least_token_usage policy
+        polls this — parity with the per-server token accounting of
+        realhf/system/gserver_manager.py:261-339."""
         get = getattr(self.engine, "get_metrics", None)
         if get is None:
             # 404, not {}: the router must fall back to its own estimates
@@ -435,6 +439,7 @@ async def _serve(args: argparse.Namespace) -> None:
         context_length=args.context_length,
         max_running_requests=args.max_running_requests,
         new_tokens_per_chunk=args.new_tokens_per_chunk,
+        decode_runahead_chunks=args.decode_runahead_chunks,
         random_seed=args.seed,
         tensor_parallel_size=args.tp_size,
     )
@@ -510,6 +515,14 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--context-length", type=int, default=32768)
     p.add_argument("--max-running-requests", type=int, default=64)
     p.add_argument("--new-tokens-per-chunk", type=int, default=128)
+    p.add_argument(
+        "--decode-runahead-chunks",
+        type=int,
+        default=1,
+        help="chunks the scheduler keeps dispatched on the device while "
+             "the host post-processes the previous one (0 = legacy "
+             "synchronous loop; output is bit-identical either way)",
+    )
     p.add_argument(
         "--tp-size",
         type=int,

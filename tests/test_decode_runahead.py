@@ -1,0 +1,368 @@
+"""Run-ahead decode scheduler: output bit-identity with the synchronous
+path, retire-mid-run-ahead reconciliation, pause/commit fencing, and the
+consumed-token throughput accounting.
+
+The run-ahead scheduler (`decode_runahead_chunks` >= 1) dispatches chunk
+k+1 against device state before the host has consumed chunk k, so the
+stop-string scan / retire / admission work overlaps the in-flight device
+chunk. Per-slot sampling keys (`fold_in(base_key, slot_length)`) make the
+emitted streams a pure function of admission order and token index —
+these tests pin that: every token and logprob must be bit-identical
+between `decode_runahead_chunks=0` and `1`.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxDecodeConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest
+from areal_tpu.engine.jax_decode import JaxDecodeEngine
+from areal_tpu.models.qwen2 import ModelConfig, forward, init_params
+
+TINY = ModelConfig(
+    vocab_size=64,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    dtype="float32",
+    param_dtype="float32",
+)
+
+
+def _make_engine(runahead: int, **kw):
+    cfg = JaxDecodeConfig(
+        context_length=kw.pop("context_length", 128),
+        max_running_requests=kw.pop("max_running_requests", 4),
+        new_tokens_per_chunk=kw.pop("new_tokens_per_chunk", 4),
+        decode_runahead_chunks=runahead,
+        dtype="float32",
+        kv_cache_dtype="float32",
+        random_seed=kw.pop("random_seed", 5),
+        **kw,
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig())
+    eng.set_model(init_params(TINY, jax.random.PRNGKey(0)), TINY)
+    eng.initialize()
+    return eng
+
+
+def _run_requests(eng, reqs):
+    async def run_all():
+        return await asyncio.gather(*[eng.agenerate(r) for r in reqs])
+
+    return asyncio.run(run_all())
+
+
+def _gather_both(make_reqs):
+    """Run the same request set on a runahead=0 and a runahead=1 engine."""
+    outs = []
+    for runahead in (0, 1):
+        eng = _make_engine(runahead)
+        try:
+            outs.append(_run_requests(eng, make_reqs()))
+        finally:
+            eng.destroy()
+    return outs
+
+
+def test_greedy_bit_identical_runahead(cpu_devices):
+    def make_reqs():
+        return [
+            ModelRequest(
+                input_ids=[2 + i, 7, 11, 3],
+                gconfig=GenerationHyperparameters(
+                    greedy=True, max_new_tokens=10
+                ),
+            )
+            for i in range(6)  # more than max_running_requests
+        ]
+
+    sync, ahead = _gather_both(make_reqs)
+    for i, (a, b) in enumerate(zip(sync, ahead)):
+        assert a.output_tokens == b.output_tokens, i
+        assert a.output_logprobs == b.output_logprobs, i
+        assert a.stop_reason == b.stop_reason, i
+
+
+def test_sampled_bit_identical_runahead(cpu_devices):
+    """Sampled streams (temperature on, mixed top-p classes) must be
+    bit-identical too: the per-slot fold_in(base_key, length) keying makes
+    a slot's stream independent of how tokens were grouped into chunks and
+    of which other slots shared the batch."""
+
+    def make_reqs():
+        reqs = []
+        for i in range(5):
+            reqs.append(
+                ModelRequest(
+                    input_ids=[1 + i, 9, 4],
+                    gconfig=GenerationHyperparameters(
+                        temperature=1.0,
+                        top_p=0.9 if i % 2 else 1.0,
+                        max_new_tokens=9,
+                    ),
+                )
+            )
+        return reqs
+
+    sync, ahead = _gather_both(make_reqs)
+    for i, (a, b) in enumerate(zip(sync, ahead)):
+        assert a.output_tokens == b.output_tokens, i
+        assert a.output_logprobs == b.output_logprobs, i
+
+
+def test_stop_token_bit_identical_and_lengths_rewound(cpu_devices):
+    """A stop token found mid-chunk retires the slot while the run-ahead
+    chunk is already in flight: the speculative tokens must be discarded,
+    the slot length rewound to the true end, and the emitted sequence must
+    equal the synchronous path's."""
+    prompt = [1, 5, 9, 13, 2]
+
+    def greedy_ref(params, p, n):
+        seq = list(p)
+        for _ in range(n):
+            T = len(seq)
+            logits = forward(
+                params,
+                np.array(seq, dtype=np.int32),
+                np.arange(T, dtype=np.int32),
+                np.zeros(T, dtype=np.int32),
+                TINY,
+            )
+            seq.append(int(np.argmax(np.asarray(logits[-1]))))
+        return seq[len(p):]
+
+    eng = _make_engine(1)
+    try:
+        full = greedy_ref(eng.params, prompt, 12)
+        stop_tok = full[5]  # mid-chunk boundary (chunk size 4)
+        cut = full.index(stop_tok) + 1
+        resp = eng.generate(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(
+                    greedy=True, max_new_tokens=12, stop_token_ids=[stop_tok]
+                ),
+            ),
+            timeout=300,
+        )
+        assert resp.stop_reason == "stop"
+        assert resp.output_tokens == full[:cut]
+        # quiesce, then check the reconcile rewound the slot's coverage to
+        # the true end (prompt[:-1] + consumed tokens), not the run-ahead
+        # horizon: retirement registers the slot as a prefix donor with
+        # exactly that many rows (a claim over garbage rows would hand
+        # later forks junk KV), and zeroes _slot_lengths
+        eng.pause_generation()
+        assert not eng._inflight
+        assert all(int(x) == 0 for x in eng._slot_lengths)
+        keys = [k for k in eng._slot_prefix if k is not None]
+        assert keys and len(keys[0]) == len(prompt) - 1 + cut, (
+            [len(k) for k in keys],
+            len(prompt) - 1 + cut,
+        )
+        # run-ahead garbage was dispatched and dropped, never emitted
+        m = eng.get_metrics()
+        assert m["generated_tokens_total"] == cut
+        eng.continue_generation()
+        # the engine stays healthy: a follow-up greedy request on the
+        # (retired-donor) KV still matches the step-by-step reference
+        resp2 = eng.generate(
+            ModelRequest(
+                input_ids=prompt,
+                gconfig=GenerationHyperparameters(greedy=True, max_new_tokens=6),
+            ),
+            timeout=300,
+        )
+        assert resp2.output_tokens == full[:6]
+    finally:
+        eng.destroy()
+
+
+def test_pause_drains_inflight_chunks(cpu_devices):
+    """pause_generation must not return while a chunk is dispatched: weight
+    swaps and abort_all run behind it, and swapping weights under a
+    dispatched computation would break the version-stamp contract."""
+    eng = _make_engine(1, context_length=512, max_running_requests=2)
+    try:
+        import threading
+
+        done = threading.Event()
+        result = {}
+
+        def run():
+            result["resp"] = eng.generate(
+                ModelRequest(
+                    input_ids=[3, 1, 4],
+                    gconfig=GenerationHyperparameters(
+                        greedy=True, max_new_tokens=200
+                    ),
+                ),
+                timeout=300,
+            )
+            done.set()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if eng.get_metrics()["running_requests"] > 0:
+                break
+            time.sleep(0.002)
+        for _ in range(3):
+            eng.pause_generation()
+            # the fence: nothing dispatched survives the pause
+            assert not eng._inflight
+            # a weight-version bump inside the fence must never stamp a
+            # token that was produced by the pre-bump weights
+            eng.set_version(eng.get_version() + 1)
+            eng.continue_generation()
+            time.sleep(0.02)
+        assert done.wait(120)
+        resp = result["resp"]
+        # tokens are stamped with a monotonically nondecreasing version
+        # sequence (each bump happened on a drained chunk boundary)
+        assert resp.output_versions == sorted(resp.output_versions)
+    finally:
+        eng.destroy()
+
+
+def test_commit_weights_fenced_by_drain(cpu_devices):
+    """update_weights_from_tensor (PR2's commit path) pauses internally:
+    with run-ahead on, that pause must consume the in-flight chunk before
+    the install, and post-commit tokens must carry the new version."""
+    from areal_tpu.core.weight_transfer import flatten_named
+
+    eng = _make_engine(1, context_length=512, max_running_requests=2)
+    try:
+        import threading
+
+        done = threading.Event()
+        result = {}
+
+        def run():
+            result["resp"] = eng.generate(
+                ModelRequest(
+                    input_ids=[3, 1, 4],
+                    gconfig=GenerationHyperparameters(
+                        greedy=True, max_new_tokens=160
+                    ),
+                ),
+                timeout=300,
+            )
+            done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if eng.get_metrics()["running_requests"] > 0:
+                break
+            time.sleep(0.002)
+        new_params = init_params(TINY, jax.random.PRNGKey(9))
+        eng.update_weights_from_tensor(flatten_named(new_params), version=7)
+        assert not eng._inflight  # commit drained before installing
+        assert done.wait(120)
+        resp = result["resp"]
+        versions = set(resp.output_versions)
+        assert versions <= {0, 7}, versions
+        # no token produced by the new weights carries the old stamp: the
+        # version sequence flips at most once, 0...0 7...7
+        assert resp.output_versions == sorted(resp.output_versions)
+    finally:
+        eng.destroy()
+
+
+def test_generated_token_count_counts_consumed_only(cpu_devices):
+    """Regression (satellite): _gen_token_count used to add
+    active x n_chunk before truncation, so tokens trimmed past a stop
+    boundary inflated server throughput metrics."""
+    eng = _make_engine(0, new_tokens_per_chunk=8)
+    try:
+        # find a greedy continuation, then stop on its 2nd token: 6 of the
+        # chunk's 8 tokens are trimmed and must not be counted
+        probe = eng.generate(
+            ModelRequest(
+                input_ids=[2, 7, 11],
+                gconfig=GenerationHyperparameters(greedy=True, max_new_tokens=8),
+            ),
+            timeout=300,
+        )
+        count0 = eng.get_metrics()["generated_tokens_total"]
+        assert count0 == len(probe.output_tokens)
+        stop_tok = probe.output_tokens[1]
+        resp = eng.generate(
+            ModelRequest(
+                input_ids=[2, 7, 11],
+                gconfig=GenerationHyperparameters(
+                    greedy=True, max_new_tokens=8, stop_token_ids=[stop_tok]
+                ),
+            ),
+            timeout=300,
+        )
+        assert resp.stop_reason == "stop"
+        assert len(resp.output_tokens) == 2
+        assert (
+            eng.get_metrics()["generated_tokens_total"]
+            == count0 + len(resp.output_tokens)
+        )
+    finally:
+        eng.destroy()
+
+
+def test_decode_timing_metrics_exported(cpu_devices):
+    """The honest ITL split: get_metrics must report device-only ITL
+    percentiles and the device-idle fraction, and a completed run must
+    have accumulated a busy window."""
+    eng = _make_engine(1)
+    try:
+        eng.generate(
+            ModelRequest(
+                input_ids=[2, 7, 11],
+                gconfig=GenerationHyperparameters(greedy=True, max_new_tokens=12),
+            ),
+            timeout=300,
+        )
+        m = eng.get_metrics()
+        assert m["chunks_dispatched_total"] >= 3
+        assert m["device_busy_s"] > 0.0
+        assert 0.0 <= m["device_idle_frac"] <= 1.0
+        assert m["itl_p50_ms"] > 0.0
+        assert m["itl_p99_ms"] >= m["itl_p50_ms"]
+        assert m["decode_runahead_chunks"] == 1
+        # per-request ITL entries are device-window only and positive
+        assert all(v > 0 for v in eng._chunk_itl_ms)
+    finally:
+        eng.destroy()
+
+
+def test_prewarm_compiles_runahead_chunk_variants(cpu_devices):
+    """Prewarm must leave every (sampler class x nb bucket) chunk variant
+    the run-ahead path can hit compiled, so the first overlapped chunk
+    never traces mid-stream."""
+    eng = _make_engine(1, context_length=1024, max_running_requests=2)
+    try:
+        eng.prewarm(prompt_len=200, new_tokens=80, include_fork=False)
+        # generation span crosses the 256->512 KV bucket boundary: both
+        # buckets' nb variants must exist for both sampler classes
+        bsz = eng._alloc.block_size
+        for b in eng._expected_chunk_buckets(200, 80):
+            nb = -(-b // bsz)
+            for use_topp in (False, True):
+                assert (use_topp, False, nb) in eng._chunk_fns, (
+                    use_topp,
+                    nb,
+                    list(eng._chunk_fns),
+                )
+    finally:
+        eng.destroy()
