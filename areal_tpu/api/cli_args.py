@@ -293,6 +293,20 @@ class JaxDecodeConfig:
     # hold, with parked-KV eviction / donor-registry drop / active-slot
     # preemption (internal requeue) when the pool runs dry.
     kv_pool_tokens: int | None = None
+    # How decode attention reaches the paged pool:
+    #   "paged" (default): attend IN PLACE over the pool through the block
+    #     table (ops/paged_attention.py) with an O(1) per-token cache
+    #     write — no per-chunk gather/scatter of the active KV.
+    #   "workspace": the legacy layout — gather each slot's blocks into a
+    #     contiguous workspace, scan the chunk, scatter back (two HBM
+    #     copies of the active KV per chunk). Kept as the numerics oracle;
+    #     tokens/logprobs are identical between the two layouts.
+    kv_layout: str = "paged"
+    # Kernel for the in-pool attention read: "pallas" (TPU split-KV
+    # flash-decode kernel; requires page_size % 128 == 0), "xla"
+    # (gather-per-block fallback, bitwise-equal to the workspace path),
+    # or "auto" (pallas on TPU, xla elsewhere).
+    paged_attn_impl: str = "auto"
     hbm_utilization: float = 0.85
     max_prefill_tokens: int = 8192
     # tokens generated per decode-loop dispatch; interrupts land on chunk

@@ -76,7 +76,12 @@ from areal_tpu.api.io_struct import (
 )
 from areal_tpu.engine.kv_pool import KVBlockAllocator, PoolDry
 from areal_tpu.models import hf_io
-from areal_tpu.models.qwen2 import ModelConfig, decode_step, prefill
+from areal_tpu.models.qwen2 import (
+    ModelConfig,
+    decode_step,
+    decode_step_paged,
+    prefill,
+)
 from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.utils import logging
 
@@ -240,6 +245,17 @@ class JaxDecodeEngine(InferenceEngine):
         # mask + its host mirror for change detection
         self._dev_active = None
         self._dev_active_host = None
+        # Cached device block-table slice, keyed on (allocator mutation
+        # version, nb): steady-state chunks — no admission / retire /
+        # fork / growth / preemption since the last dispatch — skip the
+        # [R, nb] copy + upload entirely.
+        self._dev_table = None
+        self._dev_table_key: tuple[int, int] | None = None
+        self._table_uploads = 0
+        # Workspace-layout HBM round-trip accounting (gather + scatter of
+        # the active KV per chunk); stays 0 on kv_layout="paged" — the
+        # delta IS the traffic the in-pool path eliminates.
+        self._ws_copy_bytes = 0
         # Device-chained per-slot state (last sampled token, slot length):
         # outputs of chunk k feed chunk k+1 directly. Slots whose host
         # truth diverged (retire rewind, fresh admission) are listed in
@@ -256,6 +272,7 @@ class JaxDecodeEngine(InferenceEngine):
         self._chunks_dispatched = 0
         self._runahead_discarded = 0  # run-ahead tokens dropped at reconcile
         self._chunk_fns: dict[bool, Callable] = {}
+        self._paged_impl = "auto"  # resolved in initialize()
         self._prefill_fns: dict[int, Callable] = {}
         self._batched_prefill_fns: dict[tuple[int, int], Callable] = {}
         self._fork_fns: dict[int, Callable] = {}
@@ -343,6 +360,25 @@ class JaxDecodeEngine(InferenceEngine):
         # tokens actually held.
         bs = min(int(self.config.page_size), S)
         max_bps = -(-S // bs)
+        if self.config.kv_layout not in ("paged", "workspace"):
+            raise ValueError(
+                f"kv_layout={self.config.kv_layout!r} not in "
+                "('paged', 'workspace')"
+            )
+        from areal_tpu.ops.paged_attention import resolve_impl
+
+        self._paged_impl = resolve_impl(self.config.paged_attn_impl)
+        if (
+            self.config.kv_layout == "paged"
+            and self._paged_impl == "pallas"
+            and jax.default_backend() == "tpu"
+            and bs % 128 != 0
+        ):
+            raise ValueError(
+                f"paged_attn_impl='pallas' on TPU needs page_size % 128 "
+                f"== 0 (got {bs}); set paged_attn_impl='xla' or fix "
+                "page_size"
+            )
         if self.config.kv_pool_tokens:
             n_blocks = (
                 max(-(-int(self.config.kv_pool_tokens) // bs), max_bps) + 1
@@ -376,6 +412,10 @@ class JaxDecodeEngine(InferenceEngine):
         self._ctl_dirty = True
         self._dev_active = None
         self._dev_active_host = None
+        self._dev_table = None
+        self._dev_table_key = None
+        self._table_uploads = 0
+        self._ws_copy_bytes = 0
         self._dev_last = None
         self._dev_lengths = None
         self._patch_slots = set()
@@ -417,6 +457,8 @@ class JaxDecodeEngine(InferenceEngine):
         self._ctl_cache = None
         self._dev_active = None
         self._dev_active_host = None
+        self._dev_table = None
+        self._dev_table_key = None
         self._dev_last = None
         self._dev_lengths = None
         self._patch_fn = None
@@ -787,24 +829,30 @@ class JaxDecodeEngine(InferenceEngine):
                       nb: int = 1):
         """Chunked decode loop; static sampler variants.
 
-        `nb`: blocks per slot this chunk. The kernel gathers each slot's
-        first nb blocks from the paged pool into a contiguous
-        [L, R, nb*block_size] workspace, runs the scan on it, and
-        scatters the blocks back — two HBM copies per chunk (the same
-        cost the dense engine's bucketed slice paid) buying n_chunk
-        decode steps of O(nb*block_size) attention. Aliased
-        (prefix-shared) blocks are never modified by the scan, so the
-        duplicate scatter writes identical bytes (see kv_pool.py).
+        `nb`: blocks per slot this chunk (the attention span is
+        nb * block_size). The KV access pattern is `config.kv_layout`:
 
-        Cost accounting vs the dense engine's full-context case (which
-        scanned in place with zero copies): the copies add ~2/n_chunk of
-        one workspace read — 1.6% extra KV bandwidth at the default
-        128-token chunk — in exchange for block aliasing and a pool that
-        tracks live tokens. Long-context serving should set
-        kv_pool_tokens so the pool (and this workspace) is bounded by
-        live KV, not by R x context; pushing the table lookup into a
-        Pallas paged-attention kernel would remove the copies entirely
-        and is the designated successor here.
+        - `"paged"` (default): no per-chunk KV round trip. With the
+          Pallas impl (TPU) the scan attends DIRECTLY over the pool
+          through the [R, nb] block table (ops/paged_attention.py — each
+          grid step DMAs one pool block HBM→VMEM) and each step's cache
+          write is a dynamic scatter of the single (block, offset) row —
+          O(1) per token; the pool round-trips through the jit untouched
+          except for the written rows. With the XLA impl (CPU/fallback)
+          a per-step in-pool gather measures ~20% SLOWER than the
+          workspace loop on XLA:CPU (the one-hot write fuses into the
+          attention einsum there; a fresh gather each step does not), so
+          the xla paged body instead gathers ONCE, scans the bitwise-
+          identical decode_step, and writes back ONLY the n_chunk rows
+          the chunk produced — half the workspace layout's copy traffic
+          and measurably faster, with bit-equal output.
+        - `"workspace"` (numerics oracle): gather each slot's first nb
+          blocks into a contiguous [L, R, nb*block_size] workspace, scan
+          over it, scatter the blocks back — two HBM copies of the
+          active KV per chunk, and an O(S) one-hot masked cache rewrite
+          per layer per step inside decode_step. Aliased (prefix-shared)
+          blocks are never modified by the scan, so the duplicate
+          scatter writes identical bytes (see kv_pool.py).
 
         `use_topp=False` (the common RL rollout setting, top_p == 1):
         plain categorical over temperature-scaled logits. `use_topp=True`:
@@ -831,6 +879,8 @@ class JaxDecodeEngine(InferenceEngine):
             return self._chunk_fns[key_]
         cfg = self.model_config
         n_chunk = self.config.new_tokens_per_chunk
+        paged = self.config.kv_layout == "paged"
+        paged_impl = self._paged_impl
 
         def sample(logits, subkeys, temps, top_ps, greedy):
             logits = logits.astype(jnp.float32)
@@ -866,15 +916,123 @@ class JaxDecodeEngine(InferenceEngine):
             logp = jnp.take_along_axis(logprobs_all, tok[:, None], axis=-1)[:, 0]
             return tok, logp
 
-        # ONE step body for both variants: use_freq is python-static, so the
-        # counts carry and the penalty lines only trace when requested —
-        # shared decode logic cannot diverge between the two compiled fns.
+        # ONE step body for both sampler variants AND both KV layouts:
+        # use_freq / kv_layout are python-static, so the counts carry and
+        # the penalty lines only trace when requested — shared decode
+        # logic cannot diverge between the compiled fns.
         def make_chunk(freq: bool):
             def chunk(params, kp, vp, bt, last_tokens, lengths, active,
                       base_keys, temps, top_ps, greedy, rope_delta,
                       *freq_args):
                 freq_pens, counts0 = freq_args if freq else (None, None)
-                # gather each slot's blocks into a contiguous workspace
+
+                def finish_step(logits, tokens, lengths, counts):
+                    if freq:
+                        logits = logits - freq_pens[:, None] * counts
+                    subkeys = jax.vmap(jax.random.fold_in)(base_keys, lengths)
+                    tok, logp = sample(logits, subkeys, temps, top_ps, greedy)
+                    tok = jnp.where(active, tok, tokens)
+                    if freq:
+                        counts = counts + jax.nn.one_hot(
+                            tok, counts.shape[-1], dtype=counts.dtype
+                        ) * active[:, None].astype(counts.dtype)
+                    lengths = lengths + active.astype(lengths.dtype)
+                    return tok, logp, lengths, counts
+
+                counts_init = counts0 if freq else jnp.zeros((), jnp.float32)
+
+                if paged and paged_impl == "pallas":
+                    # in-pool: the pool itself is the scan carry (donated,
+                    # so XLA updates it in place), the write is an O(1)
+                    # row scatter, and attention reads through the block
+                    # table — no gather, no scatter
+                    def step(carry, _):
+                        tokens, lengths, kpc, vpc, counts = carry
+                        logits, kpc, vpc = decode_step_paged(
+                            params, tokens, lengths, kpc, vpc, bt, cfg,
+                            active=active, rope_offset=rope_delta,
+                            attn_impl=paged_impl,
+                        )
+                        tok, logp, lengths, counts = finish_step(
+                            logits, tokens, lengths, counts
+                        )
+                        return (tok, lengths, kpc, vpc, counts), (tok, logp)
+
+                    init = (last_tokens, lengths, kp, vp, counts_init)
+                    (last, lengths, kp, vp, counts), (toks, logps) = (
+                        jax.lax.scan(step, init, None, length=n_chunk)
+                    )
+                    if freq:
+                        return kp, vp, last, lengths, toks, logps, counts
+                    return kp, vp, last, lengths, toks, logps
+
+                if paged:
+                    # xla impl: gather once, scan the (bitwise-identical)
+                    # workspace decode_step, then write back ONLY the
+                    # rows this chunk produced — the full block
+                    # scatter-back is the half of the round trip XLA:CPU
+                    # can drop without losing the one-hot-write fusion
+                    L, _, bsz, nkv, hd = kp.shape
+                    R = bt.shape[0]
+                    idx = bt.reshape(-1)
+                    lengths0 = lengths
+                    kc = jnp.take(kp, idx, axis=1).reshape(
+                        L, R, nb * bsz, nkv, hd
+                    )
+                    vc = jnp.take(vp, idx, axis=1).reshape(
+                        L, R, nb * bsz, nkv, hd
+                    )
+
+                    def step(carry, _):
+                        tokens, lengths, kc, vc, counts = carry
+                        logits, kc, vc = decode_step(
+                            params, tokens, lengths, kc, vc, cfg,
+                            active=active, rope_offset=rope_delta,
+                        )
+                        tok, logp, lengths, counts = finish_step(
+                            logits, tokens, lengths, counts
+                        )
+                        return (tok, lengths, kc, vc, counts), (tok, logp)
+
+                    init = (last_tokens, lengths, kc, vc, counts_init)
+                    (last, lengths, kc, vc, counts), (toks, logps) = (
+                        jax.lax.scan(step, init, None, length=n_chunk)
+                    )
+                    # delta write-back: the n_chunk rows per slot starting
+                    # at the pre-chunk length. Inactive slots never wrote
+                    # (masked one-hot), so their "rows" are unmodified
+                    # gather copies — redirected into the null block 0
+                    # anyway so stale positions can't touch live data.
+                    steps = jnp.arange(n_chunk, dtype=lengths0.dtype)
+                    pos = jnp.clip(
+                        lengths0[:, None] + steps[None, :], 0, nb * bsz - 1
+                    )  # [R, n_chunk]
+                    rows_k = jnp.take_along_axis(
+                        kc, pos[None, :, :, None, None], axis=2
+                    )
+                    rows_v = jnp.take_along_axis(
+                        vc, pos[None, :, :, None, None], axis=2
+                    )
+                    blk = jnp.clip(pos // bsz, 0, nb - 1)
+                    dblock = jnp.take_along_axis(
+                        jnp.broadcast_to(bt[:, None, :], (R, n_chunk, nb)),
+                        blk[..., None],
+                        axis=2,
+                    )[..., 0]
+                    dblock = jnp.where(active[:, None], dblock, 0)
+                    doff = jnp.where(active[:, None], pos % bsz, 0)
+                    kp = kp.at[:, dblock.reshape(-1), doff.reshape(-1)].set(
+                        rows_k.reshape(L, R * n_chunk, nkv, hd)
+                    )
+                    vp = vp.at[:, dblock.reshape(-1), doff.reshape(-1)].set(
+                        rows_v.reshape(L, R * n_chunk, nkv, hd)
+                    )
+                    if freq:
+                        return kp, vp, last, lengths, toks, logps, counts
+                    return kp, vp, last, lengths, toks, logps
+
+                # workspace: gather each slot's blocks into a contiguous
+                # workspace, scan, scatter the blocks back
                 L, _, bsz, nkv, hd = kp.shape
                 R = bt.shape[0]
                 idx = bt.reshape(-1)
@@ -891,26 +1049,15 @@ class JaxDecodeEngine(InferenceEngine):
                         params, tokens, lengths, kc, vc, cfg, active=active,
                         rope_offset=rope_delta,
                     )
-                    if freq:
-                        logits = logits - freq_pens[:, None] * counts
-                    subkeys = jax.vmap(jax.random.fold_in)(base_keys, lengths)
-                    tok, logp = sample(logits, subkeys, temps, top_ps, greedy)
-                    tok = jnp.where(active, tok, tokens)
-                    if freq:
-                        counts = counts + jax.nn.one_hot(
-                            tok, counts.shape[-1], dtype=counts.dtype
-                        ) * active[:, None].astype(counts.dtype)
-                    lengths = lengths + active.astype(lengths.dtype)
+                    tok, logp, lengths, counts = finish_step(
+                        logits, tokens, lengths, counts
+                    )
                     return (tok, lengths, kc, vc, counts), (tok, logp)
 
-                init = (
-                    last_tokens, lengths, kc, vc,
-                    counts0 if freq else jnp.zeros((), jnp.float32),
-                )
+                init = (last_tokens, lengths, kc, vc, counts_init)
                 (last, lengths, kc, vc, counts), (toks, logps) = (
                     jax.lax.scan(step, init, None, length=n_chunk)
                 )
-                # scatter the workspace blocks back into the pool
                 kp = kp.at[:, idx].set(
                     kc.reshape(L, R * nb, bsz, nkv, hd)
                 )
@@ -985,6 +1132,21 @@ class JaxDecodeEngine(InferenceEngine):
         )
         self._ctl_dirty = False
         return self._ctl_cache
+
+    def _table_device(self, nb: int):
+        """Device [R, nb] block-table slice for a chunk dispatch, cached
+        against (allocator mutation version, nb): the table only changes
+        on admission / retire / fork / growth / preemption, so
+        steady-state chunks reuse the uploaded buffer instead of paying a
+        host copy + upload per dispatch. table_slice() hands back a fresh
+        copy, so the upload can never alias host state the scheduler
+        later mutates."""
+        key = (self._alloc.version, nb)
+        if self._dev_table is None or self._dev_table_key != key:
+            self._dev_table = jnp.asarray(self._alloc.table_slice(nb))
+            self._dev_table_key = key
+            self._table_uploads += 1
+        return self._dev_table
 
     def _get_prefill_fn(self, bucket: int):
         """Cache-warm only: writes the prompt's KV rows at a slot offset.
@@ -2011,7 +2173,7 @@ class JaxDecodeEngine(InferenceEngine):
                 self.params,
                 self._k_cache,
                 self._v_cache,
-                jnp.asarray(self._alloc.table_slice(nb)),
+                self._table_device(nb),
                 self._dev_last,
                 self._dev_lengths,
                 self._dev_active,
@@ -2058,6 +2220,24 @@ class JaxDecodeEngine(InferenceEngine):
         # retire rewinds overwrite this with the absolute true end
         self._slot_lengths[active] += n_chunk
         self._chunks_dispatched += 1
+        # Per-chunk KV copy accounting (surfaced via get_metrics for the
+        # pagedattn bench comparison): workspace pays gather AND scatter
+        # of k+v; the paged xla impl keeps only the gather (delta
+        # write-back is O(R·n_chunk) rows, negligible); the Pallas
+        # in-pool impl copies nothing.
+        copies = (
+            2 if self.config.kv_layout == "workspace"
+            else 1 if self._paged_impl == "xla"
+            else 0
+        )
+        if copies:
+            cfgm = self.model_config
+            self._ws_copy_bytes += (
+                copies * 2 * cfgm.num_hidden_layers * R * nb
+                * self._alloc.block_size * cfgm.num_key_value_heads
+                * cfgm.head_dim_
+                * jnp.dtype(self.config.kv_cache_dtype).itemsize
+            )
         return _Inflight(
             toks=toks,
             logps=logps,
@@ -2434,11 +2614,12 @@ class JaxDecodeEngine(InferenceEngine):
                     for use_topp in classes:
                         if (use_topp, False, nb) in self._chunk_fns:
                             continue
+                        layout = self.config.kv_layout
                         if nb > self._alloc.max_blocks_per_slot:
                             logger.warning(
-                                f"prewarm: chunk variant (top_p<1={use_topp}, "
-                                f"nb={nb}) skipped — exceeds the pool's "
-                                f"max_blocks_per_slot="
+                                f"prewarm: {layout} chunk variant "
+                                f"(top_p<1={use_topp}, nb={nb}) skipped — "
+                                "exceeds the pool's max_blocks_per_slot="
                                 f"{self._alloc.max_blocks_per_slot}; a live "
                                 "dispatch at this bucket will hit a "
                                 "first-compile stall"
@@ -2448,19 +2629,26 @@ class JaxDecodeEngine(InferenceEngine):
                             self._ghost_chunk(use_topp, nb)
                         except Exception as e:  # noqa: BLE001
                             logger.warning(
-                                f"prewarm: chunk variant (top_p<1={use_topp}, "
-                                f"nb={nb}) skipped — ghost compile failed: "
-                                f"{e}; live traffic at this bucket will hit "
-                                "a first-compile stall"
+                                f"prewarm: {layout} chunk variant "
+                                f"(top_p<1={use_topp}, nb={nb}) skipped — "
+                                f"ghost compile failed: {e}; live traffic "
+                                "at this bucket will hit a first-compile "
+                                "stall"
                             )
         finally:
             self.continue_generation()
 
     def _ghost_chunk(self, use_topp: bool, nb: int) -> None:
-        """Dispatch one decode chunk with every slot inactive: decode_step's
-        cache writes are masked and the block gather/scatter round-trips
-        identical bytes, so engine state (KV, lengths, sampling streams) is
-        bit-unchanged — only the jit variant's compile happens."""
+        """Dispatch one decode chunk with every slot inactive: engine
+        state (live KV, lengths, sampling streams) is unchanged — only
+        the jit variant's compile happens. On the workspace layout the
+        masked writes + identity gather/scatter round-trip identical
+        bytes; on the paged layout every inactive slot's write is
+        redirected into the reserved null block 0, which is never read
+        as valid data (kv_pool.py), so live blocks stay bit-identical
+        there too. Compiles whichever layout's chunk variant
+        `config.kv_layout` selects — the run-ahead scheduler's first
+        overlapped dispatch must never trace either."""
         R = self.config.max_running_requests
         chunk_fn = self._get_chunk_fn(use_topp, False, nb)
         ctl = self._refresh_ctl()
@@ -2476,7 +2664,7 @@ class JaxDecodeEngine(InferenceEngine):
                 self.params,
                 self._k_cache,
                 self._v_cache,
-                jnp.asarray(self._alloc.table_slice(nb)),
+                self._table_device(nb),
                 self._dev_last,
                 self._dev_lengths,
                 jnp.zeros(R, dtype=bool),
@@ -2781,6 +2969,14 @@ class JaxDecodeEngine(InferenceEngine):
         # excluded (the sync path used to amortize both into one number).
         itl = np.asarray(self._chunk_itl_ms, dtype=np.float64)
         span = self._dev_busy_s + self._dev_idle_s
+        # prefix-cache hit rate: admissions served by KV reuse (fork /
+        # in-place / suffix) over all admissions that could have reused
+        prefix_hits = (
+            self._n_prefix_forks
+            + self._n_prefix_inplace
+            + self._n_suffix_prefills
+        )
+        prefix_total = prefix_hits + self._n_prefills
         return {
             "running_requests": running,
             "queued_requests": queued,
@@ -2801,13 +2997,28 @@ class JaxDecodeEngine(InferenceEngine):
             "prefix_forks_total": self._n_prefix_forks,
             "prefix_inplace_total": self._n_prefix_inplace,
             "suffix_prefills_total": self._n_suffix_prefills,
+            "prefix_cache_hit_rate": (
+                round(prefix_hits / prefix_total, 6) if prefix_total else 0.0
+            ),
             "preemptions_total": self._n_preemptions,
+            "kv_layout": self.config.kv_layout,
             "kv_block_size": self._alloc.block_size if self._alloc else 0,
             "kv_blocks_total": self._alloc.usable_blocks if self._alloc else 0,
             "kv_blocks_free": self._alloc.free_blocks if self._alloc else 0,
+            # free blocks that cannot back another max-context admission
+            # (the remainder after whole worst-case reservations)
+            "kv_pool_fragmentation": (
+                self._alloc.fragmentation_blocks() if self._alloc else 0
+            ),
             "kv_tokens_allocated": (
                 self._alloc.allocated_tokens() if self._alloc else 0
             ),
+            # dirty-tracked block-table uploads: chunks_dispatched_total -
+            # this = steady-state dispatches that skipped the copy+upload
+            "block_table_uploads_total": self._table_uploads,
+            # per-chunk KV copy traffic: workspace = gather + scatter,
+            # paged/xla = gather only, paged/pallas = 0 (in-pool reads)
+            "kv_workspace_copy_bytes_total": self._ws_copy_bytes,
             "weight_version": self._version,
             "paused": self._gen_paused.is_set(),
         }

@@ -9,10 +9,20 @@ slots that is the whole HBM budget even when every live sequence is short.
 TPU-first shape: one pool tensor [L, n_blocks, block_size, nKV, hd] per
 K/V. Block tables are HOST-side numpy (the scheduler thread owns them; the
 jitted kernels receive the relevant table slice as a traced operand each
-dispatch, so table mutation never recompiles anything). Device access is a
-bucketed gather: the chunk kernel gathers each slot's first `nb` blocks
-into a contiguous workspace, runs the scan, and scatters the blocks back —
-the same two HBM copies the dense engine's bucketed slice already paid.
+dispatch, so table mutation never recompiles anything). Device access is
+layout-dependent (`JaxDecodeConfig.kv_layout`):
+
+- `"paged"` (default): decode attends DIRECTLY over the pool through the
+  block table (ops/paged_attention.py) and each step's KV write is a
+  dynamic-update of the single (block, offset) row — no copies at all.
+- `"workspace"` (the numerics oracle): the chunk kernel gathers each
+  slot's first `nb` blocks into a contiguous workspace, runs the scan,
+  and scatters the blocks back — two HBM copies of the active KV per
+  chunk (the cost the dense engine's bucketed slice already paid).
+
+`version` is a monotonic mutation counter: every table write (ensure
+growth, free, fork) bumps it, so the engine can skip re-uploading the
+table slice for steady-state chunks where nothing moved.
 
 Sharing: a prefix fork ALIASES the donor's full blocks (refcount bump — a
 table write, no data movement) and device-copies only the one partial
@@ -58,6 +68,8 @@ class KVBlockAllocator:
         self._free: list[int] = list(range(n_blocks - 1, 0, -1))
         self.tables = np.zeros((n_slots, max_blocks_per_slot), dtype=np.int32)
         self.nblocks = np.zeros(n_slots, dtype=np.int32)
+        # bumped on every table mutation; consumers cache uploads against it
+        self.version = 0
 
     # -- queries --------------------------------------------------------
     @property
@@ -74,6 +86,13 @@ class KVBlockAllocator:
     def allocated_tokens(self) -> int:
         """Distinct blocks in use x block_size (aliased blocks count once)."""
         return int((self.refcount[1:] > 0).sum()) * self.block_size
+
+    def fragmentation_blocks(self) -> int:
+        """Free blocks that cannot back another max-context admission: the
+        remainder after whole max_blocks_per_slot reservations. Paged
+        allocation needs no contiguity, so this is the only structural
+        waste a full-context request can observe."""
+        return len(self._free) % self.max_blocks_per_slot
 
     def table_slice(self, nb: int) -> np.ndarray:
         """[n_slots, nb] table head for a bucketed gather (copy — the
@@ -94,6 +113,8 @@ class KVBlockAllocator:
 
     def free_slot(self, slot: int) -> None:
         nb = int(self.nblocks[slot])
+        if nb:
+            self.version += 1
         for j in range(nb):
             b = int(self.tables[slot, j])
             if b == 0:
@@ -116,6 +137,7 @@ class KVBlockAllocator:
             return False
         self.tables[slot, cur:target] = got
         self.nblocks[slot] = target
+        self.version += 1
         return True
 
     def fork(self, src: int, dst: int, covered: int) -> tuple[int, int] | None:
@@ -140,6 +162,7 @@ class KVBlockAllocator:
             if b != 0:
                 self.refcount[b] += 1
         self.nblocks[dst] = full
+        self.version += 1
         if partial:
             got = self._alloc(1)
             if got is None:

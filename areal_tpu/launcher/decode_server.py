@@ -114,6 +114,8 @@ class DecodeServer:
                 "context_length": self.config.context_length,
                 "max_running_requests": self.config.max_running_requests,
                 "decode_runahead_chunks": self.config.decode_runahead_chunks,
+                "kv_layout": self.config.kv_layout,
+                "paged_attn_impl": self.config.paged_attn_impl,
                 "version": self.engine.get_version(),
             }
         )
@@ -440,6 +442,8 @@ async def _serve(args: argparse.Namespace) -> None:
         max_running_requests=args.max_running_requests,
         new_tokens_per_chunk=args.new_tokens_per_chunk,
         decode_runahead_chunks=args.decode_runahead_chunks,
+        kv_layout=args.kv_layout,
+        paged_attn_impl=args.paged_attn_impl,
         random_seed=args.seed,
         tensor_parallel_size=args.tp_size,
     )
@@ -522,6 +526,22 @@ def main(argv: list[str] | None = None) -> None:
         help="chunks the scheduler keeps dispatched on the device while "
              "the host post-processes the previous one (0 = legacy "
              "synchronous loop; output is bit-identical either way)",
+    )
+    p.add_argument(
+        "--kv-layout",
+        default="paged",
+        choices=["paged", "workspace"],
+        help="decode KV access: 'paged' attends in place over the paged "
+             "pool through the block table (no per-chunk gather/scatter); "
+             "'workspace' is the legacy copy-in/copy-out numerics oracle",
+    )
+    p.add_argument(
+        "--paged-attn-impl",
+        default="auto",
+        choices=["auto", "pallas", "xla"],
+        help="kernel for the in-pool attention read: 'pallas' (TPU "
+             "split-KV flash-decode; needs page_size %% 128 == 0), 'xla' "
+             "(gather-per-block fallback), 'auto' picks per backend",
     )
     p.add_argument(
         "--tp-size",

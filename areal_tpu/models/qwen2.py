@@ -1964,3 +1964,118 @@ def decode_step(
     else:
         logits = jnp.einsum("rh,hv->rv", x, params["lm_head"]["kernel"])
     return logits.astype(jnp.float32), k_cache, v_cache
+
+
+def decode_step_paged(
+    params: dict,
+    tokens: jax.Array,  # [R] current input token per slot
+    positions: jax.Array,  # [R] logical index the new token occupies
+    k_pool: jax.Array,  # [L, n_blocks, bsz, nKV, hd] paged KV pool
+    v_pool: jax.Array,  # [L, n_blocks, bsz, nKV, hd]
+    block_tables: jax.Array,  # [R, nb] int32: each slot's pool blocks
+    cfg: ModelConfig,
+    active: jax.Array | None = None,  # [R] bool: slot holds a live request
+    rope_offset: jax.Array | None = None,  # [R] added to rope pos only
+    attn_impl: str = "auto",  # ops/paged_attention.py impl select
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One batched decode step attending DIRECTLY over the paged pool.
+
+    The in-pool twin of `decode_step` (same embed/rope/mlp/lm-head body;
+    the two must stay output-equivalent — tests/test_paged_attention.py
+    pins it). Differences, both per layer per step:
+
+    - **Write is O(1), not O(S).** `decode_step`'s cache write is a
+      one-hot masked rewrite of the whole [R, S] cache; here the new
+      row's pool coordinates `(block_tables[r, p // bsz], p % bsz)` are
+      computed from the slot position and written with a single dynamic
+      scatter of R rows. Inactive slots are redirected to the reserved
+      null block 0 (never read as valid data), so retired donors' and
+      parked slots' KV is untouched — the same guarantee the masked
+      one-hot write gave. Write-collision safety between active slots is
+      the pool invariant: aliased (prefix-shared) blocks sit strictly
+      below every writer's position and the boundary block is private
+      (engine/kv_pool.py).
+    - **Attention reads through the block table** (ops/paged_attention):
+      no workspace gather/scatter round-trip per chunk.
+    """
+    from areal_tpu.ops.paged_attention import paged_attention
+
+    compute_dtype = jnp.dtype(cfg.dtype)
+    R = tokens.shape[0]
+    bsz = k_pool.shape[2]
+    nb = block_tables.shape[1]
+    span = nb * bsz
+    nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    group = nH // nKV
+    x = _scale_embed(
+        params["embed"]["embedding"][tokens].astype(compute_dtype), cfg
+    )  # [R, H]
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"]["embedding"][positions].astype(
+            compute_dtype
+        )
+    rope_pos = positions if rope_offset is None else positions + rope_offset
+    cos, sin = rope_table(rope_pos, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)
+    valid = jnp.arange(span)[None, :] <= positions[:, None]  # [R, span]
+    if cfg.sliding_window is not None:
+        valid = valid & (
+            jnp.arange(span)[None, :] > positions[:, None] - cfg.sliding_window
+        )
+
+    # the one pool row this step writes, per slot: clip keeps stale
+    # inactive positions in range, and inactive slots land in null block 0
+    blk_col = jnp.clip(positions // bsz, 0, nb - 1)
+    dest_block = jnp.take_along_axis(block_tables, blk_col[:, None], axis=1)[
+        :, 0
+    ]
+    dest_off = positions % bsz
+    if active is not None:
+        dest_block = jnp.where(active, dest_block, 0)
+        dest_off = jnp.where(active, dest_off, 0)
+
+    def write(pool_l, new):  # [n_blocks, bsz, nKV, hd] <- [R, nKV, hd]
+        return pool_l.at[dest_block, dest_off].set(new)
+
+    def layer(x, inputs):
+        layer_p, kp, vp = inputs
+        h = _norm(x, layer_p["input_norm"], cfg, layer_p.get("input_norm_bias"))
+        q, k_new, v_new = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
+        kp = write(kp, k_new.astype(kp.dtype))
+        vp = write(vp, v_new.astype(vp.dtype))
+        attn_out = paged_attention(
+            q.reshape(R, nH, hd), kp, vp, block_tables, valid, impl=attn_impl
+        )
+        proj = jnp.einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"])
+        if cfg.attn_out_bias:
+            proj = proj + layer_p["attn"]["o_bias"]
+        x = x + proj
+        h = _norm(x, layer_p["post_attn_norm"], cfg, layer_p.get("post_attn_norm_bias"))
+        if cfg.num_experts:
+            y, _ = moe_mlp(layer_p["mlp"], h, cfg, valid=active)
+        else:
+            y = mlp(layer_p["mlp"], h, cfg)
+        x = x + y
+        return x, (kp, vp)
+
+    if cfg.scan_layers:
+        x, (k_pool, v_pool) = jax.lax.scan(
+            layer, x, (params["layers"], k_pool, v_pool)
+        )
+    else:
+        kps, vps = [], []
+        for i in range(cfg.num_hidden_layers):
+            x, (kp, vp) = layer(
+                x, (params[f"layers_{i}"], k_pool[i], v_pool[i])
+            )
+            kps.append(kp)
+            vps.append(vp)
+        k_pool, v_pool = jnp.stack(kps), jnp.stack(vps)
+
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum(
+            "rh,vh->rv", x, params["embed"]["embedding"].astype(compute_dtype)
+        )
+    else:
+        logits = jnp.einsum("rh,hv->rv", x, params["lm_head"]["kernel"])
+    return logits.astype(jnp.float32), k_pool, v_pool

@@ -1,0 +1,225 @@
+"""Paged-attention decode kernel: attend over the KV pool IN PLACE.
+
+The decode engine's KV lives in a paged pool `[n_blocks, bsz, nKV, hd]`
+(per layer) with host-side `[R, nb]` block tables (engine/kv_pool.py).
+Until this op existed, the chunk kernel gathered every active slot's
+blocks into a contiguous workspace, scanned decode steps over it, and
+scattered the blocks back — two full HBM copies of the active KV per
+chunk that SGLang's paged radix cache (the reference's decode substrate)
+never pays. Decode is HBM-bandwidth-bound on TPU, so those copies were
+the largest remaining device-side cost after the host-gap work.
+
+Two implementations behind one signature, selected like `attn_impl`:
+
+- `"pallas"` (TPU): a split-KV flash-decode kernel. The block table is a
+  scalar-prefetch operand, so each grid step's BlockSpec index map reads
+  `bt[r, b]` and DMAs exactly that pool block HBM→VMEM — attention reads
+  KV *through the table*, nothing is ever copied HBM→HBM. Online-softmax
+  partial (max, sum, acc) scratch carries across the `nb` block steps of
+  each (slot, kv-head) program.
+- `"xla"` (CPU / tests / fallback): gathers the `nb` blocks per step and
+  runs the exact einsum sequence of the workspace `decode_step`, so its
+  logits are BITWISE identical to the workspace layout — that is what
+  lets the engine keep `kv_layout="workspace"` as a numerics oracle.
+
+The per-token KV *write* is not this op's job: `decode_step_paged`
+(models/qwen2.py) writes the single (block, offset) row with a dynamic
+scatter — O(1) per token where the workspace path's one-hot masked
+rewrite touched the whole [R, S] cache per layer per step.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+IMPLS = ("auto", "pallas", "xla")
+
+
+def resolve_impl(impl: str) -> str:
+    if impl not in IMPLS:
+        raise ValueError(f"paged_attn impl={impl!r} not in {IMPLS}")
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return impl
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback: gather-per-block, workspace-identical arithmetic
+# ---------------------------------------------------------------------------
+
+
+def _paged_attention_xla(q, k_pool, v_pool, block_table, valid, sm_scale):
+    R, nH, hd = q.shape
+    bsz, nKV = k_pool.shape[1], k_pool.shape[2]
+    nb = block_table.shape[1]
+    group = nH // nKV
+    idx = block_table.reshape(-1)
+    kc = jnp.take(k_pool, idx, axis=0).reshape(R, nb * bsz, nKV, hd)
+    vc = jnp.take(v_pool, idx, axis=0).reshape(R, nb * bsz, nKV, hd)
+    # the exact op/cast sequence of the workspace decode_step attention —
+    # bitwise-equal logits are the parity contract with kv_layout="workspace"
+    qg = q.reshape(R, nKV, group, hd)
+    scores = jnp.einsum("rkgd,rskd->rkgs", qg, kc.astype(q.dtype))
+    if sm_scale == 1.0 / math.sqrt(hd):
+        # the workspace decode_step divides by sqrt(hd); reproduce that op
+        # exactly (not a mathematically-equal multiply) for bit parity
+        scores = (scores / np.sqrt(hd)).astype(jnp.float32)
+    else:
+        scores = (scores * sm_scale).astype(jnp.float32)
+    scores = jnp.where(valid[:, None, None, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("rkgs,rskd->rkgd", probs, vc.astype(q.dtype))
+    return out.reshape(R, nH, hd)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel: split-KV grid, online-softmax partial reduction
+# ---------------------------------------------------------------------------
+
+
+def _paged_attn_kernel(
+    bt_ref,  # [R, nb] scalar-prefetch block table
+    mask_ref,  # (1, bsz) int32 validity rows for this block
+    q_ref,  # (1, 1, group, hd)
+    k_ref,  # (1, bsz, 1, hd) — THE pool block bt[r, b], DMA'd in place
+    v_ref,  # (1, bsz, 1, hd)
+    o_ref,  # (1, 1, group, hd)
+    acc_ref,  # VMEM (group, hd) f32
+    m_ref,  # VMEM (group, 1) f32
+    l_ref,  # VMEM (group, 1) f32
+    *,
+    sm_scale: float,
+):
+    b = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(b == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [group, hd]
+    k = k_ref[0, :, 0].astype(jnp.float32)  # [bsz, hd]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * sm_scale
+    s = jnp.where(mask_ref[0][None, :] != 0, s, _NEG_INF)
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    # rows with no valid key yet: every p entry is exp(-inf - -inf) = 1
+    p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    m_ref[:] = m_new
+    v = v_ref[0, :, 0].astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(b == nb - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(
+    q, k_pool, v_pool, block_table, valid, sm_scale, interpret
+):
+    R, nH, hd = q.shape
+    bsz, nKV = k_pool.shape[1], k_pool.shape[2]
+    nb = block_table.shape[1]
+    group = nH // nKV
+    if not interpret and bsz % 128 != 0:
+        raise ValueError(
+            f"pallas paged attention needs page_size % 128 == 0 on TPU "
+            f"(got {bsz}); use impl='xla' or a 128-multiple page size"
+        )
+    qg = q.reshape(R, nKV, group, hd)
+    mask = valid.astype(jnp.int32)  # [R, nb*bsz]
+
+    kernel = functools.partial(_paged_attn_kernel, sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(R, nKV, nb),
+        in_specs=[
+            pl.BlockSpec((1, bsz), lambda r, h, b, bt: (r, b)),
+            pl.BlockSpec((1, 1, group, hd), lambda r, h, b, bt: (r, h, 0, 0)),
+            # the index map IS the page walk: block b of slot r comes
+            # straight from the pool row the table names
+            pl.BlockSpec(
+                (1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0)
+            ),
+            pl.BlockSpec(
+                (1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, hd), lambda r, h, b, bt: (r, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, hd), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, nKV, group, hd), q.dtype),
+        interpret=interpret,
+    )(block_table, mask, qg, k_pool, v_pool)
+    return out.reshape(R, nH, hd)
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+def paged_attention(
+    q: jax.Array,  # [R, nH, hd] query (one decode step per slot)
+    k_pool: jax.Array,  # [n_blocks, bsz, nKV, hd] ONE layer's pool
+    v_pool: jax.Array,  # [n_blocks, bsz, nKV, hd]
+    block_table: jax.Array,  # [R, nb] int32 pool-block ids per slot
+    valid: jax.Array,  # [R, nb*bsz] bool: logical rows each slot attends
+    *,
+    impl: str = "auto",
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Decode attention of R single-token queries over paged KV.
+
+    Logical row s of slot r lives at pool position
+    `(block_table[r, s // bsz], s % bsz)`; `valid` carries the causal
+    (and sliding-window) mask over those logical rows, so unallocated
+    table tail entries (null block 0) are read but never scored. Returns
+    `[R, nH, hd]` in q's dtype.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _default_interpret()
+    impl = resolve_impl(impl)
+    if impl == "xla":
+        return _paged_attention_xla(q, k_pool, v_pool, block_table, valid, sm_scale)
+    return _paged_attention_pallas(
+        q, k_pool, v_pool, block_table, valid, sm_scale, interpret
+    )

@@ -182,7 +182,7 @@ def _wait_for_running(eng, timeout_s: float, poll_s: float = 0.01) -> bool:
 
 
 def bench_decode(model, n_requests, prompt_len, new_tokens, max_running,
-                 runahead=1, chunk=None):
+                 runahead=1, chunk=None, kv_layout="paged"):
     from areal_tpu.api.cli_args import (
         GenerationHyperparameters,
         InferenceEngineConfig,
@@ -199,6 +199,7 @@ def bench_decode(model, n_requests, prompt_len, new_tokens, max_running,
         max_running_requests=max_running,
         new_tokens_per_chunk=chunk or min(128, new_tokens),
         decode_runahead_chunks=runahead,
+        kv_layout=kv_layout,
         dtype=model.dtype,
         kv_cache_dtype=model.dtype,
     )
@@ -285,6 +286,14 @@ def bench_decode(model, n_requests, prompt_len, new_tokens, max_running,
         decode_requests=n_requests,
         decode_new_tokens=new_tokens,
         decode_runahead_chunks=runahead,
+        decode_kv_layout=kv_layout,
+        # per-chunk KV copy traffic over the timed window: workspace =
+        # gather + scatter; paged drops the scatter half (xla impl) or
+        # both halves (pallas in-pool reads)
+        decode_kv_copy_bytes=(
+            m1["kv_workspace_copy_bytes_total"]
+            - m0["kv_workspace_copy_bytes_total"]
+        ),
         decode_device_idle_frac=(
             idle / (busy + idle) if (busy + idle) > 0 else 0.0
         ),
@@ -318,6 +327,39 @@ def bench_decode_compare(model, n_requests, prompt_len, new_tokens,
     out["decode_sync_device_idle_frac"] = sync["decode_device_idle_frac"]
     out["decode_sync_itl_p50_ms"] = sync["decode_itl_p50_ms"]
     out["decode_sync_itl_p99_ms"] = sync["decode_itl_p99_ms"]
+    return out
+
+
+def bench_paged_compare(model, n_requests, prompt_len, new_tokens,
+                        max_running, chunk=None):
+    """In-pool paged attention (kv_layout="paged", the default) vs the
+    legacy gather/scatter workspace layout at the same wave config.
+    Headline numbers come from the paged engine; the workspace run lands
+    under `decode_ws_*` plus its measured gather/scatter round-trip bytes
+    (`decode_ws_gather_scatter_bytes`) — the per-chunk HBM traffic the
+    in-pool path eliminates outright. The paged engine runs FIRST so the
+    warm-process advantage goes to the workspace baseline (same
+    conservative ordering as bench_decode_compare)."""
+    out = bench_decode(
+        model, n_requests, prompt_len, new_tokens, max_running,
+        chunk=chunk, kv_layout="paged",
+    )
+    ws = bench_decode(
+        model, n_requests, prompt_len, new_tokens, max_running,
+        chunk=chunk, kv_layout="workspace",
+    )
+    out["decode_ws_tokens_per_sec_per_chip"] = ws[
+        "decode_tokens_per_sec_per_chip"
+    ]
+    out["decode_ws_itl_p50_ms"] = ws["decode_itl_p50_ms"]
+    out["decode_ws_itl_p99_ms"] = ws["decode_itl_p99_ms"]
+    out["decode_ws_gather_scatter_bytes"] = ws["decode_kv_copy_bytes"]
+    out["paged_over_ws_speedup"] = (
+        out["decode_tokens_per_sec_per_chip"]
+        / ws["decode_tokens_per_sec_per_chip"]
+        if ws["decode_tokens_per_sec_per_chip"] > 0
+        else 0.0
+    )
     return out
 
 
@@ -1125,6 +1167,18 @@ def main() -> None:
                 attempts=3,
                 base_delay=15.0,
             )
+        if want("pagedattn"):
+            decode.update(
+                _retry_transport(
+                    lambda: bench_paged_compare(
+                        model, n_requests=128, prompt_len=128, new_tokens=256,
+                        max_running=64,
+                    ),
+                    what="bench_paged_compare",
+                    attempts=3,
+                    base_delay=15.0,
+                )
+            )
         if want("prefix"):
             decode.update(
                 _retry_transport(
@@ -1246,6 +1300,16 @@ def main() -> None:
                 model, n_requests=8, prompt_len=16, new_tokens=64,
                 max_running=4, chunk=8,
             )
+        if want("pagedattn"):
+            # same steady-state-dominated shape as the decode smoke: enough
+            # chunks per request that the per-chunk gather/scatter (or its
+            # absence) is what the timed window measures
+            decode.update(
+                bench_paged_compare(
+                    model, n_requests=8, prompt_len=16, new_tokens=64,
+                    max_running=4, chunk=8,
+                )
+            )
         if want("prefix"):
             decode.update(
                 bench_prefix_decode(
@@ -1290,6 +1354,7 @@ def main() -> None:
         # read as a catastrophic regression. Headline the mode's own number.
         headline = {
             "decode": ("decode_tokens_per_sec_per_chip", "tok/s/chip"),
+            "pagedattn": ("paged_over_ws_speedup", "x"),
             "prefix": ("prefix_share_speedup", "x"),
             "grpo": ("grpo_samples_per_sec_per_chip", "samples/s/chip"),
             "ppsched": ("pp_temp_ratio_gpipe_over_1f1b", "x"),
@@ -1321,8 +1386,8 @@ if __name__ == "__main__":
             "--mode",
             default=os.environ.get("AREAL_BENCH_MODE", "all"),
             choices=[
-                "all", "train", "decode", "prefix", "grpo", "ppsched",
-                "weightsync",
+                "all", "train", "decode", "pagedattn", "prefix", "grpo",
+                "ppsched", "weightsync",
             ],
             help="which measurements to run (default: all)",
         )
