@@ -1,0 +1,13 @@
+"""areal-lint: AST-based concurrency + JAX hot-path invariant analyzer.
+
+CLI: `python -m areal_tpu.analysis [paths...]` (see __main__.py).
+Library: `analyze_paths(paths)` returns pragma-filtered Findings.
+Rule catalog and semantics: docs/ANALYSIS.md.
+"""
+
+from areal_tpu.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    RULES,
+    analyze_paths,
+)

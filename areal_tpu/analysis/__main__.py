@@ -1,0 +1,116 @@
+"""areal-lint CLI.
+
+  python -m areal_tpu.analysis [paths...]
+      [--baseline tools/lint_baseline.json] [--write-baseline]
+      [--rules AR101,AR2xx...] [--json] [--list-rules] [--no-baseline]
+
+Exit codes: 0 clean (all findings baselined or none), 1 findings, 2 usage.
+The default baseline path is tools/lint_baseline.json relative to the
+current directory (the repo root in CI); pass --no-baseline to see every
+finding, --write-baseline to (re)generate the file from current findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from areal_tpu.analysis.core import RULES, Baseline, analyze_paths
+
+DEFAULT_BASELINE = "tools/lint_baseline.json"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m areal_tpu.analysis",
+        description="concurrency + JAX hot-path invariant analyzer",
+    )
+    p.add_argument("paths", nargs="*", default=["areal_tpu"])
+    p.add_argument("--baseline", default=None, help="baseline JSON path")
+    p.add_argument(
+        "--no-baseline", action="store_true", help="ignore any baseline"
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    p.add_argument(
+        "--rules", default=None, help="comma-separated rule filter (AR101,...)"
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for code, desc in sorted(RULES.items()):
+            print(f"{code}  {desc}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = set()
+        for r in args.rules.split(","):
+            r = r.strip().upper()
+            if r.endswith("XX"):  # family: AR1xx / AR2xx
+                rules |= {c for c in RULES if c.startswith(r[:-2])}
+            elif r:
+                rules.add(r)
+
+    paths = args.paths or ["areal_tpu"]
+    errors: list = []
+    findings = analyze_paths(paths, rules=rules, collect_errors=errors)
+    for path, err in errors:
+        print(f"warning: could not parse {path}: {err}", file=sys.stderr)
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.write_baseline:
+        Baseline.from_findings(findings).save(baseline_path)
+        print(f"wrote {len(findings)} entries to {baseline_path}")
+        return 0
+
+    baseline = None
+    if not args.no_baseline:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except FileNotFoundError:
+            baseline = None
+        except (OSError, ValueError) as e:
+            print(f"error: bad baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+
+    new = [f for f in findings if baseline is None or not baseline.covers(f)]
+    suppressed = len(findings) - len(new)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.__dict__ for f in new],
+                    "baselined": suppressed,
+                    "total": len(findings),
+                }
+            )
+        )
+    else:
+        for f in new:
+            print(f.format())
+        stale = baseline.unused(findings) if baseline else []
+        for e in stale:
+            print(
+                "note: stale baseline entry "
+                f"{e.get('file')}:{e.get('rule')}:{e.get('key')} "
+                "(finding no longer fires — remove it)",
+                file=sys.stderr,
+            )
+        print(
+            f"areal-lint: {len(new)} finding(s), {suppressed} baselined, "
+            f"{len(findings)} total",
+            file=sys.stderr,
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,693 @@
+"""AR1xx — concurrency invariants over the async engine surface.
+
+Model (documented in docs/ANALYSIS.md):
+
+Thread contexts, per class:
+  - "main"                 any public sync method (external callers)
+  - "eventloop"            any `async def` method (one event loop = one
+                           thread; aiohttp handler registrations are
+                           discovered and land here too)
+  - "thread:<entry>"       a method or nested function passed to
+                           `threading.Thread(target=...)`,
+                           `<executor>.submit(...)`, or
+                           `loop.run_in_executor(None, ...)`
+Contexts propagate through `self.m()` calls (fixpoint), so a private helper
+called from both the scheduler thread and a public method is multi-context.
+`__init__` bodies are excluded (they run before any thread exists) but
+thread-target functions *defined* inside `__init__` are not.
+
+AR101: an attribute written from >= 2 contexts must be guarded. A guard is
+  - implicit: every multi-context write site sits lexically inside a
+    `with self.<lock>:` block on one common lock, or
+  - declared: `# guarded-by: <lock>` on an assignment line of the attr, or
+    a module-level `_GUARDED_BY = {"Class.attr": "<lock>"}` registry (for
+    handshake-style serialization the lexical check cannot see).
+Attributes whose initializer is a known thread-safe type (Lock/Event/Queue/
+OrderedLock/...) are exempt.
+
+AR102: cycle in the global lock acquisition-order graph. An edge A -> B is
+recorded whenever B is acquired while A is held, including one level of
+interprocedural reach (locks transitively acquired by `self.m()` calls made
+under A). The graph is unioned across every analyzed file before cycle
+detection.
+
+AR103: an edge A -> B where both locks declare ranks (`OrderedLock(name,
+rank)`) in the same class and rank(A) >= rank(B) — the static counterpart
+of utils/lock.py's runtime LockOrderViolation.
+
+AR104: a guarded-by annotation or registry entry naming a lock that is not
+declared on the class (or a registry key naming an unknown class/attr).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from areal_tpu.analysis.core import (
+    GUARDED_BY_RE,
+    Finding,
+    SourceFile,
+    call_root,
+    dotted_name,
+)
+
+# attribute initializers considered inherently thread-safe
+_SAFE_TYPES = {
+    "Lock",
+    "RLock",
+    "Condition",
+    "Event",
+    "Semaphore",
+    "BoundedSemaphore",
+    "Barrier",
+    "Queue",
+    "LifoQueue",
+    "PriorityQueue",
+    "SimpleQueue",
+    "OrderedLock",
+    "local",
+}
+_LOCK_TYPES = {"Lock", "RLock", "Condition", "OrderedLock"}
+
+# method calls that mutate their receiver
+_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "pop",
+    "popleft",
+    "appendleft",
+    "remove",
+    "discard",
+    "add",
+    "clear",
+    "update",
+    "setdefault",
+    "sort",
+    "reverse",
+    "fill",
+}
+
+
+@dataclass
+class _Write:
+    unit: str
+    line: int
+    held: frozenset  # lock node names lexically held at the write
+
+
+@dataclass
+class _ClassInfo:
+    name: str
+    file: str
+    methods: dict = field(default_factory=dict)  # name -> FunctionDef
+    locks: dict = field(default_factory=dict)  # attr -> {"rank", "line"}
+    safe_attrs: set = field(default_factory=set)
+    writes: dict = field(default_factory=dict)  # attr -> [_Write]
+    entry_ctx: dict = field(default_factory=dict)  # unit -> set[str]
+    calls: dict = field(default_factory=dict)  # unit -> set[method name]
+    annotations: dict = field(default_factory=dict)  # attr -> (lock, line)
+    attr_lines: dict = field(default_factory=dict)  # attr -> first write line
+
+
+class ConcurrencyState:
+    """Cross-file accumulator for the lock-order graph (AR102/AR103)."""
+
+    def __init__(self):
+        # (held, acquired) -> (file, line) of a representative site
+        self.edges: dict[tuple[str, str], tuple[str, int]] = {}
+        self.ranks: dict[str, int] = {}  # lock node -> declared rank
+        self._files: dict[str, SourceFile] = {}
+
+    def add_edge(self, held: str, acquired: str, file: str, line: int):
+        self.edges.setdefault((held, acquired), (file, line))
+
+    def finalize(self) -> list[Finding]:
+        findings: list[Finding] = []
+        # AR103: rank order, same-class locks only
+        for (a, b), (file, line) in sorted(self.edges.items()):
+            ra, rb = self.ranks.get(a), self.ranks.get(b)
+            if ra is None or rb is None or a == b:
+                continue
+            if a.rsplit(".", 1)[0] != b.rsplit(".", 1)[0]:
+                continue
+            if ra >= rb:
+                f = Finding(
+                    rule="AR103",
+                    file=file,
+                    line=line,
+                    key=f"{a}->{b}",
+                    message=f"acquiring {b} (rank {rb}) while holding "
+                    f"{a} (rank {ra}) violates the declared order",
+                )
+                if not self._suppressed(f):
+                    findings.append(f)
+        # AR102: cycles over the union graph
+        adj: dict[str, set[str]] = {}
+        for a, b in self.edges:
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+        seen_cycles: set[frozenset] = set()
+        for start in sorted(adj):
+            cyc = self._find_cycle(start, adj)
+            if cyc and frozenset(cyc) not in seen_cycles:
+                seen_cycles.add(frozenset(cyc))
+                edge = (cyc[0], cyc[1 % len(cyc)])
+                file, line = self.edges.get(
+                    edge, next(iter(self.edges.values()))
+                )
+                f = Finding(
+                    rule="AR102",
+                    file=file,
+                    line=line,
+                    key="->".join(sorted(set(cyc))),
+                    message="lock acquisition-order cycle: "
+                    + " -> ".join(cyc + [cyc[0]]),
+                )
+                if not self._suppressed(f):
+                    findings.append(f)
+        return findings
+
+    @staticmethod
+    def _find_cycle(start: str, adj: dict) -> list[str] | None:
+        path: list[str] = []
+        on_path: set[str] = set()
+        done: set[str] = set()
+
+        def dfs(n: str) -> list[str] | None:
+            path.append(n)
+            on_path.add(n)
+            for m in sorted(adj.get(n, ())):
+                if m in on_path:
+                    return path[path.index(m) :]
+                if m not in done:
+                    got = dfs(m)
+                    if got:
+                        return got
+            on_path.discard(n)
+            done.add(n)
+            path.pop()
+            return None
+
+        return dfs(start)
+
+    def _suppressed(self, f: Finding) -> bool:
+        sf = self._files.get(f.file)
+        return sf.suppressed(f.rule, f.line) if sf else False
+
+
+def analyze_concurrency(
+    sf: SourceFile, state: ConcurrencyState | None = None
+) -> list[Finding]:
+    if state is not None:
+        state._files[sf.display] = sf
+    findings: list[Finding] = []
+    module_locks = _module_locks(sf.tree)
+    registry, registry_lines = _guard_registry(sf.tree)
+    classes = [
+        n for n in sf.tree.body if isinstance(n, ast.ClassDef)
+    ]
+    class_names = {c.name for c in classes}
+    for cls in classes:
+        info = _collect_class(sf, cls)
+        if state is not None:
+            for attr, meta in info.locks.items():
+                if meta["rank"] is not None:
+                    state.ranks[f"{info.name}.{attr}"] = meta["rank"]
+        findings += _check_class(
+            sf, info, registry, module_locks, state
+        )
+    # AR104 for registry keys that name unknown classes/locks
+    for key, lock in registry.items():
+        cls_name = key.split(".", 1)[0]
+        if cls_name not in class_names:
+            findings.append(
+                Finding(
+                    rule="AR104",
+                    file=sf.display,
+                    line=registry_lines.get(key, 1),
+                    key=key,
+                    message=f"_GUARDED_BY entry {key!r} names a class not "
+                    "defined in this module",
+                )
+            )
+    # lock-order edges for module-level functions (module-level locks)
+    if state is not None:
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _walk_unit(
+                    node.body,
+                    unit="",
+                    info=None,
+                    sf=sf,
+                    state=state,
+                    lock_nodes=module_locks,
+                    held=[],
+                )
+    return findings
+
+
+# -- collection --------------------------------------------------------------
+
+
+def _type_of_call(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Call):
+        name = call_root(node)
+        if name:
+            return name.rsplit(".", 1)[-1]
+    return None
+
+
+def _module_locks(tree: ast.Module) -> dict[str, str]:
+    """module-level `NAME = threading.Lock()` -> {NAME: node_name}."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and _type_of_call(node.value) in _LOCK_TYPES:
+                out[t.id] = f"<module>.{t.id}"
+    return out
+
+
+def _guard_registry(tree: ast.Module):
+    """module-level `_GUARDED_BY = {"Class.attr": "lock"}`."""
+    reg: dict[str, str] = {}
+    lines: dict[str, int] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Name) and t.id == "_GUARDED_BY"):
+            continue
+        if isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(v, ast.Constant):
+                    reg[str(k.value)] = str(v.value)
+                    lines[str(k.value)] = k.lineno
+    return reg, lines
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """`self.X` -> "X" (one level only)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _thread_target(call: ast.Call):
+    """If `call` hands a callable to another thread, return that callable's
+    AST expr: Thread(target=...), <pool>.submit(fn, ...),
+    loop.run_in_executor(exec, fn, ...)."""
+    name = call_root(call) or ""
+    last = name.rsplit(".", 1)[-1]
+    if last == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+    elif last == "submit" and call.args:
+        return call.args[0]
+    elif last == "run_in_executor" and len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _collect_class(sf: SourceFile, cls: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(name=cls.name, file=sf.display)
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[node.name] = node
+
+    # pass A: locks, safe attrs, annotations, thread entries
+    for mname, m in info.methods.items():
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    ty = _type_of_call(node.value)
+                    if ty in _LOCK_TYPES:
+                        rank = _ordered_lock_rank(node.value)
+                        info.locks.setdefault(
+                            attr, {"rank": rank, "line": node.lineno}
+                        )
+                    if ty in _SAFE_TYPES:
+                        info.safe_attrs.add(attr)
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None or node.lineno > len(sf.lines):
+                        continue
+                    mm = GUARDED_BY_RE.search(sf.lines[node.lineno - 1])
+                    if mm:
+                        info.annotations[attr] = (mm.group(1), node.lineno)
+            if isinstance(node, ast.Call):
+                tgt = _thread_target(node)
+                if tgt is None:
+                    continue
+                tattr = _self_attr(tgt)
+                if tattr and tattr in info.methods:
+                    info.entry_ctx.setdefault(tattr, set()).add(
+                        f"thread:{tattr}"
+                    )
+                elif isinstance(tgt, ast.Name):
+                    # nested function used as a thread target
+                    info.entry_ctx.setdefault(
+                        f"{mname}.{tgt.id}", set()
+                    ).add(f"thread:{mname}.{tgt.id}")
+
+    # entry contexts for methods themselves
+    for mname, m in info.methods.items():
+        ctx = info.entry_ctx.setdefault(mname, set())
+        if isinstance(m, ast.AsyncFunctionDef):
+            ctx.add("eventloop")
+        elif mname == "__init__":
+            pass  # runs before any thread exists
+        elif not mname.startswith("_") or (
+            mname.startswith("__") and mname.endswith("__")
+        ):
+            ctx.add("main")
+    return info
+
+
+def _ordered_lock_rank(call: ast.Call) -> int | None:
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        v = call.args[1].value
+        return v if isinstance(v, int) else None
+    for kw in call.keywords:
+        if kw.arg == "rank" and isinstance(kw.value, ast.Constant):
+            v = kw.value.value
+            return v if isinstance(v, int) else None
+    return None
+
+
+# -- per-unit walk (writes, self-calls, lock edges) --------------------------
+
+
+def _lock_node_of(expr: ast.AST, info, lock_nodes: dict[str, str]) -> str | None:
+    """Resolve a with-item / acquire receiver to a lock graph node name."""
+    attr = _self_attr(expr)
+    if attr is not None and info is not None and attr in info.locks:
+        return f"{info.name}.{attr}"
+    if isinstance(expr, ast.Name) and expr.id in lock_nodes:
+        return lock_nodes[expr.id]
+    if isinstance(expr, ast.Call):
+        name = call_root(expr) or ""
+        if name.rsplit(".", 1)[-1] == "DistributedLock":
+            if expr.args and isinstance(expr.args[0], ast.Constant):
+                return f"DistributedLock:{expr.args[0].value}"
+            return "DistributedLock:<dynamic>"
+    return None
+
+
+def _walk_unit(
+    body: list,
+    unit: str,
+    info: _ClassInfo | None,
+    sf: SourceFile,
+    state: ConcurrencyState | None,
+    lock_nodes: dict[str, str],
+    held: list[str],
+):
+    """Walk statements of one execution unit, tracking lexically held
+    locks; record writes/calls into `info` and edges into `state`."""
+
+    def record_write(attr: str, line: int):
+        if info is None or unit.split(".", 1)[0] == "__init__" and "." not in unit:
+            return
+        info.writes.setdefault(attr, []).append(
+            _Write(unit=unit, line=line, held=frozenset(held))
+        )
+        info.attr_lines.setdefault(attr, line)
+
+    def record_call(callee: str):
+        if info is not None:
+            info.calls.setdefault(unit, set()).add(callee)
+
+    def visit(node: ast.AST):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: thread targets become their own unit with an
+            # empty held stack (a fresh thread holds nothing)
+            nested = f"{unit.split('.', 1)[0]}.{node.name}" if info else unit
+            if info is not None and nested in info.entry_ctx:
+                _walk_unit(
+                    node.body, nested, info, sf, state, lock_nodes, []
+                )
+            else:
+                for ch in node.body:
+                    visit(ch)
+            return
+        if isinstance(node, ast.Lambda):
+            return  # opaque
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            n0 = len(held)
+            for item in node.items:
+                lock = _lock_node_of(item.context_expr, info, lock_nodes)
+                if lock is not None:
+                    if state is not None:
+                        for h in held:
+                            state.add_edge(
+                                h, lock, sf.display, item.context_expr.lineno
+                            )
+                    held.append(lock)
+                else:
+                    visit(item.context_expr)
+            for ch in node.body:
+                visit(ch)
+            del held[n0:]
+            return
+        if isinstance(node, ast.Call):
+            name = call_root(node) or ""
+            last = name.rsplit(".", 1)[-1]
+            # explicit .acquire(): held for the rest of the unit (approx.)
+            if last == "acquire" and isinstance(node.func, ast.Attribute):
+                lock = _lock_node_of(node.func.value, info, lock_nodes)
+                if lock is not None:
+                    if state is not None:
+                        for h in held:
+                            state.add_edge(h, lock, sf.display, node.lineno)
+                    held.append(lock)
+            # mutating method call on a self attribute
+            if last in _MUTATORS and isinstance(node.func, ast.Attribute):
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    record_write(attr, node.lineno)
+            # self.m() call
+            if (
+                name.startswith("self.")
+                and name.count(".") == 1
+                and info is not None
+                and name[5:] in info.methods
+            ):
+                record_call(name[5:])
+                if state is not None and held:
+                    # interprocedural edges resolved in _check_class via
+                    # transitive acquires; record the call site for that
+                    info.calls.setdefault(unit, set())
+                    _pending_edges.append(
+                        (info.name, list(held), name[5:], sf.display, node.lineno)
+                    )
+            for ch in ast.iter_child_nodes(node):
+                visit(ch)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets
+                if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            flat: list[ast.AST] = []
+            for t in targets:
+                if isinstance(t, (ast.Tuple, ast.List)):
+                    flat.extend(t.elts)
+                else:
+                    flat.append(t)
+            for t in flat:
+                attr = _self_attr(t)
+                if attr is not None:
+                    record_write(attr, node.lineno)
+                elif isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        record_write(attr, node.lineno)
+            for ch in ast.iter_child_nodes(node):
+                visit(ch)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is None and isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                if attr is not None:
+                    record_write(attr, node.lineno)
+            return
+        for ch in ast.iter_child_nodes(node):
+            visit(ch)
+
+    for stmt in body:
+        visit(stmt)
+
+
+# pending interprocedural (held-locks, callee) records; resolved per class
+_pending_edges: list = []
+
+
+# -- evaluation --------------------------------------------------------------
+
+
+def _check_class(
+    sf: SourceFile,
+    info: _ClassInfo,
+    registry: dict[str, str],
+    module_locks: dict[str, str],
+    state: ConcurrencyState | None,
+) -> list[Finding]:
+    global _pending_edges
+    _pending_edges = []
+    for mname, m in info.methods.items():
+        _walk_unit(m.body, mname, info, sf, state, module_locks, [])
+
+    # context propagation through self.m() calls (fixpoint)
+    ctx: dict[str, set[str]] = {
+        u: set(c) for u, c in info.entry_ctx.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for unit, callees in info.calls.items():
+            src = ctx.get(unit, set())
+            if not src:
+                continue
+            for callee in callees:
+                dst = ctx.setdefault(callee, set())
+                if not src <= dst:
+                    dst |= src
+                    changed = True
+
+    # transitive lock acquires per method (for interprocedural edges)
+    if state is not None:
+        direct: dict[str, set[str]] = {}
+        for mname, m in info.methods.items():
+            acq: set[str] = set()
+            for node in ast.walk(m):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        lock = _lock_node_of(
+                            item.context_expr, info, module_locks
+                        )
+                        if lock:
+                            acq.add(lock)
+                elif isinstance(node, ast.Call):
+                    nm = call_root(node) or ""
+                    if nm.rsplit(".", 1)[-1] == "acquire" and isinstance(
+                        node.func, ast.Attribute
+                    ):
+                        lock = _lock_node_of(
+                            node.func.value, info, module_locks
+                        )
+                        if lock:
+                            acq.add(lock)
+            direct[mname] = acq
+        trans = {m: set(a) for m, a in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for mname, m in info.methods.items():
+                callees = set()
+                for unit, cs in info.calls.items():
+                    if unit.split(".", 1)[0] == mname:
+                        callees |= cs
+                for c in callees:
+                    extra = trans.get(c, set())
+                    if not extra <= trans[mname]:
+                        trans[mname] |= extra
+                        changed = True
+        for cls_name, held, callee, file, line in _pending_edges:
+            if cls_name != info.name:
+                continue
+            for lock in trans.get(callee, ()):
+                for h in held:
+                    if h != lock:
+                        state.add_edge(h, lock, file, line)
+
+    findings: list[Finding] = []
+
+    # AR104: annotations naming undeclared locks
+    known_locks = set(info.locks) | set(module_locks)
+    for attr, (lock, line) in sorted(info.annotations.items()):
+        lname = lock[5:] if lock.startswith("self.") else lock
+        if lname not in known_locks:
+            findings.append(
+                Finding(
+                    rule="AR104",
+                    file=sf.display,
+                    line=line,
+                    key=f"{info.name}.{attr}",
+                    message=f"guarded-by names {lock!r}, which is not a "
+                    f"declared lock of {info.name}",
+                )
+            )
+    for key, lock in sorted(registry.items()):
+        cls_name, _, attr = key.partition(".")
+        if cls_name != info.name:
+            continue
+        if lock not in known_locks:
+            findings.append(
+                Finding(
+                    rule="AR104",
+                    file=sf.display,
+                    line=1,
+                    key=key,
+                    message=f"_GUARDED_BY[{key!r}] names {lock!r}, which is "
+                    f"not a declared lock of {info.name}",
+                )
+            )
+
+    # AR101: multi-context writes without a guard
+    for attr, writes in sorted(info.writes.items()):
+        if attr in info.safe_attrs or attr in info.locks:
+            continue
+        write_ctxs: set[str] = set()
+        for w in writes:
+            write_ctxs |= ctx.get(w.unit, set())
+        if len(write_ctxs) < 2:
+            continue
+        # implicit guard: one common lock held at every write site
+        common = None
+        for w in writes:
+            common = w.held if common is None else (common & w.held)
+        if common:
+            continue
+        # declared guard
+        if attr in info.annotations:
+            continue
+        if registry.get(f"{info.name}.{attr}"):
+            continue
+        lines = sorted({w.line for w in writes})
+        # an inline disable pragma on ANY write site suppresses the
+        # attribute's finding (the finding itself is anchored to the first
+        # write, which may be far from the site the author annotated)
+        if any(sf.suppressed("AR101", ln) for ln in lines):
+            continue
+        findings.append(
+            Finding(
+                rule="AR101",
+                file=sf.display,
+                line=lines[0],
+                key=f"{info.name}.{attr}",
+                message=f"'{attr}' is written from contexts "
+                f"{sorted(write_ctxs)} (lines {lines[:8]}) with no common "
+                "lock held and no guarded-by declaration",
+            )
+        )
+    return findings

@@ -1,0 +1,240 @@
+"""areal-lint core: findings, pragmas, baseline, and the analysis driver.
+
+The analyzers are pure-AST (no jax import, no code execution) so the suite
+runs in milliseconds over the whole tree and can gate tier-1. Rule families:
+
+  AR1xx — concurrency invariants (analysis/concurrency.py)
+  AR2xx — JAX hot-path hazards  (analysis/jax_rules.py)
+
+Suppression surfaces, in priority order:
+  1. inline pragma      `# areal-lint: disable=AR101[,AR203]` on the flagged
+     line or the immediately preceding (comment-only) line
+  2. file pragma        `# areal-lint: disable-file=AR201` anywhere at module
+     top level (first 30 lines)
+  3. baseline file      JSON entries keyed on (file, rule, key) — `key` is a
+     rule-specific *stable* identifier (attribute / symbol name), not a line
+     number, so baselines survive unrelated edits. Every entry carries a
+     one-line `justification`.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # "AR101"
+    file: str  # path as passed to the analyzer (normalized, /-separated)
+    line: int  # 1-based
+    key: str  # stable identifier used for baseline matching
+    message: str
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} [{self.key}] {self.message}"
+
+
+RULES: dict[str, str] = {
+    "AR101": "shared attribute written from multiple thread contexts "
+    "without a declared guard",
+    "AR102": "lock acquisition-order cycle",
+    "AR103": "lock acquired against the declared rank order",
+    "AR104": "guarded-by annotation names an undeclared lock",
+    "AR201": "implicit device->host sync inside a loop "
+    "(.item() / float() / int() / np.asarray on a device array)",
+    "AR202": "use of a buffer after it was donated to a jit call",
+    "AR203": "jnp.asarray upload aliasing a host array that is later "
+    "mutated in place",
+    "AR204": "retrace hazard: loop-varying Python scalar or unhashable "
+    "argument to a jit-compiled function",
+}
+
+_PRAGMA_RE = re.compile(r"#\s*areal-lint:\s*disable=([A-Z0-9,\s]+)")
+_FILE_PRAGMA_RE = re.compile(r"#\s*areal-lint:\s*disable-file=([A-Z0-9,\s]+)")
+GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][\w.]*)")
+
+
+def _parse_rule_list(blob: str) -> set[str]:
+    return {r.strip() for r in blob.split(",") if r.strip()}
+
+
+class SourceFile:
+    """One parsed module: tree + raw lines + pragma index."""
+
+    def __init__(self, path: str, display_path: str | None = None):
+        self.path = path
+        self.display = (display_path or path).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=path)
+        self._line_pragmas: dict[int, set[str]] = {}
+        self._file_pragmas: set[str] = set()
+        for i, ln in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(ln)
+            if m:
+                self._line_pragmas[i] = _parse_rule_list(m.group(1))
+            if i <= 30:
+                m = _FILE_PRAGMA_RE.search(ln)
+                if m:
+                    self._file_pragmas |= _parse_rule_list(m.group(1))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_pragmas:
+            return True
+        for ln in (line, line - 1):
+            rules = self._line_pragmas.get(ln)
+            # a pragma on the preceding line only counts if that line is
+            # comment-only — otherwise it belongs to that line's own code
+            if rules and rule in rules:
+                if ln == line:
+                    return True
+                prev = self.lines[ln - 1].strip() if 0 < ln <= len(self.lines) else ""
+                if prev.startswith("#"):
+                    return True
+        return False
+
+
+@dataclass
+class Baseline:
+    """Checked-in list of accepted findings (false positives, justified)."""
+
+    entries: list[dict] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(entries=list(data.get("entries", [])))
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(
+                {"version": 1, "entries": self.entries},
+                f,
+                indent=2,
+                sort_keys=False,
+            )
+            f.write("\n")
+
+    @staticmethod
+    def _file_match(finding_file: str, entry_file: str) -> bool:
+        # baseline files are repo-relative; findings may carry absolute
+        # paths depending on how the analyzer was invoked
+        return finding_file == entry_file or finding_file.endswith(
+            "/" + entry_file
+        )
+
+    def covers(self, f: Finding) -> bool:
+        return any(
+            e.get("rule") == f.rule
+            and e.get("key") == f.key
+            and self._file_match(f.file, e.get("file", ""))
+            for e in self.entries
+        )
+
+    def unused(self, findings: list[Finding]) -> list[dict]:
+        return [
+            e
+            for e in self.entries
+            if not any(
+                e.get("rule") == f.rule
+                and e.get("key") == f.key
+                and self._file_match(f.file, e.get("file", ""))
+                for f in findings
+            )
+        ]
+
+    @classmethod
+    def from_findings(cls, findings: list[Finding]) -> "Baseline":
+        return cls(
+            entries=[
+                {
+                    "file": f.file,
+                    "rule": f.rule,
+                    "key": f.key,
+                    "justification": "TODO: justify or fix",
+                }
+                for f in sorted(findings, key=lambda x: (x.file, x.rule, x.key))
+            ]
+        )
+
+
+def iter_py_files(paths: list[str]) -> list[tuple[str, str]]:
+    """Expand files/directories into (abs_path, display_path) pairs."""
+    out: list[tuple[str, str]] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append((p, p))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs if d not in ("__pycache__", ".git", "node_modules")
+            )
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    full = os.path.join(root, fn)
+                    out.append((full, full))
+    return out
+
+
+def analyze_paths(
+    paths: list[str],
+    rules: set[str] | None = None,
+    collect_errors: list | None = None,
+) -> list[Finding]:
+    """Run every analyzer over the given files/dirs; pragma-filtered,
+    baseline NOT applied (the caller decides)."""
+    from areal_tpu.analysis.concurrency import (
+        ConcurrencyState,
+        analyze_concurrency,
+    )
+    from areal_tpu.analysis.jax_rules import analyze_jax
+
+    state = ConcurrencyState()
+    findings: list[Finding] = []
+    for full, display in iter_py_files(paths):
+        try:
+            sf = SourceFile(full, display)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            if collect_errors is not None:
+                collect_errors.append((display, repr(e)))
+            continue
+        per_file = analyze_concurrency(sf, state) + analyze_jax(sf)
+        for f in per_file:
+            if rules is not None and f.rule not in rules:
+                continue
+            if sf.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    # cross-file lock-order findings (AR102/AR103); pragma suppression is
+    # applied inside finalize via the retained SourceFiles
+    for f in state.finalize():
+        if rules is None or f.rule in rules:
+            findings.append(f)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.key))
+    return findings
+
+
+# -- small shared AST helpers ------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """`a.b.c` -> "a.b.c", Name -> "a"; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_root(call: ast.Call) -> str | None:
+    """Dotted name of a call's callee ("jnp.asarray", "self._fn")."""
+    return dotted_name(call.func)

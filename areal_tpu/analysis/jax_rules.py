@@ -1,0 +1,519 @@
+"""AR2xx — JAX hot-path hazards (pjit/TPU invariants).
+
+All heuristics are intentionally conservative about what counts as a
+"device array": a local name is device-typed only if it was assigned from a
+`jnp.*` / `jax.*` call (minus the explicit host transfers) or from a call to
+a name known to be jit-wrapped in the same scope/module. Unknown receivers
+are NOT flagged — fewer false positives beats exhaustiveness for a tier-1
+gate; the fixtures pin the contract.
+
+AR201  implicit host sync inside a `for`/`while` loop: `.item()`,
+       `float()`/`int()` on a device array, `np.asarray`/`np.array` of a
+       device array. Each of these blocks the host on the device stream —
+       inside a decode/train step loop that serializes the pipeline and
+       pollutes timing measurements.
+
+AR202  use of a donated buffer after a `donate_argnums`/`donate_argnames`
+       jit call: the callee's XLA buffers alias the argument, which is
+       deleted after the call. Reads after the call site (without an
+       intervening rebind) are use-after-free.
+
+AR203  `jnp.asarray(x)` of a host array `x` that is later mutated in place.
+       On CPU (and in unified-memory setups) `jnp.asarray` zero-copies
+       aligned numpy buffers, so the later mutation races whatever
+       computation the upload feeds (the PR 3 run-ahead bug class). Bare
+       names and `self.*` attributes are tracked; wrapping the upload in
+       `np.array(...)` (an explicit copy) clears the finding.
+
+AR204  retrace hazard: a loop-varying Python scalar passed to a
+       jit-compiled function (each distinct value re-specializes or
+       fragments the jit cache), or an unhashable literal (list/dict/set)
+       passed at a static arg position (TypeError at runtime).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from areal_tpu.analysis.core import Finding, SourceFile, call_root
+
+_HOST_SYNC_CASTS = {"float", "int"}
+_NP_CONVERTERS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_INPLACE_MUTATORS = {"fill", "sort", "reverse", "partition", "put", "setflags"}
+
+
+@dataclass
+class _JitInfo:
+    static_argnums: set = field(default_factory=set)
+    static_argnames: set = field(default_factory=set)
+    donate_argnums: set = field(default_factory=set)
+    donate_argnames: set = field(default_factory=set)
+    line: int = 0
+
+
+def walk_scope(fn: ast.AST):
+    """Yield nodes of one function scope without descending into nested
+    function/class definitions (they are analyzed as their own scopes)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_jax_call_root(name: str | None) -> bool:
+    if not name:
+        return False
+    root = name.split(".", 1)[0]
+    if root not in ("jnp", "jax"):
+        return False
+    return name not in ("jax.device_get",)
+
+
+def _jit_wrap_info(call: ast.Call) -> _JitInfo | None:
+    """`jax.jit(f, ...)` / `partial(jax.jit, ...)` -> static/donate info."""
+    name = call_root(call) or ""
+    if name in ("jax.jit", "jit", "pjit", "jax.pjit"):
+        return _extract_argspec(call)
+    if name.rsplit(".", 1)[-1] == "partial" and call.args:
+        from areal_tpu.analysis.core import dotted_name
+
+        inner = dotted_name(call.args[0]) or ""
+        if inner in ("jax.jit", "jit", "jax.pjit", "pjit"):
+            return _extract_argspec(call)
+    return None
+
+
+def _extract_argspec(call: ast.Call) -> _JitInfo:
+    info = _JitInfo(line=call.lineno)
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            info.static_argnums |= _int_tuple(kw.value)
+        elif kw.arg == "static_argnames":
+            info.static_argnames |= _str_tuple(kw.value)
+        elif kw.arg == "donate_argnums":
+            info.donate_argnums |= _int_tuple(kw.value)
+        elif kw.arg == "donate_argnames":
+            info.donate_argnames |= _str_tuple(kw.value)
+    return info
+
+
+def _int_tuple(node: ast.AST) -> set:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        }
+    return set()
+
+
+def _str_tuple(node: ast.AST) -> set:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def _target_names(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for e in node.elts:
+            out += _target_names(e)
+        return out
+    return []
+
+
+def _expr_key(node: ast.AST) -> str | None:
+    """Stable textual key for a Name or dotted attribute (incl. self.*)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        inner = _expr_key(node.value)
+        return f"{inner}.{node.attr}" if inner else None
+    return None
+
+
+def analyze_jax(sf: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    module_jitted = _collect_jitted(sf.tree.body)
+
+    def walk_defs(body: list, qual: str, jitted: dict):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{qual}.{node.name}" if qual else node.name
+                inner = dict(jitted)
+                inner.update(_collect_jitted(node.body))
+                findings.extend(_analyze_function(sf, node, q, inner))
+                walk_defs(node.body, q, inner)
+            elif isinstance(node, ast.ClassDef):
+                q = f"{qual}.{node.name}" if qual else node.name
+                findings.extend(_analyze_class_alias(sf, node, q))
+                walk_defs(node.body, q, jitted)
+
+    walk_defs(sf.tree.body, "", module_jitted)
+    return findings
+
+
+def _collect_jitted(body: list) -> dict[str, _JitInfo]:
+    """name -> jit info for `f = jax.jit(g, ...)` bindings and decorated
+    defs in one statement list."""
+    out: dict[str, _JitInfo] = {}
+    for node in body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            info = _jit_wrap_info(node.value)
+            if info is not None:
+                for t in node.targets:
+                    for nm in _target_names(t):
+                        out[nm] = info
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    info = _jit_wrap_info(dec)
+                    if info is not None:
+                        out[node.name] = info
+                else:
+                    from areal_tpu.analysis.core import dotted_name
+
+                    if (dotted_name(dec) or "") in ("jax.jit", "jit"):
+                        out[node.name] = _JitInfo(line=node.lineno)
+    return out
+
+
+def _analyze_function(
+    sf: SourceFile,
+    fn: ast.FunctionDef,
+    qual: str,
+    jitted: dict[str, _JitInfo],
+) -> list[Finding]:
+    findings: list[Finding] = []
+
+    # -- scope inference: device-typed locals, stores/loads --------------
+    device_names: set[str] = set()
+    stores: dict[str, list[int]] = {}
+    loads: dict[str, list[int]] = {}
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Assign):
+            val_device = _produces_device(node.value, jitted)
+            for t in node.targets:
+                for nm in _target_names(t):
+                    stores.setdefault(nm, []).append(node.lineno)
+                    if val_device:
+                        device_names.add(nm)
+        elif isinstance(node, ast.AugAssign):
+            for nm in _target_names(node.target):
+                stores.setdefault(nm, []).append(node.lineno)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loads.setdefault(node.id, []).append(node.lineno)
+        elif isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+            key = _expr_key(node)
+            if key:
+                loads.setdefault(key, []).append(node.lineno)
+
+    def is_device(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in device_names
+        if isinstance(expr, ast.Call):
+            return _produces_device(expr, jitted)
+        if isinstance(expr, ast.Subscript):
+            return is_device(expr.value)
+        return False
+
+    # -- loop-scoped checks (AR201, AR204) -------------------------------
+    def check_call(node: ast.Call, loop_vars: set[str], in_loop: bool):
+        name = call_root(node) or ""
+        last = name.rsplit(".", 1)[-1]
+        if not in_loop:
+            pass
+        elif (
+            last == "item"
+            and isinstance(node.func, ast.Attribute)
+            and not node.args
+            and is_device(node.func.value)
+        ):
+            findings.append(
+                Finding(
+                    "AR201",
+                    sf.display,
+                    node.lineno,
+                    f"{qual}.item",
+                    ".item() on a device array inside a loop forces a "
+                    "device->host sync every iteration",
+                )
+            )
+        elif (
+            name in _HOST_SYNC_CASTS
+            and len(node.args) == 1
+            and is_device(node.args[0])
+        ):
+            key = _expr_key(node.args[0]) or name
+            findings.append(
+                Finding(
+                    "AR201",
+                    sf.display,
+                    node.lineno,
+                    f"{qual}.{key}",
+                    f"{name}() on device array '{key}' inside a loop blocks "
+                    "on the device every iteration; hoist the transfer out "
+                    "of the loop or keep the value on device",
+                )
+            )
+        elif name in _NP_CONVERTERS and node.args and is_device(node.args[0]):
+            key = _expr_key(node.args[0]) or "expr"
+            findings.append(
+                Finding(
+                    "AR201",
+                    sf.display,
+                    node.lineno,
+                    f"{qual}.{key}",
+                    f"{name}() of device array '{key}' inside a loop is an "
+                    "implicit blocking transfer every iteration",
+                )
+            )
+        info = jitted.get(name)
+        if info is not None and in_loop and loop_vars:
+            for i, arg in enumerate(node.args):
+                free = {
+                    n.id for n in ast.walk(arg) if isinstance(n, ast.Name)
+                }
+                wrapped = isinstance(arg, ast.Call) and _is_jax_call_root(
+                    call_root(arg)
+                )
+                if free & loop_vars and not wrapped:
+                    findings.append(
+                        Finding(
+                            "AR204",
+                            sf.display,
+                            node.lineno,
+                            f"{qual}.{name}.arg{i}",
+                            f"loop-varying Python value "
+                            f"{ast.unparse(arg)!r} passed to jit-compiled "
+                            f"'{name}' — each new value re-specializes the "
+                            "computation (retrace per iteration); pass a "
+                            "device array or declare it static and bucket "
+                            "it",
+                        )
+                    )
+        if info is not None:
+            for i, arg in enumerate(node.args):
+                if i in info.static_argnums and isinstance(
+                    arg, (ast.List, ast.Dict, ast.Set)
+                ):
+                    findings.append(
+                        Finding(
+                            "AR204",
+                            sf.display,
+                            node.lineno,
+                            f"{qual}.{name}.arg{i}",
+                            f"unhashable literal passed at static arg "
+                            f"position {i} of jit-compiled '{name}'",
+                        )
+                    )
+
+    def scan(node: ast.AST, loop_vars: set[str], in_loop: bool):
+        for ch in ast.iter_child_nodes(node):
+            if isinstance(
+                ch,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+            ):
+                continue
+            if isinstance(ch, ast.For):
+                scan(ch, loop_vars | set(_target_names(ch.target)), True)
+                continue
+            if isinstance(ch, ast.While):
+                scan(ch, loop_vars, True)
+                continue
+            if isinstance(ch, ast.Call):
+                check_call(ch, loop_vars, in_loop)
+            scan(ch, loop_vars, in_loop)
+
+    scan(fn, set(), False)
+
+    # -- AR202: donated buffer reuse -------------------------------------
+    for node in walk_scope(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_root(node) or ""
+        info = jitted.get(name)
+        if info is None or not (info.donate_argnums or info.donate_argnames):
+            continue
+        donated: list[tuple[str, int]] = []
+        for i, arg in enumerate(node.args):
+            if i in info.donate_argnums:
+                key = _expr_key(arg)
+                if key:
+                    donated.append((key, node.lineno))
+        for kw in node.keywords:
+            if kw.arg in info.donate_argnames:
+                key = _expr_key(kw.value)
+                if key:
+                    donated.append((key, node.lineno))
+        for key, line in donated:
+            rebinds = [ln for ln in stores.get(key, []) if ln >= line]
+            for ld in sorted(loads.get(key, [])):
+                if ld <= line:
+                    continue
+                if any(r <= ld for r in rebinds):
+                    break
+                findings.append(
+                    Finding(
+                        "AR202",
+                        sf.display,
+                        ld,
+                        f"{qual}.{key}",
+                        f"'{key}' was donated to '{name}' at line {line} "
+                        "and read afterwards — donation deletes the "
+                        "buffer (use the returned array instead)",
+                    )
+                )
+                break
+
+    # -- AR203: aliased upload then in-place mutation (same scope) -------
+    uploads: list[tuple[str, int]] = []
+    for node in walk_scope(fn):
+        if (
+            isinstance(node, ast.Call)
+            and (call_root(node) or "") == "jnp.asarray"
+            and node.args
+        ):
+            key = _expr_key(node.args[0])
+            if key and not is_device(node.args[0]):
+                uploads.append((key, node.lineno))
+    if uploads:
+        mutations = _inplace_mutations(fn)
+        for key, line in uploads:
+            later = [
+                (ln, how) for (k, ln, how) in mutations if k == key and ln > line
+            ]
+            if not later:
+                continue
+            ln, how = later[0]
+            if any(line < r <= ln for r in _name_rebinds(fn, key)):
+                continue
+            findings.append(
+                Finding(
+                    "AR203",
+                    sf.display,
+                    line,
+                    f"{qual}.{key}",
+                    f"jnp.asarray({key}) may zero-copy the host buffer, but "
+                    f"'{key}' is mutated in place at line {ln} ({how}) — "
+                    "the in-flight computation reads the mutation; upload "
+                    f"an explicit copy (jnp.asarray(np.array({key})))",
+                )
+            )
+    return findings
+
+
+def _analyze_class_alias(
+    sf: SourceFile, cls: ast.ClassDef, qual: str
+) -> list[Finding]:
+    """Cross-method AR203 for self.* attributes: an aliased upload of
+    `self.X` in one method + an in-place mutation of `self.X` in any
+    method of the same class (call order is unknowable statically)."""
+    findings: list[Finding] = []
+    uploads: list[tuple[str, int, str]] = []
+    mutations: list[tuple[str, int, str]] = []
+    for m in cls.body:
+        if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(m):
+            if (
+                isinstance(node, ast.Call)
+                and (call_root(node) or "") == "jnp.asarray"
+                and node.args
+            ):
+                key = _expr_key(node.args[0])
+                if key and key.startswith("self."):
+                    uploads.append((key, node.lineno, m.name))
+        for k, ln, how in _inplace_mutations(m):
+            if k.startswith("self."):
+                mutations.append((k, ln, how))
+    mutated = {k for k, _, _ in mutations}
+    for key, line, mname in uploads:
+        if key in mutated:
+            mline = next(ln for k, ln, _ in mutations if k == key)
+            findings.append(
+                Finding(
+                    "AR203",
+                    sf.display,
+                    line,
+                    f"{qual}.{key}",
+                    f"jnp.asarray({key}) in {mname}() may zero-copy a host "
+                    "mirror that is mutated in place elsewhere in the class "
+                    f"(e.g. line {mline}); upload an explicit copy",
+                )
+            )
+    return findings
+
+
+def _inplace_mutations(fn: ast.AST) -> list[tuple[str, int, str]]:
+    """(key, line, kind) for `X[...] =` / `X[...] op=` / `X op=` /
+    `X.fill()`-style in-place mutations within `fn` (nested defs
+    included — closures mutate enclosing-scope arrays)."""
+    out: list[tuple[str, int, str]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    key = _expr_key(t.value)
+                    if key:
+                        out.append((key, node.lineno, "subscript assign"))
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Subscript):
+                key = _expr_key(node.target.value)
+                if key:
+                    out.append((key, node.lineno, "subscript augassign"))
+            else:
+                key = _expr_key(node.target)
+                if key:
+                    out.append((key, node.lineno, "augassign"))
+        elif isinstance(node, ast.Call):
+            name = call_root(node) or ""
+            parts = name.rsplit(".", 1)
+            if len(parts) == 2 and parts[1] in _INPLACE_MUTATORS:
+                out.append((parts[0], node.lineno, f".{parts[1]}()"))
+    return out
+
+
+def _name_rebinds(fn: ast.AST, key: str) -> list[int]:
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if _expr_key(t) == key:
+                    out.append(node.lineno)
+    return out
+
+
+def _produces_device(expr: ast.AST, jitted: dict[str, _JitInfo]) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    name = call_root(expr)
+    if name is None:
+        # immediately-invoked jit: jax.jit(f)(x)
+        if isinstance(expr.func, ast.Call) and _jit_wrap_info(expr.func):
+            return True
+        return False
+    if name in jitted:
+        return True
+    if _is_jax_call_root(name):
+        last = name.rsplit(".", 1)[-1]
+        if last in ("device_get",):
+            return False
+        return True
+    return False

@@ -36,6 +36,7 @@ from areal_tpu.api.io_struct import ModelRequest, ModelResponse, WeightUpdateMet
 from areal_tpu.core.workflow_executor import WorkflowExecutor
 from areal_tpu.utils import logging, names
 from areal_tpu.utils import name_resolve
+from areal_tpu.utils.lock import OrderedLock
 from areal_tpu.utils.http import (
     arequest_with_retry,
     close_current_session,
@@ -117,13 +118,20 @@ class RemoteInfEngine(InferenceEngine):
         self.addresses: list[str] = []
         self._router: str | None = None  # cached names.rollout_router lookup
         self._router_next_lookup = 0.0  # negative-lookup cooldown clock
-        self._server_idx = 0
+        # round-robin cursor + rid affinity map, both mutated from the
+        # rollout event loop AND main-thread callers — one lock for both
+        self._server_idx = 0  # guarded-by: _rid_lock
         self._rid_to_addr: dict[str, str] = {}
-        self._rid_lock = threading.Lock()
+        self._rid_lock = OrderedLock("remote_inf._rid_lock", rank=10)
         self._version = 0
         self._executor: WorkflowExecutor | None = None
-        # weight-sync observability (client side); see get_metrics()
-        self._sync_stats = dict(
+        # weight-sync observability (client side); see get_metrics().
+        # stage_weights runs on the trainer's dcn-weight-push daemon thread
+        # (DcnWeightPush, engine/jax_engine.py) while commit_staged runs on
+        # the main thread — the stats dict needs its own guard (previously
+        # unguarded read-modify-write from two threads).
+        self._stats_lock = OrderedLock("remote_inf._stats_lock", rank=20)
+        self._sync_stats = dict(  # guarded-by: _stats_lock
             n_pushes=0,
             wire_bytes=0,
             last_push_bytes=0,
@@ -242,15 +250,18 @@ class RemoteInfEngine(InferenceEngine):
             return None
 
     def choose_server(self, rid: str | None = None) -> str:
-        if rid is not None:
-            with self._rid_lock:
+        # the whole affinity-lookup + round-robin bump sits under _rid_lock:
+        # the cursor increment was previously outside it, so concurrent
+        # callers (rollout event loop vs main thread) could lose increments
+        # and dogpile one server
+        with self._rid_lock:
+            if rid is not None:
                 cached = self._rid_to_addr.get(rid)
                 if cached is not None:
                     return cached
-        addr = self.addresses[self._server_idx % len(self.addresses)]
-        self._server_idx += 1
-        if rid is not None:
-            with self._rid_lock:
+            addr = self.addresses[self._server_idx % len(self.addresses)]
+            self._server_idx += 1
+            if rid is not None:
                 self._rid_to_addr[rid] = addr
                 if len(self._rid_to_addr) > 65536:
                     # drop oldest half to bound memory
@@ -484,14 +495,16 @@ class RemoteInfEngine(InferenceEngine):
             asyncio.run(_drain())
         except BaseException:
             stop.set()
-            self._sync_stats["aborts"] += 1
+            with self._stats_lock:
+                self._sync_stats["aborts"] += 1
             self.abort_push(push_id)
             raise
         finally:
             feeder.join(timeout=10)
-        self._sync_stats["staging_secs"] += time.monotonic() - t0
-        self._sync_stats["wire_bytes"] += n_bytes
-        self._sync_stats["last_push_bytes"] = n_bytes
+        with self._stats_lock:
+            self._sync_stats["staging_secs"] += time.monotonic() - t0
+            self._sync_stats["wire_bytes"] += n_bytes
+            self._sync_stats["last_push_bytes"] = n_bytes
         return push_id
 
     def _commit_fanout(
@@ -531,8 +544,9 @@ class RemoteInfEngine(InferenceEngine):
             self._commit_fanout(push_id, version, lora_scale)
         finally:
             self.continue_generation()
-        self._sync_stats["commit_pause_secs"] += time.monotonic() - t0
-        self._sync_stats["n_pushes"] += 1
+        with self._stats_lock:
+            self._sync_stats["commit_pause_secs"] += time.monotonic() - t0
+            self._sync_stats["n_pushes"] += 1
 
     def abort_push(self, push_id: str) -> None:
         """Drop server-side staging for a failed/abandoned push (explicit
@@ -581,14 +595,16 @@ class RemoteInfEngine(InferenceEngine):
         finally:
             self.continue_generation()
         # legacy mode: the whole push sat inside the pause window
-        self._sync_stats["commit_pause_secs"] += time.monotonic() - t0
-        self._sync_stats["n_pushes"] += 1
+        with self._stats_lock:
+            self._sync_stats["commit_pause_secs"] += time.monotonic() - t0
+            self._sync_stats["n_pushes"] += 1
 
     def get_metrics(self) -> dict:
         """Client-side weight-sync observability: push counts, wire bytes,
         staging seconds (generation live) vs commit-pause seconds (the only
         window generation actually stops)."""
-        return dict(self._sync_stats)
+        with self._stats_lock:
+            return dict(self._sync_stats)
 
     def update_weights_from_distributed(self, meta: WeightUpdateMeta, **kw):
         raise NotImplementedError(

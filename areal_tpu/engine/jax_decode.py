@@ -84,8 +84,60 @@ from areal_tpu.models.qwen2 import (
 )
 from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.utils import logging
+from areal_tpu.utils.lock import OrderedLock
 
 logger = logging.getLogger("jax_decode")
+
+# Concurrency contract, checked by areal-lint (AR101; see docs/ANALYSIS.md).
+# Attributes written from BOTH the scheduler thread and main-thread entry
+# points are serialized by the named lock — either held directly at every
+# write, or through the pause handshake that lock mediates: pause_generation
+# sets _gen_paused and acquires _sched_lock once, after which the scheduler
+# is provably parked (it re-checks the flag under the lock and drains all
+# in-flight chunks), so main-thread mutation until continue_generation() is
+# exclusive. Lock hierarchy (runtime-enforced by OrderedLock, statically by
+# AR102/AR103): _sched_lock (10) > _weight_lock (20) > _metrics_lock (30).
+_GUARDED_BY = {
+    # scheduler/slot state: mutated by the scheduler pass (under
+    # _sched_lock) and by main-thread lifecycle/pause-fenced paths
+    "JaxDecodeEngine._slots": "_sched_lock",
+    "JaxDecodeEngine._slot_lengths": "_sched_lock",
+    "JaxDecodeEngine._slot_rope_delta": "_sched_lock",
+    "JaxDecodeEngine._slot_used_freq": "_sched_lock",
+    "JaxDecodeEngine._slot_keys": "_sched_lock",
+    "JaxDecodeEngine._slot_epoch": "_sched_lock",
+    "JaxDecodeEngine._admission_seq": "_sched_lock",
+    "JaxDecodeEngine._inflight": "_sched_lock",
+    "JaxDecodeEngine._overflow": "_sched_lock",
+    "JaxDecodeEngine._parked": "_sched_lock",
+    "JaxDecodeEngine._parked_tokens": "_sched_lock",
+    "JaxDecodeEngine._prefix_lookup": "_sched_lock",
+    "JaxDecodeEngine._slot_prefix": "_sched_lock",
+    "JaxDecodeEngine._patch_slots": "_sched_lock",
+    "JaxDecodeEngine._ctl_cache": "_sched_lock",
+    "JaxDecodeEngine._ctl_dirty": "_sched_lock",
+    "JaxDecodeEngine._dev_active": "_sched_lock",
+    "JaxDecodeEngine._dev_active_host": "_sched_lock",
+    "JaxDecodeEngine._dev_table": "_sched_lock",
+    "JaxDecodeEngine._dev_table_key": "_sched_lock",
+    "JaxDecodeEngine._dev_last": "_sched_lock",
+    "JaxDecodeEngine._dev_lengths": "_sched_lock",
+    # compiled-fn caches: populated lazily by the scheduler, cleared by
+    # destroy() (thread already joined) and warmed by prewarm (pause-fenced)
+    "JaxDecodeEngine._patch_fn": "_sched_lock",
+    "JaxDecodeEngine._chunk_fns": "_sched_lock",
+    "JaxDecodeEngine._prefill_fns": "_sched_lock",
+    "JaxDecodeEngine._batched_prefill_fns": "_sched_lock",
+    "JaxDecodeEngine._fork_fns": "_sched_lock",
+    "JaxDecodeEngine._suffix_prefill_fns": "_sched_lock",
+    "JaxDecodeEngine._vision_fns": "_sched_lock",
+    "JaxDecodeEngine._embed_prefill_fns": "_sched_lock",
+    # device buffers swapped under _weight_lock at every mutation site
+    # that can race a dispatched chunk
+    "JaxDecodeEngine._k_cache": "_weight_lock",
+    "JaxDecodeEngine._v_cache": "_weight_lock",
+    "JaxDecodeEngine._freq_counts": "_weight_lock",
+}
 
 _PREFILL_BUCKET = 64
 # partial prefix sharing kicks in only when the shared history is at least
@@ -178,8 +230,15 @@ class JaxDecodeEngine(InferenceEngine):
         # in-flight chunk has finished, and the flag is re-checked under the
         # lock so no new chunk can start — a race-free handshake regardless
         # of how long the first XLA compile takes.
-        self._sched_lock = threading.Lock()
-        self._weight_lock = threading.Lock()
+        # Ranked locks (utils/lock.py OrderedLock): acquire order is
+        # _sched_lock -> _weight_lock -> _metrics_lock, enforced at runtime
+        # and statically by areal-lint AR102/AR103.
+        self._sched_lock = OrderedLock("jax_decode._sched_lock", rank=10)
+        self._weight_lock = OrderedLock("jax_decode._weight_lock", rank=20)
+        # guards the metric counters written per chunk and read by
+        # get_metrics() from the HTTP/main threads (previously unguarded:
+        # torn busy/idle reads and lost counter increments were possible)
+        self._metrics_lock = OrderedLock("jax_decode._metrics_lock", rank=30)
         self._thread: threading.Thread | None = None
         self._thread_exc: BaseException | None = None
 
@@ -218,8 +277,9 @@ class JaxDecodeEngine(InferenceEngine):
         self._n_suffix_prefills = 0  # partial-prefix hits (multi-turn)
         self._n_preemptions = 0  # pool-pressure internal requeues
         self._alloc: KVBlockAllocator | None = None  # set in initialize
-        self._gen_token_count = 0  # total consumed tokens since init
-        self._rng = None
+        self._gen_token_count = 0  # guarded-by: _metrics_lock
+        # admission counter: seeds the host-derived per-slot base keys
+        self._admission_seq = 0
         # -- run-ahead scheduler state ---------------------------------
         # Dispatched-but-unconsumed chunks, oldest first. The scheduler
         # keeps up to `decode_runahead_chunks` of these in flight on the
@@ -404,7 +464,7 @@ class JaxDecodeEngine(InferenceEngine):
         self._slots = [None] * R
         self._prefix_lookup = {}
         self._slot_prefix = [None] * R
-        self._rng = jax.random.PRNGKey(self.config.random_seed)
+        self._admission_seq = 0
         self._slot_keys = np.zeros((R, 2), dtype=np.uint32)
         self._slot_epoch = np.zeros(R, dtype=np.int64)
         self._inflight = deque()
@@ -414,17 +474,18 @@ class JaxDecodeEngine(InferenceEngine):
         self._dev_active_host = None
         self._dev_table = None
         self._dev_table_key = None
-        self._table_uploads = 0
-        self._ws_copy_bytes = 0
         self._dev_last = None
         self._dev_lengths = None
         self._patch_slots = set()
-        self._dev_busy_s = 0.0
-        self._dev_idle_s = 0.0
-        self._last_ready_t = None
-        self._chunk_itl_ms = deque(maxlen=512)
-        self._chunks_dispatched = 0
-        self._runahead_discarded = 0
+        with self._metrics_lock:
+            self._table_uploads = 0
+            self._ws_copy_bytes = 0
+            self._dev_busy_s = 0.0
+            self._dev_idle_s = 0.0
+            self._last_ready_t = None
+            self._chunk_itl_ms = deque(maxlen=512)
+            self._chunks_dispatched = 0
+            self._runahead_discarded = 0
 
         from areal_tpu.core.workflow_executor import WorkflowExecutor
 
@@ -1145,7 +1206,8 @@ class JaxDecodeEngine(InferenceEngine):
         if self._dev_table is None or self._dev_table_key != key:
             self._dev_table = jnp.asarray(self._alloc.table_slice(nb))
             self._dev_table_key = key
-            self._table_uploads += 1
+            with self._metrics_lock:
+                self._table_uploads += 1
         return self._dev_table
 
     def _get_prefill_fn(self, bucket: int):
@@ -1742,9 +1804,16 @@ class JaxDecodeEngine(InferenceEngine):
             self._slot_lengths[slot_idx] = P - 1
             self._slot_epoch[slot_idx] += 1
             # one base key per admission, in admission (FIFO) order — the
-            # key stream is identical for the sync and run-ahead schedules
-            self._rng, sub = jax.random.split(self._rng)
-            self._slot_keys[slot_idx] = np.asarray(sub, dtype=np.uint32)
+            # key stream is identical for the sync and run-ahead schedules.
+            # Derived on the HOST (SeedSequence mixing of (seed, admission
+            # index)): the old jax.random.split chain forced a blocking
+            # device round-trip per admission inside the scheduler loop
+            # (areal-lint AR201) for 8 bytes of key material.
+            seq = np.random.SeedSequence(
+                entropy=(int(self.config.random_seed), self._admission_seq)
+            )
+            self._admission_seq += 1
+            self._slot_keys[slot_idx] = seq.generate_state(2, np.uint32)
             self._mark_slot_dirty(slot_idx)
             admitted = True
         self._flush_wave(wave_pending, wave_forks)
@@ -2009,7 +2078,8 @@ class JaxDecodeEngine(InferenceEngine):
                             if not self._active_mask().any():
                                 # engine idle — gaps from here on are lack
                                 # of traffic, not scheduler overhead
-                                self._last_ready_t = None
+                                with self._metrics_lock:
+                                    self._last_ready_t = None
                         worked = dispatched or admitted or drained
                 if paused:
                     time.sleep(0.005)
@@ -2219,7 +2289,6 @@ class JaxDecodeEngine(InferenceEngine):
         # ensure / bucket choice covers this (unconsumed) chunk's growth;
         # retire rewinds overwrite this with the absolute true end
         self._slot_lengths[active] += n_chunk
-        self._chunks_dispatched += 1
         # Per-chunk KV copy accounting (surfaced via get_metrics for the
         # pagedattn bench comparison): workspace pays gather AND scatter
         # of k+v; the paged xla impl keeps only the gather (delta
@@ -2230,14 +2299,16 @@ class JaxDecodeEngine(InferenceEngine):
             else 1 if self._paged_impl == "xla"
             else 0
         )
-        if copies:
-            cfgm = self.model_config
-            self._ws_copy_bytes += (
-                copies * 2 * cfgm.num_hidden_layers * R * nb
-                * self._alloc.block_size * cfgm.num_key_value_heads
-                * cfgm.head_dim_
-                * jnp.dtype(self.config.kv_cache_dtype).itemsize
-            )
+        with self._metrics_lock:
+            self._chunks_dispatched += 1
+            if copies:
+                cfgm = self.model_config
+                self._ws_copy_bytes += (
+                    copies * 2 * cfgm.num_hidden_layers * R * nb
+                    * self._alloc.block_size * cfgm.num_key_value_heads
+                    * cfgm.head_dim_
+                    * jnp.dtype(self.config.kv_cache_dtype).itemsize
+                )
         return _Inflight(
             toks=toks,
             logps=logps,
@@ -2257,18 +2328,22 @@ class JaxDecodeEngine(InferenceEngine):
         # dispatch→ready is the device window; anything between the
         # previous chunk's ready and this dispatch is device idle (the
         # host gap the run-ahead path exists to hide)
-        if self._last_ready_t is not None and rec.t_dispatch > self._last_ready_t:
-            self._dev_idle_s += rec.t_dispatch - self._last_ready_t
-            busy_start = rec.t_dispatch
-        elif self._last_ready_t is not None:
-            busy_start = self._last_ready_t
-        else:
-            busy_start = rec.t_dispatch
-        dev_s = max(t_ready - busy_start, 0.0)
-        self._dev_busy_s += dev_s
-        self._last_ready_t = t_ready
-        per_tok_s = dev_s / max(n_chunk, 1)
-        self._chunk_itl_ms.append(per_tok_s * 1000.0)
+        with self._metrics_lock:
+            if (
+                self._last_ready_t is not None
+                and rec.t_dispatch > self._last_ready_t
+            ):
+                self._dev_idle_s += rec.t_dispatch - self._last_ready_t
+                busy_start = rec.t_dispatch
+            elif self._last_ready_t is not None:
+                busy_start = self._last_ready_t
+            else:
+                busy_start = rec.t_dispatch
+            dev_s = max(t_ready - busy_start, 0.0)
+            self._dev_busy_s += dev_s
+            self._last_ready_t = t_ready
+            per_tok_s = dev_s / max(n_chunk, 1)
+            self._chunk_itl_ms.append(per_tok_s * 1000.0)
         for i, s in enumerate(rec.items):
             if s is None or not rec.active[i]:
                 continue
@@ -2278,7 +2353,8 @@ class JaxDecodeEngine(InferenceEngine):
                 # happened (the length rewind at retire already un-claimed
                 # the KV rows). The epoch check also rejects a preempted
                 # item that re-admitted into the same slot.
-                self._runahead_discarded += n_chunk
+                with self._metrics_lock:
+                    self._runahead_discarded += n_chunk
                 continue
             if s.ttft == float("inf"):
                 s.ttft = time.monotonic() - s.start_time
@@ -2290,7 +2366,8 @@ class JaxDecodeEngine(InferenceEngine):
             self._truncate_at_stop(s)
             # consumed tokens only: tokens trimmed past a stop boundary
             # never reach the client and must not inflate throughput
-            self._gen_token_count += len(s.tokens) - n_before
+            with self._metrics_lock:
+                self._gen_token_count += len(s.tokens) - n_before
             if s.stop_reason is not None:
                 # rewind the slot length to the true end: KV rows cover
                 # prompt[:-1] plus every *consumed* token (cache positions
@@ -2967,8 +3044,19 @@ class JaxDecodeEngine(InferenceEngine):
         # next dispatch), plus honest per-token ITL percentiles over the
         # recent chunk window — dispatch→ready wall only, host work
         # excluded (the sync path used to amortize both into one number).
-        itl = np.asarray(self._chunk_itl_ms, dtype=np.float64)
-        span = self._dev_busy_s + self._dev_idle_s
+        # Snapshot under _metrics_lock: this runs on the HTTP/main thread
+        # while the scheduler mutates the counters per chunk; the lock
+        # prevents torn busy/idle pairs and mid-append deque iteration.
+        with self._metrics_lock:
+            itl = np.asarray(self._chunk_itl_ms, dtype=np.float64)
+            span = self._dev_busy_s + self._dev_idle_s
+            dev_busy_s = self._dev_busy_s
+            dev_idle_s = self._dev_idle_s
+            gen_tokens = self._gen_token_count
+            chunks_dispatched = self._chunks_dispatched
+            runahead_discarded = self._runahead_discarded
+            table_uploads = self._table_uploads
+            ws_copy_bytes = self._ws_copy_bytes
         # prefix-cache hit rate: admissions served by KV reuse (fork /
         # in-place / suffix) over all admissions that could have reused
         prefix_hits = (
@@ -2982,14 +3070,14 @@ class JaxDecodeEngine(InferenceEngine):
             "queued_requests": queued,
             "queued_tokens": queued_tokens,
             "active_tokens": active_tokens,
-            "generated_tokens_total": self._gen_token_count,
+            "generated_tokens_total": gen_tokens,
             "decode_runahead_chunks": int(self.config.decode_runahead_chunks),
-            "chunks_dispatched_total": self._chunks_dispatched,
-            "runahead_discarded_tokens_total": self._runahead_discarded,
-            "device_busy_s": round(self._dev_busy_s, 6),
-            "device_idle_s": round(self._dev_idle_s, 6),
+            "chunks_dispatched_total": chunks_dispatched,
+            "runahead_discarded_tokens_total": runahead_discarded,
+            "device_busy_s": round(dev_busy_s, 6),
+            "device_idle_s": round(dev_idle_s, 6),
             "device_idle_frac": (
-                round(self._dev_idle_s / span, 6) if span > 0 else 0.0
+                round(dev_idle_s / span, 6) if span > 0 else 0.0
             ),
             "itl_p50_ms": float(np.percentile(itl, 50)) if itl.size else 0.0,
             "itl_p99_ms": float(np.percentile(itl, 99)) if itl.size else 0.0,
@@ -3015,10 +3103,10 @@ class JaxDecodeEngine(InferenceEngine):
             ),
             # dirty-tracked block-table uploads: chunks_dispatched_total -
             # this = steady-state dispatches that skipped the copy+upload
-            "block_table_uploads_total": self._table_uploads,
+            "block_table_uploads_total": table_uploads,
             # per-chunk KV copy traffic: workspace = gather + scatter,
             # paged/xla = gather only, paged/pallas = 0 (in-pool reads)
-            "kv_workspace_copy_bytes_total": self._ws_copy_bytes,
+            "kv_workspace_copy_bytes_total": ws_copy_bytes,
             "weight_version": self._version,
             "paused": self._gen_paused.is_set(),
         }

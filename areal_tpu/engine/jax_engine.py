@@ -165,7 +165,10 @@ class DcnWeightPush:
     """Handle for an in-flight staged "dcn" weight push.
 
     `stage_fn` (bucket streaming, generation live) runs on a daemon thread
-    started at construction; the learner keeps training meanwhile. The
+    started at construction; the learner keeps training meanwhile. Anything
+    `stage_fn` touches must therefore be thread-safe against the main
+    thread — RemoteInfEngine guards its sync stats with `_stats_lock` for
+    exactly this caller (see docs/architecture.md threading model). The
     caller picks the synchronization point: `commit()` joins the staging
     thread and runs `commit_fn` — the only pause the decode fleet sees.
     A staging error surfaces at join/commit; `abort()` drops server-side

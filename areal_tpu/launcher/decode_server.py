@@ -77,9 +77,15 @@ class DecodeServer:
         self._owns_engine = engine is None
         self._runner: web.AppRunner | None = None
         self.addr: str | None = None
+        # Threading model (docs/architecture.md "Threading model and lock
+        # hierarchy"): every handler runs on ONE aiohttp event loop, so
+        # handler-local state below is single-threaded between awaits;
+        # critical sections that span an await (pause/commit windows) are
+        # serialized by _ctl_lock. areal-lint (AR101) models async handlers
+        # as one "eventloop" context for the same reason.
         # Set by /pause_generation, cleared by /continue_generation: a weight
         # update must not cancel a pause the client asked for explicitly.
-        self._client_paused = False
+        self._client_paused = False  # guarded-by: _ctl_lock
         # Serialises pause/continue/weight-swap: a /continue_generation must
         # not resume decoding in the middle of an in-flight swap, or tokens
         # from the new weights would carry the old version stamp.
@@ -87,13 +93,15 @@ class DecodeServer:
         # Buckets staged by /update_weights_from_tensor until /commit_weights.
         from areal_tpu.core.weight_transfer import WeightStaging
 
-        self._weight_staging = WeightStaging()
-        self._staging_push_id: str | None = None
-        self._staging_t0: float | None = None
-        self._last_commit_version: int | None = None
-        self._last_commit_push_id: str | None = None
-        # weight-sync observability (server side); merged into /metrics
-        self._sync_stats = dict(
+        self._weight_staging = WeightStaging()  # guarded-by: _ctl_lock
+        self._staging_push_id: str | None = None  # guarded-by: _ctl_lock
+        self._staging_t0: float | None = None  # guarded-by: _ctl_lock
+        self._last_commit_version: int | None = None  # guarded-by: _ctl_lock
+        self._last_commit_push_id: str | None = None  # guarded-by: _ctl_lock
+        # weight-sync observability (server side); merged into /metrics.
+        # /metrics reads it without _ctl_lock: the read happens between
+        # awaits on the same loop, so it observes an atomic snapshot.
+        self._sync_stats = dict(  # guarded-by: _ctl_lock
             n_pushes=0,
             wire_bytes=0,
             staging_secs=0.0,

@@ -88,9 +88,13 @@ class DecodeRouter:
         # its prompt's rid); release accounting one unit per finish
         self._qid_pending: dict[str, int] = {}
         self._versions: dict[str, int] = {}
-        self._running = 0
-        self._submitted = 0
-        self._accepted = 0
+        self._running = 0  # guarded-by: _lock
+        self._submitted = 0  # guarded-by: _lock
+        self._accepted = 0  # guarded-by: _lock
+        # One aiohttp event loop runs every handler AND _poll_loop; _lock
+        # is an asyncio.Lock making multi-field load-accounting updates
+        # atomic across the awaits inside handlers (areal-lint models all
+        # async methods as one "eventloop" context — see docs/ANALYSIS.md).
         self._lock = asyncio.Lock()
         self._runner: web.AppRunner | None = None
         self._poll_task: asyncio.Task | None = None

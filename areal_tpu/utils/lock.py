@@ -1,21 +1,141 @@
-"""Distributed mutex over the name_resolve store.
+"""Locks: in-process ranked mutexes + the distributed name_resolve mutex.
 
-Parity: areal/utils/lock.py:9 DistributedLock — the reference mutexes over a
-torch TCPStore (counter+owner keys, backoff). The TPU build has no c10d
-store; the same semantics come from name_resolve's atomic create-if-absent
-(`add(replace=False)` — link(2) on the NFS backend, etcd txn on
-create_revision==0), with a keepalive TTL so a crashed holder's lock
-self-releases instead of deadlocking the fleet.
+OrderedLock — a threading lock with a declared *rank* in a lock hierarchy.
+Acquiring a lock whose rank is <= the highest-ranked lock this thread
+already holds (in the same domain) raises LockOrderViolation instead of
+deadlocking, turning a latent lock-inversion into an immediate, attributed
+error. The static half of the contract is areal-lint's AR102/AR103
+(areal_tpu/analysis/concurrency.py): the analyzer builds the acquisition-
+order graph and checks it against these declared ranks, so inversions are
+caught before the interleaving that would trigger them at runtime. The
+decode engine's hierarchy (see docs/architecture.md):
+
+    _sched_lock (10)  >  _weight_lock (20)  >  _metrics_lock (30)
+
+(acquire strictly rank-increasing; release in any order).
+
+DistributedLock — parity: areal/utils/lock.py:9 — the reference mutexes
+over a torch TCPStore (counter+owner keys, backoff). The TPU build has no
+c10d store; the same semantics come from name_resolve's atomic
+create-if-absent (`add(replace=False)` — link(2) on the NFS backend, etcd
+txn on create_revision==0), with a keepalive TTL so a crashed holder's lock
+self-releases instead of deadlocking the fleet. It is NOT reentrant: a
+second acquire by the same holder blocks until TTL lapse (see
+tests/test_lock.py).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 import uuid
 
 from areal_tpu.utils import logging, name_resolve
 
 logger = logging.getLogger("lock")
+
+
+class LockOrderViolation(RuntimeError):
+    """Raised when a thread acquires locks against the declared rank order
+    (including re-acquiring a non-reentrant OrderedLock it already holds —
+    the same bug class, surfaced instead of deadlocking)."""
+
+
+_held_tls = threading.local()
+
+
+def _held_stack() -> list:
+    stack = getattr(_held_tls, "stack", None)
+    if stack is None:
+        stack = _held_tls.stack = []
+    return stack
+
+
+class OrderedLock:
+    """threading.Lock/RLock with a declared rank in a lock hierarchy.
+
+    Within one `domain`, every thread must acquire OrderedLocks in strictly
+    increasing rank. Violations raise LockOrderViolation at acquire time.
+    `reentrant=True` uses an RLock and permits re-acquiring the lock at the
+    top of this thread's held stack; a non-reentrant re-acquire raises
+    (instead of self-deadlocking). Locks in different domains do not
+    constrain each other — rank hierarchies are per-subsystem.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        rank: int,
+        reentrant: bool = False,
+        domain: str | None = None,
+    ):
+        self.name = name
+        self.rank = int(rank)
+        self.reentrant = reentrant
+        # default domain: the dotted prefix ("jax_decode._sched_lock" ->
+        # "jax_decode"), so one subsystem's ranks don't constrain another's
+        self.domain = domain if domain is not None else name.rsplit(".", 1)[0]
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def _check_order(self) -> None:
+        stack = _held_stack()
+        if self in stack:
+            if self.reentrant:
+                return  # re-entry of an already-held RLock is always safe
+            raise LockOrderViolation(
+                f"re-acquiring non-reentrant lock {self.name!r} "
+                "(would self-deadlock)"
+            )
+        for held in reversed(stack):
+            if held.domain != self.domain:
+                continue
+            if held.rank >= self.rank:
+                raise LockOrderViolation(
+                    f"acquiring {self.name!r} (rank {self.rank}) while "
+                    f"holding {held.name!r} (rank {held.rank}); the "
+                    f"{self.domain!r} hierarchy requires strictly "
+                    "increasing ranks"
+                )
+            break  # only the innermost same-domain lock constrains
+        return
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._check_order()
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _held_stack().append(self)
+        return ok
+
+    def release(self) -> None:
+        stack = _held_stack()
+        # remove the most recent occurrence (reentrant locks appear N times)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._lock.release()
+
+    def locked(self) -> bool:
+        if self.held_by_me():
+            return True
+        got = self._lock.acquire(blocking=False)
+        if got:
+            self._lock.release()
+            return False
+        return True
+
+    def held_by_me(self) -> bool:
+        return self in _held_stack()
+
+    def __enter__(self) -> "OrderedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"OrderedLock({self.name!r}, rank={self.rank})"
 
 
 class DistributedLock:

@@ -1,0 +1,40 @@
+"""Seeded-bad fixture: AR101 — multi-context write without a guard.
+
+`_counter` is bumped by the worker thread and reset from the public
+(main-thread) API with no lock in common and no guarded-by declaration.
+`_safe_q` must NOT fire (thread-safe type); `_locked_total` must NOT fire
+(every write site holds the same lock — implicit guard); `_fenced` must NOT
+fire (declared via the module registry).
+"""
+
+import queue
+import threading
+
+_GUARDED_BY = {
+    "Worker._fenced": "_lock",
+}
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counter = 0
+        self._locked_total = 0
+        self._fenced = 0
+        self._safe_q = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self._counter += 1  # thread context write
+            with self._lock:
+                self._locked_total += 1
+            self._fenced += 1
+            self._safe_q.put(1)
+
+    def reset(self):
+        self._counter = 0  # main context write, unguarded -> AR101
+        with self._lock:
+            self._locked_total = 0
+        self._fenced = 0
+        self._safe_q.put(0)

@@ -1,0 +1,34 @@
+"""Seeded-bad fixture: AR102 — lock acquisition-order cycle.
+
+`step_ab` acquires A then B; `step_ba` acquires B then A. Two threads
+running one each deadlock; the analyzer must report the A<->B cycle.
+The interprocedural edge (C held -> helper acquires A) must not create a
+false cycle on its own.
+"""
+
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def step_ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def step_ba(self):
+        with self._b:
+            with self._a:  # AR102: closes the cycle
+                pass
+
+    def _helper(self):
+        with self._a:
+            pass
+
+    def step_c(self):
+        with self._c:
+            self._helper()  # edge c -> a (no cycle by itself)

@@ -1,0 +1,20 @@
+"""Seeded-bad fixture: AR103 — acquisition against declared OrderedLock
+ranks. `bad` takes the rank-20 lock then the rank-10 lock (the only
+nesting in the file, so no AR102 cycle — this isolates the rank rule)."""
+
+from areal_tpu.utils.lock import OrderedLock
+
+
+class Ranked:
+    def __init__(self):
+        self._low = OrderedLock("ranked._low", rank=10)
+        self._high = OrderedLock("ranked._high", rank=20)
+
+    def uses_low(self):
+        with self._low:
+            pass
+
+    def bad(self):
+        with self._high:
+            with self._low:  # AR103: rank 20 held while taking rank 10
+                pass

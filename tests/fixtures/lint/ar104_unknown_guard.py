@@ -1,0 +1,16 @@
+"""Seeded-bad fixture: AR104 — guard declarations naming unknown locks."""
+
+import threading
+
+_GUARDED_BY = {
+    "Annotated._registry_attr": "_phantom_lock",  # AR104: no such lock
+    "NoSuchClass._x": "_lock",  # AR104: no such class
+}
+
+
+class Annotated:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ok = 0  # guarded-by: _lock
+        self._bad = 0  # guarded-by: _ghost_lock  (AR104: undeclared)
+        self._registry_attr = 0

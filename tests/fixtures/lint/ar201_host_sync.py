@@ -1,0 +1,22 @@
+"""Seeded-bad fixture: AR201 — implicit host syncs inside a step loop.
+
+Three hazard forms on device arrays inside the loop (.item(), float(),
+np.asarray()); the pre-loop conversions and the host-array float() must
+not fire.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def decode_loop(n):
+    logits = jnp.ones((8,))
+    host_before = np.asarray(logits)  # outside the loop: fine
+    total = 0.0
+    for _ in range(n):
+        x = jnp.sum(logits)
+        total += x.item()  # AR201: per-iteration sync
+        total += float(x)  # AR201
+        host = np.asarray(x)  # AR201
+        total += float(host_before[0])  # host array: fine
+    return total, host

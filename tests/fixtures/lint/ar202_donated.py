@@ -1,0 +1,27 @@
+"""Seeded-bad fixture: AR202 — reading a buffer after donating it.
+
+`bad` reads `state` after it was donated; `good` rebinds the name to the
+returned array (the standard donation pattern) and must not fire.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(state, x):
+    return state + x
+
+
+step = jax.jit(_step, donate_argnums=(0,))
+
+
+def bad():
+    state = jnp.zeros((4,))
+    new_state = step(state, jnp.ones((4,)))
+    return state + new_state  # AR202: `state` was donated
+
+
+def good():
+    state = jnp.zeros((4,))
+    state = step(state, jnp.ones((4,)))
+    return state

@@ -1,0 +1,36 @@
+"""Seeded-bad fixture: AR203 — jnp.asarray zero-copy alias of a host
+mirror that is mutated in place afterwards (the PR 3 run-ahead bug class).
+
+`upload_then_mutate` reproduces the exact local pattern; `Engine` the
+cross-method self-attribute pattern (upload in dispatch, mutation in
+retire). `safe_copy` uploads through an explicit np.array copy and must
+not fire.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def upload_then_mutate(active, n_chunk):
+    lengths = np.zeros(8, dtype=np.int32)
+    dev_lengths = jnp.asarray(lengths)  # AR203: aliases the host buffer
+    lengths[active] += n_chunk  # ... which this then mutates
+    return dev_lengths
+
+
+def safe_copy(active, n_chunk):
+    lengths = np.zeros(8, dtype=np.int32)
+    dev_lengths = jnp.asarray(np.array(lengths))  # explicit copy: fine
+    lengths[active] += n_chunk
+    return dev_lengths
+
+
+class Engine:
+    def __init__(self):
+        self._slot_lengths = np.zeros(8, dtype=np.int32)
+
+    def dispatch(self):
+        return jnp.asarray(self._slot_lengths)  # AR203 (cross-method)
+
+    def retire(self, slot):
+        self._slot_lengths[slot] = 0

@@ -1,0 +1,34 @@
+"""Seeded-bad fixture: AR204 — retrace hazards at jit call sites.
+
+`bad_loop` feeds the loop counter straight into a jit function (retrace
+per iteration); `bad_static` passes an unhashable literal at a static
+position. `good_loop` wraps the varying value in jnp.asarray (traced
+array argument — single compile) and must not fire.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _f(x, k):
+    return x * k
+
+
+step = jax.jit(_f)
+bucketed = jax.jit(_f, static_argnums=(1,))
+
+
+def bad_loop(x):
+    for i in range(16):
+        x = step(x, i)  # AR204: i re-specializes every iteration
+    return x
+
+
+def good_loop(x):
+    for i in range(16):
+        x = step(x, jnp.asarray(i))  # traced argument: fine
+    return x
+
+
+def bad_static(x):
+    return bucketed(x, [1, 2, 3])  # AR204: unhashable static arg

@@ -1,0 +1,48 @@
+"""Fixture: every violation here is suppressed by a pragma — the analyzer
+must report nothing. Exercises same-line, preceding-comment-line, and
+file-level pragma forms."""
+
+# areal-lint: disable-file=AR202
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Suppressed:
+    def __init__(self):
+        self._counter = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self._counter += 1
+
+    def reset(self):
+        self._counter = 0  # areal-lint: disable=AR101
+
+
+def loop(n):
+    x = jnp.ones(())
+    total = 0.0
+    for _ in range(n):
+        # areal-lint: disable=AR201
+        total += float(x)
+    return total
+
+
+_step = jax.jit(lambda s, v: s + v, donate_argnums=(0,))
+
+
+def donated():
+    s = jnp.zeros((2,))
+    out = _step(s, jnp.ones((2,)))
+    return s, out  # AR202 suppressed file-wide
+
+
+def alias():
+    h = np.zeros(4)
+    d = jnp.asarray(h)  # areal-lint: disable=AR203
+    h[0] = 1
+    return d

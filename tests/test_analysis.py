@@ -1,0 +1,238 @@
+"""areal-lint tier-1 suite.
+
+One test per rule against the seeded known-bad fixtures under
+tests/fixtures/lint/, the pragma/baseline semantics, the repo-wide
+clean-against-baseline gate (the acceptance invariant:
+`python -m areal_tpu.analysis areal_tpu/` exits 0), and a regression test
+reproducing the PR 3 zero-copy alias hazard pattern.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from areal_tpu.analysis import Baseline, analyze_paths
+from areal_tpu.analysis.core import RULES
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "lint"
+
+
+def _codes(findings):
+    return {f.rule for f in findings}
+
+
+def _run_fixture(name):
+    return analyze_paths([str(FIXTURES / name)])
+
+
+# -- one test per rule -------------------------------------------------------
+
+
+def test_ar101_unguarded_multi_context_write():
+    fs = _run_fixture("ar101_unguarded.py")
+    assert _codes(fs) == {"AR101"}
+    (f,) = fs
+    assert f.key == "Worker._counter"
+    # negative space: the queue attr, the lock-guarded attr and the
+    # registry-declared attr must NOT fire
+    assert "_safe_q" not in f.message
+    assert all("locked_total" not in x.key and "_fenced" not in x.key for x in fs)
+
+
+def test_ar102_lock_order_cycle():
+    fs = _run_fixture("ar102_cycle.py")
+    assert _codes(fs) == {"AR102"}
+    (f,) = fs
+    assert "Pipeline._a" in f.key and "Pipeline._b" in f.key
+
+
+def test_ar103_rank_violation():
+    fs = _run_fixture("ar103_rank.py")
+    assert _codes(fs) == {"AR103"}
+    (f,) = fs
+    assert f.key == "Ranked._high->Ranked._low"
+
+
+def test_ar104_unknown_guard():
+    fs = _run_fixture("ar104_unknown_guard.py")
+    assert _codes(fs) == {"AR104"}
+    keys = {f.key for f in fs}
+    assert keys == {
+        "Annotated._registry_attr",
+        "NoSuchClass._x",
+        "Annotated._bad",
+    }
+
+
+def test_ar201_host_sync_in_loop():
+    fs = _run_fixture("ar201_host_sync.py")
+    assert _codes(fs) == {"AR201"}
+    # .item(), float(), np.asarray() — one finding each, all inside the loop
+    assert len(fs) == 3
+    assert {f.line for f in fs} == {18, 19, 20}
+
+
+def test_ar202_donated_buffer_reuse():
+    fs = _run_fixture("ar202_donated.py")
+    assert _codes(fs) == {"AR202"}
+    (f,) = fs
+    assert f.key == "bad.state"  # good() rebinding must not fire
+
+
+def test_ar203_alias_upload():
+    fs = _run_fixture("ar203_alias.py")
+    assert _codes(fs) == {"AR203"}
+    keys = {f.key for f in fs}
+    # local pattern AND the cross-method self-attribute pattern; the
+    # explicit-copy variant must not fire
+    assert keys == {
+        "upload_then_mutate.lengths",
+        "Engine.self._slot_lengths",
+    }
+
+
+def test_ar204_retrace_hazards():
+    fs = _run_fixture("ar204_retrace.py")
+    assert _codes(fs) == {"AR204"}
+    keys = {f.key for f in fs}
+    assert keys == {"bad_loop.step.arg1", "bad_static.bucketed.arg1"}
+
+
+# -- pragma + baseline semantics --------------------------------------------
+
+
+def test_pragmas_suppress_everything():
+    assert _run_fixture("pragmas_ok.py") == []
+
+
+def test_baseline_covers_and_reports_stale(tmp_path):
+    fs = _run_fixture("ar201_host_sync.py")
+    bl = Baseline.from_findings(fs)
+    assert all(bl.covers(f) for f in fs)
+    # an entry whose finding disappeared is reported as stale
+    bl.entries.append(
+        {"file": "gone.py", "rule": "AR999", "key": "x", "justification": "j"}
+    )
+    stale = bl.unused(fs)
+    assert len(stale) == 1 and stale[0]["file"] == "gone.py"
+    # round-trips through disk
+    p = tmp_path / "bl.json"
+    bl.save(str(p))
+    assert len(Baseline.load(str(p)).entries) == len(bl.entries)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = FIXTURES / "ar201_host_sync.py"
+    env_cmd = [sys.executable, "-m", "areal_tpu.analysis"]
+    r = subprocess.run(
+        env_cmd + [str(bad), "--no-baseline"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 1
+    assert "AR201" in r.stdout
+    # --write-baseline then a rerun against it exits 0
+    bl = tmp_path / "bl.json"
+    r = subprocess.run(
+        env_cmd + [str(bad), "--baseline", str(bl), "--write-baseline"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(
+        env_cmd + [str(bad), "--baseline", str(bl)],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- repo-wide gate ----------------------------------------------------------
+
+
+def test_repo_clean_against_baseline():
+    """THE acceptance invariant: the whole package is clean against the
+    checked-in baseline. New multi-thread writes, lock inversions, or
+    hot-path hazards land here as failures with a rule code and a fix /
+    annotate / baseline decision to make."""
+    findings = analyze_paths([str(REPO / "areal_tpu")])
+    baseline = Baseline.load(str(REPO / "tools" / "lint_baseline.json"))
+    new = [f.format() for f in findings if not baseline.covers(f)]
+    assert not new, "\n".join(new)
+
+
+def test_baseline_entries_justified():
+    data = json.loads((REPO / "tools" / "lint_baseline.json").read_text())
+    for e in data["entries"]:
+        assert e.get("justification", "").strip(), f"unjustified entry {e}"
+        assert e["rule"] in RULES
+
+
+# -- PR 3 alias-hazard regression -------------------------------------------
+
+
+def test_pr3_alias_hazard_pattern_detected(tmp_path):
+    """The exact bug class PR 3 found by hand: the run-ahead dispatcher
+    uploaded `self._slot_lengths` via jnp.asarray (zero-copy on CPU), then
+    projected the host array forward in place while the dispatched chunk
+    still read the device view. The analyzer must flag the pattern; the
+    shipped fix (upload through np.array) must be clean."""
+    bug = tmp_path / "bug.py"
+    bug.write_text(
+        textwrap.dedent(
+            """
+            import jax.numpy as jnp
+            import numpy as np
+
+            class Sched:
+                def __init__(self):
+                    self._slot_lengths = np.zeros(8, np.int32)
+                    self._dev_lengths = None
+
+                def dispatch(self, active, n_chunk):
+                    self._dev_lengths = jnp.asarray(self._slot_lengths)
+                    self._slot_lengths[active] += n_chunk
+            """
+        )
+    )
+    fs = analyze_paths([str(bug)])
+    assert any(
+        f.rule == "AR203" and "self._slot_lengths" in f.key for f in fs
+    ), fs
+
+    fixed = tmp_path / "fixed.py"
+    fixed.write_text(
+        textwrap.dedent(
+            """
+            import jax.numpy as jnp
+            import numpy as np
+
+            class Sched:
+                def __init__(self):
+                    self._slot_lengths = np.zeros(8, np.int32)
+                    self._dev_lengths = None
+
+                def dispatch(self, active, n_chunk):
+                    self._dev_lengths = jnp.asarray(np.array(self._slot_lengths))
+                    self._slot_lengths[active] += n_chunk
+            """
+        )
+    )
+    assert not [f for f in analyze_paths([str(fixed)]) if f.rule == "AR203"]
+
+
+def test_fixture_rule_coverage():
+    """Every cataloged rule has at least one seeded fixture that triggers
+    it — adding a rule without a fixture fails here."""
+    all_found = set()
+    for p in sorted(FIXTURES.glob("ar*.py")):
+        all_found |= _codes(analyze_paths([str(p)]))
+    assert all_found == set(RULES), set(RULES) - all_found

@@ -1,10 +1,22 @@
-"""DistributedLock over name_resolve (parity: areal/utils/lock.py +
-areal/tests/torchrun lock test — mutual exclusion under contention)."""
+"""Direct unit tests for areal_tpu/utils/lock.py.
+
+DistributedLock (parity: areal/utils/lock.py + areal/tests/torchrun lock
+test — mutual exclusion under contention) plus the in-process OrderedLock:
+reentrancy, timeout, and the rank-ordering contract the areal-lint
+lock-order analyzer (AR102/AR103) assumes — the runtime and the static
+checker must enforce the same hierarchy rules.
+"""
 
 import threading
 import time
 
-from areal_tpu.utils.lock import DistributedLock
+import pytest
+
+from areal_tpu.utils.lock import (
+    DistributedLock,
+    LockOrderViolation,
+    OrderedLock,
+)
 from areal_tpu.utils.name_resolve import (
     MemoryNameRecordRepository,
     NfsNameRecordRepository,
@@ -87,3 +99,154 @@ print("done")
     lock.release()
     assert p1.wait(10) == 0
     assert waited > 0.15, f"should have waited for the child, waited {waited}"
+
+
+def test_distributed_lock_not_reentrant():
+    """DistributedLock is NOT reentrant: the holder's second acquire spins
+    on the existing entry until timeout (documented contract)."""
+    repo = MemoryNameRecordRepository()
+    a = DistributedLock("re", repo=repo, retry_interval=0.01)
+    assert a.acquire()
+    assert not a.acquire(timeout=0.1)
+    a.release()
+    assert a.acquire(timeout=1.0)
+    a.release()
+
+
+# -- OrderedLock: the ordering contract the lock-order analyzer assumes ----
+
+
+def test_ordered_lock_rank_order_enforced():
+    low = OrderedLock("d._low", rank=10)
+    high = OrderedLock("d._high", rank=20)
+    # declared direction: fine
+    with low:
+        with high:
+            assert high.held_by_me()
+    # inverted direction: surfaced immediately instead of deadlocking later
+    with high:
+        with pytest.raises(LockOrderViolation):
+            low.acquire()
+    # a failed acquire must not leak held-stack state
+    assert not low.held_by_me() and not high.held_by_me()
+    with low:
+        with high:
+            pass
+
+
+def test_ordered_lock_equal_rank_rejected():
+    a = OrderedLock("d._a", rank=10)
+    b = OrderedLock("d._b", rank=10)
+    with a:
+        with pytest.raises(LockOrderViolation):
+            b.acquire()
+
+
+def test_ordered_lock_domains_do_not_interact():
+    sched = OrderedLock("jax_decode._sched_lock", rank=10)
+    stats = OrderedLock("remote_inf._stats_lock", rank=5)
+    # lower rank, different domain: no constraint
+    with sched:
+        with stats:
+            pass
+
+
+def test_ordered_lock_reentrancy():
+    r = OrderedLock("d._r", rank=10, reentrant=True)
+    with r:
+        with r:  # RLock re-entry permitted
+            assert r.held_by_me()
+    assert not r.held_by_me()
+    n = OrderedLock("d._n", rank=10)
+    with n:
+        # non-reentrant re-acquire raises instead of self-deadlocking
+        with pytest.raises(LockOrderViolation):
+            n.acquire()
+    assert not n.locked()
+
+
+def test_ordered_lock_timeout_and_contention():
+    lock = OrderedLock("d._t", rank=10)
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            acquired.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert acquired.wait(5)
+    t0 = time.monotonic()
+    assert not lock.acquire(timeout=0.2)  # times out under contention
+    assert 0.15 <= time.monotonic() - t0 < 2.0
+    assert lock.locked() and not lock.held_by_me()
+    release.set()
+    assert lock.acquire(timeout=5.0)
+    lock.release()
+    t.join(5)
+
+
+def test_ordered_lock_per_thread_stacks():
+    """Held stacks are thread-local: thread B holding the high lock does
+    not constrain thread A's low->high acquisition."""
+    low = OrderedLock("d2._low", rank=10)
+    high = OrderedLock("d2._high", rank=20)
+    got_high = threading.Event()
+    done = threading.Event()
+
+    def b():
+        with high:
+            got_high.set()
+            done.wait(5)
+
+    t = threading.Thread(target=b, daemon=True)
+    t.start()
+    assert got_high.wait(5)
+    with low:  # must not raise: B's stack is not ours
+        assert not high.held_by_me()
+    done.set()
+    t.join(5)
+
+
+def test_ordered_lock_mutual_exclusion():
+    lock = OrderedLock("d._mx", rank=10)
+    counter = {"v": 0}
+
+    def worker():
+        for _ in range(200):
+            with lock:
+                v = counter["v"]
+                counter["v"] = v + 1
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter["v"] == 800
+
+
+def test_engine_hierarchy_ranks_match_analyzer_contract():
+    """The decode engine's declared hierarchy is what the static analyzer
+    checks (docs/architecture.md): _sched_lock(10) -> _weight_lock(20) ->
+    _metrics_lock(30), all in one domain."""
+    from areal_tpu.api.cli_args import JaxDecodeConfig
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+
+    eng = JaxDecodeEngine(JaxDecodeConfig())
+    assert eng._sched_lock.rank < eng._weight_lock.rank < eng._metrics_lock.rank
+    assert (
+        eng._sched_lock.domain
+        == eng._weight_lock.domain
+        == eng._metrics_lock.domain
+    )
+    # the declared direction composes; the inversion raises
+    with eng._sched_lock:
+        with eng._weight_lock:
+            with eng._metrics_lock:
+                pass
+    with eng._weight_lock:
+        with pytest.raises(LockOrderViolation):
+            eng._sched_lock.acquire()

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Pre-PR check: areal-lint (concurrency + JAX hot-path invariants) against
+# the checked-in baseline, then a bytecode compile of the whole tree.
+#
+#   tools/lint.sh            # gate: what CI / the tier-1 suite enforces
+#   tools/lint.sh --all      # also sweep bench.py, tools/ and tests/
+#                            # (informational; tests/ has known AR201s in
+#                            # oracle loops where sync cost is irrelevant)
+#
+# Run from the repo root. Exit 0 = clean.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== areal-lint (areal_tpu/ vs tools/lint_baseline.json) =="
+python -m areal_tpu.analysis areal_tpu/ --baseline tools/lint_baseline.json
+
+if [[ "${1:-}" == "--all" ]]; then
+    echo "== areal-lint sweep: bench.py tools/ (gating) =="
+    python -m areal_tpu.analysis bench.py tools/*.py --no-baseline
+    echo "== areal-lint sweep: tests/ (informational) =="
+    python -m areal_tpu.analysis tests/ --no-baseline || true
+fi
+
+echo "== compileall =="
+python -m compileall -q areal_tpu tests tools bench.py examples
+echo "lint: OK"
