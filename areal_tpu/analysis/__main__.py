@@ -83,6 +83,7 @@ def main(argv: list[str] | None = None) -> int:
     new = [f for f in findings if baseline is None or not baseline.covers(f)]
     suppressed = len(findings) - len(new)
 
+    invalid = baseline.invalid() if baseline else []
     if args.json:
         print(
             json.dumps(
@@ -90,6 +91,7 @@ def main(argv: list[str] | None = None) -> int:
                     "findings": [f.__dict__ for f in new],
                     "baselined": suppressed,
                     "total": len(findings),
+                    "invalid_baseline": [dict(e) for e in invalid],
                 }
             )
         )
@@ -102,6 +104,14 @@ def main(argv: list[str] | None = None) -> int:
                 "note: stale baseline entry "
                 f"{e.get('file')}:{e.get('rule')}:{e.get('key')} "
                 "(finding no longer fires — remove it)",
+                file=sys.stderr,
+            )
+        for e in invalid:
+            print(
+                "note: invalid baseline entry "
+                f"{e.get('file')}:{e.get('rule')}:{e.get('key')} "
+                "(justification empty or still the "
+                f"{'TODO: justify or fix'!r} placeholder — justify or fix)",
                 file=sys.stderr,
             )
         print(

@@ -99,6 +99,9 @@ class SourceFile:
         return False
 
 
+_PLACEHOLDER_JUSTIFICATION = "TODO: justify or fix"
+
+
 @dataclass
 class Baseline:
     """Checked-in list of accepted findings (false positives, justified)."""
@@ -137,6 +140,24 @@ class Baseline:
             for e in self.entries
         )
 
+    def invalid(self) -> list[dict]:
+        """Entries whose justification was never written: missing, empty /
+        whitespace-only, or still the `--write-baseline` placeholder. The
+        baseline contract is one honest sentence per accepted finding — a
+        placeholder silently waives the rule without the review the
+        justification field exists to force, so these are surfaced through
+        the same reporting channel as stale entries."""
+        out = []
+        for e in self.entries:
+            j = e.get("justification")
+            if (
+                j is None
+                or not str(j).strip()
+                or str(j).strip() == _PLACEHOLDER_JUSTIFICATION
+            ):
+                out.append(e)
+        return out
+
     def unused(self, findings: list[Finding]) -> list[dict]:
         return [
             e
@@ -157,7 +178,7 @@ class Baseline:
                     "file": f.file,
                     "rule": f.rule,
                     "key": f.key,
-                    "justification": "TODO: justify or fix",
+                    "justification": _PLACEHOLDER_JUSTIFICATION,
                 }
                 for f in sorted(findings, key=lambda x: (x.file, x.rule, x.key))
             ]

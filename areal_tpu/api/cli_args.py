@@ -321,6 +321,26 @@ class JaxDecodeConfig:
     # retires mid-run-ahead has its speculative tokens discarded and its
     # KV length rewound at the next dispatch.
     decode_runahead_chunks: int = 1
+    # Draft-free speculative decoding. "ngram": a host-side prompt-lookup
+    # drafter matches the trailing n-gram of each slot's (prompt +
+    # generated) context against its own earlier tokens and proposes up
+    # to spec_k continuation tokens; the device chunk becomes a VERIFY
+    # chunk that scores all draft positions in one forward over the paged
+    # pool and accepts the longest prefix matching what greedy/sampling
+    # would have emitted, plus the model's own bonus token. Accepted
+    # streams and logprobs are bit-identical to spec_decode="off"
+    # (fold_in(base_key, position) sampling keys are a pure function of
+    # token index); rejected draft rows are dead KV overwritten by the
+    # next write. Strong on math/code rollouts that quote their prompts
+    # (and on greedy repetition); draftless passes fall back to normal
+    # chunks, so non-repetitive workloads keep baseline throughput.
+    spec_decode: str = "off"  # "off" | "ngram"
+    # max draft tokens proposed (and verified) per chunk per slot; the
+    # verify q-width is bucketed to powers of two up to spec_k + 1
+    spec_k: int = 4
+    # longest trailing n-gram matched against the slot's earlier context
+    # (matching tries spec_ngram_max down to 1, longest match wins)
+    spec_ngram_max: int = 3
     enable_prefix_caching: bool = True
     disable_radix_cache: bool = False
     schedule_policy: str = "fcfs"

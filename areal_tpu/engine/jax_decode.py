@@ -39,6 +39,16 @@ TPU-first design:
   commits and abort_all.
 - **Sampling on device**: temperature / top-p / greedy per slot inside the
   jit; logprob of the chosen token returned per step.
+- **Draft-free speculative decoding** (`spec_decode="ngram"`): a host-side
+  prompt-lookup drafter proposes up to `spec_k` tokens per slot from the
+  request's own context; the device chunk becomes a VERIFY chunk scoring
+  all draft positions in one forward over the paged pool, accepting the
+  longest prefix matching what sampling would have emitted plus a bonus
+  token. Accepted streams and logprobs are bit-identical to
+  `spec_decode="off"` (the per-slot `fold_in(base_key, position)` keys are
+  a pure function of token index); rejected rows are dead KV reusing the
+  run-ahead retire-reconcile machinery. Draftless passes fall back to the
+  normal chunk, so non-repetitive workloads keep baseline throughput.
 
 The asyncio surface (`agenerate`) bridges to the scheduler thread with
 futures, so thousands of concurrent workflow coroutines can await
@@ -81,6 +91,8 @@ from areal_tpu.models.qwen2 import (
     decode_step,
     decode_step_paged,
     prefill,
+    verify_step,
+    verify_step_paged,
 )
 from areal_tpu.parallel import mesh as mesh_lib
 from areal_tpu.utils import logging
@@ -126,6 +138,7 @@ _GUARDED_BY = {
     # destroy() (thread already joined) and warmed by prewarm (pause-fenced)
     "JaxDecodeEngine._patch_fn": "_sched_lock",
     "JaxDecodeEngine._chunk_fns": "_sched_lock",
+    "JaxDecodeEngine._verify_fns": "_sched_lock",
     "JaxDecodeEngine._prefill_fns": "_sched_lock",
     "JaxDecodeEngine._batched_prefill_fns": "_sched_lock",
     "JaxDecodeEngine._fork_fns": "_sched_lock",
@@ -148,6 +161,93 @@ _MIN_SHARED_PREFIX = 64
 
 def _next_bucket(n: int, bucket: int = _PREFILL_BUCKET) -> int:
     return max(((n + bucket - 1) // bucket) * bucket, bucket)
+
+
+def _make_sample_fn(use_topp: bool):
+    """Per-slot sampling used by BOTH the chunked decode loop and the
+    speculative verify chunk (the verify path flattens [R, W] positions to
+    R*W rows and calls this unchanged) — one definition so the two cannot
+    drift and accepted speculative tokens stay bit-identical to the
+    non-speculative oracle.
+
+    `use_topp=False` (the common RL rollout setting, top_p == 1): plain
+    categorical over temperature-scaled logits. `use_topp=True`: top-p
+    filtering *within the top-64 candidates* (lax.top_k); top_p == 1 slots
+    co-scheduled into this variant keep the FULL distribution and sample
+    with the PRIMARY subkey, so a slot's stream never depends on which
+    variant its batchmates forced. Reported logprobs are always exact
+    log-softmax over the FULL vocab for the chosen token."""
+
+    def sample(logits, subkeys, temps, top_ps, greedy):
+        logits = logits.astype(jnp.float32)
+        logprobs_all = jax.nn.log_softmax(logits, axis=-1)
+        greedy_tok = jnp.argmax(logits, axis=-1)
+        scaled = logits / jnp.maximum(temps[:, None], 1e-6)
+        cat = jax.vmap(jax.random.categorical)  # per-slot keys
+        if use_topp:
+            k = min(64, logits.shape[-1])
+            vals, idx = jax.lax.top_k(scaled, k)
+            probs = jax.nn.softmax(vals, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = cum - probs < top_ps[:, None]
+            vals = jnp.where(keep, vals, -1e30)
+            # top_p == 1 slots sample with the PRIMARY subkey — the same
+            # key the use_topp=False variant uses — so a slot's stream
+            # does not depend on which chunk variant its batchmates
+            # forced (bit-identity across schedules); the truncated
+            # branch derives a secondary key instead
+            sub2 = jax.vmap(jax.random.fold_in)(
+                subkeys, jnp.ones(subkeys.shape[0], jnp.int32)
+            )
+            s = cat(sub2, vals)
+            sampled_topp = jnp.take_along_axis(idx, s[:, None], axis=-1)[:, 0]
+            sampled_full = cat(subkeys, scaled)
+            sampled = jnp.where(top_ps < 1.0, sampled_topp, sampled_full)
+        else:
+            sampled = cat(subkeys, scaled)
+        tok = jnp.where(greedy, greedy_tok, sampled)
+        logp = jnp.take_along_axis(logprobs_all, tok[:, None], axis=-1)[:, 0]
+        return tok, logp
+
+    return sample
+
+
+def _ngram_draft(context: list[int], k: int, ngram_max: int) -> list[int]:
+    """Prompt-lookup drafter: match the trailing n-gram of `context`
+    against its own earlier tokens and propose up to `k` continuation
+    tokens (the tokens that followed the MOST RECENT earlier occurrence).
+
+    Draft-model-free speculation (PLD / LLMA class): math and code
+    rollouts quote their prompts heavily, and repetition loops quote
+    themselves, so the request's own context is a strong cheap draft
+    source. Longest n wins (more context → better continuation); the
+    proposed span may overlap the suffix itself (self-extension — exactly
+    what makes periodic repetition fully accepted). Correctness never
+    depends on the draft: the verify chunk accepts only tokens sampling
+    would have emitted anyway.
+    """
+    n_ctx = len(context)
+    if k <= 0 or n_ctx < 2:
+        return []
+    arr = np.asarray(context, dtype=np.int64)
+    for n in range(min(int(ngram_max), n_ctx - 1), 0, -1):
+        pat = arr[n_ctx - n :]
+        n_starts = n_ctx - n  # candidate starts 0..n_ctx-n-1 (suffix excluded)
+        eq = np.ones(n_starts, dtype=bool)
+        for j in range(n):
+            eq &= arr[j : j + n_starts] == pat[j]
+        starts = np.nonzero(eq)[0]
+        if starts.size == 0:
+            continue
+        # most recent occurrence with a FULL k-token continuation if one
+        # exists (periodic contexts: an earlier period gives the whole
+        # draft), else the most recent overall (truncated continuation)
+        full = starts[starts + n + k <= n_ctx]
+        s = int(full[-1]) if full.size else int(starts[-1])
+        cont = arr[s + n : s + n + k]
+        if cont.size:
+            return cont.tolist()
+    return []
 
 
 def _pow2_bucket(n: int, lo: int = _PREFILL_BUCKET) -> int:
@@ -204,6 +304,13 @@ class _Inflight:
     version: int  # weight version the chunk was produced under
     t_dispatch: float
     n_chunk: int
+    # -- speculative verify chunks (spec_decode="ngram") ---------------
+    # spec_w > 0 marks a verify chunk of q-width spec_w (= draft bucket
+    # + 1 bonus); n_chunk == spec_w then bounds the PER-SLOT emission,
+    # the true count is accepted[i] + 1.
+    spec_w: int = 0
+    accepted: Any = None  # jax [R] accepted draft tokens per slot
+    draft_lens: np.ndarray | None = None  # [R] host draft lengths dispatched
 
 
 class JaxDecodeEngine(InferenceEngine):
@@ -332,6 +439,18 @@ class JaxDecodeEngine(InferenceEngine):
         self._chunks_dispatched = 0
         self._runahead_discarded = 0  # run-ahead tokens dropped at reconcile
         self._chunk_fns: dict[bool, Callable] = {}
+        # speculative verify-chunk variants, keyed (use_topp, nb, W)
+        self._verify_fns: dict[tuple, Callable] = {}
+        # -- speculative decoding (spec_decode="ngram") accounting -----
+        # all guarded by _metrics_lock (scheduler writes per consumed
+        # chunk; get_metrics snapshots from the HTTP/main threads)
+        self._spec_hist = np.zeros(
+            max(int(getattr(config, "spec_k", 1)), 1) + 1, dtype=np.int64
+        )  # accepted-per-chunk histogram (index = accepted draft tokens)
+        self._spec_chunk_slots = 0  # (slot, verify-chunk) pairs consumed
+        self._spec_drafted = 0  # draft tokens dispatched to verify
+        self._spec_accepted = 0  # draft tokens accepted
+        self._spec_rejected = 0  # draft tokens rejected (drafted - accepted)
         self._paged_impl = "auto"  # resolved in initialize()
         self._prefill_fns: dict[int, Callable] = {}
         self._batched_prefill_fns: dict[tuple[int, int], Callable] = {}
@@ -428,6 +547,19 @@ class JaxDecodeEngine(InferenceEngine):
         from areal_tpu.ops.paged_attention import resolve_impl
 
         self._paged_impl = resolve_impl(self.config.paged_attn_impl)
+        if self.config.spec_decode not in ("off", "ngram"):
+            raise ValueError(
+                f"spec_decode={self.config.spec_decode!r} not in "
+                "('off', 'ngram')"
+            )
+        if self.config.spec_decode == "ngram" and (
+            int(self.config.spec_k) < 1 or int(self.config.spec_ngram_max) < 1
+        ):
+            raise ValueError(
+                "spec_decode='ngram' needs spec_k >= 1 and "
+                f"spec_ngram_max >= 1 (got spec_k={self.config.spec_k}, "
+                f"spec_ngram_max={self.config.spec_ngram_max})"
+            )
         if (
             self.config.kv_layout == "paged"
             and self._paged_impl == "pallas"
@@ -486,6 +618,13 @@ class JaxDecodeEngine(InferenceEngine):
             self._chunk_itl_ms = deque(maxlen=512)
             self._chunks_dispatched = 0
             self._runahead_discarded = 0
+            self._spec_hist = np.zeros(
+                max(int(self.config.spec_k), 1) + 1, dtype=np.int64
+            )
+            self._spec_chunk_slots = 0
+            self._spec_drafted = 0
+            self._spec_accepted = 0
+            self._spec_rejected = 0
 
         from areal_tpu.core.workflow_executor import WorkflowExecutor
 
@@ -526,6 +665,7 @@ class JaxDecodeEngine(InferenceEngine):
         self._vision_fns.clear()
         self._embed_prefill_fns.clear()
         self._chunk_fns.clear()
+        self._verify_fns.clear()
         self._prefill_fns.clear()
         self._batched_prefill_fns.clear()
         self._fork_fns.clear()
@@ -866,7 +1006,7 @@ class JaxDecodeEngine(InferenceEngine):
             self.mesh, P(None, None, None, kv_axis, None)
         )
 
-    def _chunk_bucket(self, active: np.ndarray) -> int:
+    def _chunk_bucket(self, active: np.ndarray, grow: int | None = None) -> int:
         """Smallest KV bucket covering every ACTIVE slot through this
         chunk. Attention cost per decode step is O(R x S_bucket): with the
         default 32k context, short rollouts would otherwise pay full-32k
@@ -880,7 +1020,9 @@ class JaxDecodeEngine(InferenceEngine):
         unchanged, and rows past the bucket are never touched at all."""
         S = self.config.context_length
         lens = self._slot_lengths[active]
-        needed = int(lens.max()) + self.config.new_tokens_per_chunk + 1
+        if grow is None:
+            grow = self.config.new_tokens_per_chunk
+        needed = int(lens.max()) + grow + 1
         b = 256
         while b < needed:
             b *= 2
@@ -943,39 +1085,10 @@ class JaxDecodeEngine(InferenceEngine):
         paged = self.config.kv_layout == "paged"
         paged_impl = self._paged_impl
 
-        def sample(logits, subkeys, temps, top_ps, greedy):
-            logits = logits.astype(jnp.float32)
-            logprobs_all = jax.nn.log_softmax(logits, axis=-1)
-            greedy_tok = jnp.argmax(logits, axis=-1)
-            scaled = logits / jnp.maximum(temps[:, None], 1e-6)
-            cat = jax.vmap(jax.random.categorical)  # per-slot keys
-            if use_topp:
-                # Per-slot exactness: co-scheduled top_p == 1 slots keep the
-                # FULL distribution (plain categorical); only slots that
-                # asked for top-p filtering get the top-64 truncation.
-                k = min(64, logits.shape[-1])
-                vals, idx = jax.lax.top_k(scaled, k)
-                probs = jax.nn.softmax(vals, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                keep = cum - probs < top_ps[:, None]
-                vals = jnp.where(keep, vals, -1e30)
-                # top_p == 1 slots sample with the PRIMARY subkey — the same
-                # key the use_topp=False variant uses — so a slot's stream
-                # does not depend on which chunk variant its batchmates
-                # forced (bit-identity across schedules); the truncated
-                # branch derives a secondary key instead
-                sub2 = jax.vmap(jax.random.fold_in)(
-                    subkeys, jnp.ones(subkeys.shape[0], jnp.int32)
-                )
-                s = cat(sub2, vals)
-                sampled_topp = jnp.take_along_axis(idx, s[:, None], axis=-1)[:, 0]
-                sampled_full = cat(subkeys, scaled)
-                sampled = jnp.where(top_ps < 1.0, sampled_topp, sampled_full)
-            else:
-                sampled = cat(subkeys, scaled)
-            tok = jnp.where(greedy, greedy_tok, sampled)
-            logp = jnp.take_along_axis(logprobs_all, tok[:, None], axis=-1)[:, 0]
-            return tok, logp
+        # sampler shared with the speculative verify chunk (see
+        # _make_sample_fn) — per-slot exactness and the top_p==1 primary-key
+        # rule live there
+        sample = _make_sample_fn(use_topp)
 
         # ONE step body for both sampler variants AND both KV layouts:
         # use_freq / kv_layout are python-static, so the counts carry and
@@ -1137,6 +1250,148 @@ class JaxDecodeEngine(InferenceEngine):
         )
         self._chunk_fns[key_] = fn
         return fn
+
+    def _spec_draft_buckets(self) -> list[int]:
+        """Draft-width buckets a verify dispatch can pick (powers of two up
+        to spec_k, plus spec_k itself): keyed into the jit cache as
+        q-width W = bucket + 1, so the compile count stays logarithmic in
+        spec_k while short drafts avoid paying the full-width forward."""
+        k = max(int(self.config.spec_k), 1)
+        out = []
+        b = 1
+        while b < k:
+            out.append(b)
+            b *= 2
+        out.append(k)
+        return sorted(set(out))
+
+    def _get_verify_fn(self, use_topp: bool, nb: int, W: int):
+        """Speculative VERIFY chunk (spec_decode="ngram"): one forward
+        scores W = draft_bucket + 1 token positions per slot over the
+        paged pool (models/qwen2.verify_step_paged; the workspace layout
+        runs the gather → verify_step → scatter oracle), samples every
+        position with the SAME fold_in(base_key, position) keys and
+        sampler the chunked decode loop uses, and accepts the longest
+        draft prefix that matches what sampling emitted plus the model's
+        own bonus token — so accepted streams and logprobs are
+        bit-identical to the non-speculative oracle by construction.
+
+        Returns (kp, vp, last, lengths, toks [W, R], logps [W, R],
+        accepted [R]): `last`/`lengths` advance by the ACCEPTED counts on
+        device, so run-ahead chaining and the patch/rewind reconcile work
+        exactly as for normal chunks; rows written for rejected positions
+        are dead (next write at that length overwrites them, the causal
+        mask hides them until then).
+        """
+        key_ = (use_topp, nb, W)
+        if key_ in self._verify_fns:
+            return self._verify_fns[key_]
+        cfg = self.model_config
+        paged = self.config.kv_layout == "paged"
+        paged_impl = self._paged_impl
+        sample = _make_sample_fn(use_topp)
+
+        def verify_chunk(params, kp, vp, bt, last_tokens, lengths, active,
+                         base_keys, temps, top_ps, greedy, rope_delta,
+                         drafts, draft_lens):
+            R = last_tokens.shape[0]
+            tokens = jnp.concatenate([last_tokens[:, None], drafts], axis=1)
+            if paged:
+                logits, kp, vp = verify_step_paged(
+                    params, tokens, lengths, kp, vp, bt, cfg,
+                    active=active, rope_offset=rope_delta,
+                    attn_impl=paged_impl,
+                )
+            else:
+                L, _, bsz, nkv, hd = kp.shape
+                idx = bt.reshape(-1)
+                kc = jnp.take(kp, idx, axis=1).reshape(
+                    L, R, nb * bsz, nkv, hd
+                )
+                vc = jnp.take(vp, idx, axis=1).reshape(
+                    L, R, nb * bsz, nkv, hd
+                )
+                logits, kc, vc = verify_step(
+                    params, tokens, lengths, kc, vc, cfg,
+                    active=active, rope_offset=rope_delta,
+                )
+                kp = kp.at[:, idx].set(kc.reshape(L, R * nb, bsz, nkv, hd))
+                vp = vp.at[:, idx].set(vc.reshape(L, R * nb, bsz, nkv, hd))
+            V = logits.shape[-1]
+            # flatten [R, W] positions to R*W rows and reuse the chunk
+            # loop's sampler verbatim: position base+j samples with
+            # fold_in(base_key, base+j) — a pure function of token index,
+            # so the emitted stream cannot depend on speculation
+            pos = lengths[:, None] + jnp.arange(W, dtype=lengths.dtype)
+            subkeys = jax.vmap(jax.random.fold_in)(
+                jnp.repeat(base_keys, W, axis=0), pos.reshape(-1)
+            )
+            tok, logp = sample(
+                logits.reshape(R * W, V), subkeys,
+                jnp.repeat(temps, W), jnp.repeat(top_ps, W),
+                jnp.repeat(greedy, W),
+            )
+            tok = tok.reshape(R, W)
+            logp = logp.reshape(R, W)
+            # accepted prefix: position j's sample must equal the draft
+            # token the forward already consumed at position j+1
+            steps = jnp.arange(W - 1, dtype=draft_lens.dtype)
+            match = (tok[:, :-1] == drafts) & (
+                steps[None, :] < draft_lens[:, None]
+            )
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+            emit = jnp.where(active, acc + 1, 0).astype(lengths.dtype)
+            bonus = jnp.take_along_axis(tok, acc[:, None], axis=1)[:, 0]
+            last_out = jnp.where(active, bonus, last_tokens)
+            return (
+                kp, vp, last_out, lengths + emit, tok.T, logp.T,
+                acc * active.astype(acc.dtype),
+            )
+
+        fn = jax.jit(verify_chunk, donate_argnums=(1, 2))
+        self._verify_fns[key_] = fn
+        return fn
+
+    def _draft_all(
+        self, active: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Host-side drafting pass: per active slot, prompt-lookup up to
+        spec_k continuation tokens from the slot's own (host-known)
+        context. Draft lengths are capped by the context-length horizon
+        and the slot's max_new_tokens remainder — both against the
+        run-ahead PROJECTED length, which only over-caps (a draft may be
+        shorter than strictly necessary, never write past the horizon).
+        Under run-ahead the context can lag the device by the unconsumed
+        chunks' tokens; a stale draft costs acceptance, never correctness
+        (the verify chunk accepts only what sampling emits anyway)."""
+        R = self.config.max_running_requests
+        k_max = int(self.config.spec_k)
+        ngram_max = int(self.config.spec_ngram_max)
+        S = self.config.context_length
+        drafts = np.zeros((R, k_max), dtype=np.int32)
+        dlens = np.zeros(R, dtype=np.int32)
+        for i in np.nonzero(active)[0]:
+            s = self._slots[i]
+            if s is None:
+                continue
+            # +1 for the bonus token the verify chunk always emits; the
+            # horizon cap keeps every write position < context_length
+            cap = min(
+                k_max,
+                S - 1 - int(self._slot_lengths[i]) - 1,
+                s.gconfig.max_new_tokens
+                - (int(self._slot_lengths[i]) - (len(s.prompt) - 1))
+                - 1,
+            )
+            if cap <= 0:
+                continue
+            d = _ngram_draft(
+                list(s.prompt) + list(s.tokens), cap, ngram_max
+            )
+            if d:
+                dlens[i] = len(d)
+                drafts[i, : len(d)] = d
+        return drafts, dlens
 
     def _get_patch_fn(self):
         """Override selected slots of the device-chained (last, lengths)
@@ -2129,6 +2384,22 @@ class JaxDecodeEngine(InferenceEngine):
         R = self.config.max_running_requests
         n_chunk = self.config.new_tokens_per_chunk
         S = self.config.context_length
+        spec_on = self.config.spec_decode == "ngram"
+        if spec_on and self._inflight:
+            # Draft freshness under run-ahead: chunks whose results already
+            # landed are consumed for free (device idle either way), so the
+            # drafter matches against the true context instead of a
+            # chunk-stale one. Chunks still in flight are left alone — a
+            # stale draft costs acceptance, never correctness, and blocking
+            # here would forfeit the overlap run-ahead exists for.
+            while self._inflight:
+                ready = getattr(self._inflight[0].toks, "is_ready", None)
+                if ready is None or not ready():
+                    break
+                self._consume_chunk(self._inflight.popleft())
+            active = active & self._active_mask()
+            if not active.any():
+                return None
         # Saturation mask: a slot whose full max_new_tokens output is
         # already covered by dispatched (possibly unconsumed) chunks gets
         # nothing from another chunk — masking it out skips the run-ahead
@@ -2146,6 +2417,38 @@ class JaxDecodeEngine(InferenceEngine):
                 active[i] = False
         if not active.any():
             return None
+        use_topp = bool(
+            any(
+                s is not None and not s.gconfig.greedy and s.gconfig.top_p < 1.0
+                for s in self._slots
+            )
+        )
+        use_freq = bool(
+            any(
+                s is not None and s.gconfig.frequency_penalty != 0.0
+                for s in self._slots
+            )
+        )
+        # Speculative drafting (spec_decode="ngram"): a verify chunk is
+        # dispatched only when some slot actually produced a draft — a
+        # draftless pass falls back to the normal n_chunk-deep chunk, so
+        # non-repetitive workloads keep baseline throughput. Frequency-
+        # penalty batches also fall back: the oracle's penalty counts
+        # evolve token-by-token WITHIN a chunk, which a one-forward verify
+        # cannot reproduce bit-exactly.
+        spec_w = 0
+        drafts_np = dlens_np = None
+        if spec_on and not use_freq:
+            drafts_np, dlens_np = self._draft_all(active)
+            max_d = int(dlens_np.max()) if dlens_np.size else 0
+            if max_d > 0:
+                b = 1
+                while b < max_d:
+                    b *= 2
+                b = min(b, int(self.config.spec_k))
+                spec_w = b + 1
+                drafts_np = drafts_np[:, :b]
+        grow = spec_w if spec_w else n_chunk
         # Every active slot needs blocks through this chunk's growth
         # (self._slot_lengths already projects all dispatched chunks).
         # Shortest-first so pool pressure preempts as few slots as
@@ -2160,7 +2463,7 @@ class JaxDecodeEngine(InferenceEngine):
         for i in order:
             if i in preempted:
                 continue
-            need = min(int(self._slot_lengths[i]) + n_chunk + 1, S)
+            need = min(int(self._slot_lengths[i]) + grow + 1, S)
             while not self._ensure_tokens(i, need):
                 victims = [
                     j
@@ -2212,18 +2515,6 @@ class JaxDecodeEngine(InferenceEngine):
                 jnp.asarray(np.array(self._slot_lengths)),  # no-alias copy
             )
             self._patch_slots.clear()
-        use_topp = bool(
-            any(
-                s is not None and not s.gconfig.greedy and s.gconfig.top_p < 1.0
-                for s in self._slots
-            )
-        )
-        use_freq = bool(
-            any(
-                s is not None and s.gconfig.frequency_penalty != 0.0
-                for s in self._slots
-            )
-        )
         ctl = self._refresh_ctl()
         # the effective (saturation-refined) active mask gets its own
         # cached device buffer: it changes only when a slot joins, leaves,
@@ -2233,10 +2524,80 @@ class JaxDecodeEngine(InferenceEngine):
         ):
             self._dev_active_host = active.copy()
             self._dev_active = jnp.asarray(active.copy())
-        s_bucket = self._chunk_bucket(active)
+        s_bucket = self._chunk_bucket(active, grow)
         nb = -(-s_bucket // self._alloc.block_size)
-        chunk_fn = self._get_chunk_fn(use_topp, use_freq, nb)
         version_at_chunk = self._version
+        accepted = None
+        if spec_w:
+            verify_fn = self._get_verify_fn(use_topp, nb, spec_w)
+            t_dispatch = time.monotonic()
+            with self._weight_lock:
+                (
+                    self._k_cache,
+                    self._v_cache,
+                    self._dev_last,
+                    self._dev_lengths,
+                    toks,
+                    logps,
+                    accepted,
+                ) = verify_fn(
+                    self.params,
+                    self._k_cache,
+                    self._v_cache,
+                    self._table_device(nb),
+                    self._dev_last,
+                    self._dev_lengths,
+                    self._dev_active,
+                    ctl["base_keys"],
+                    ctl["temps"],
+                    ctl["top_ps"],
+                    ctl["greedy"],
+                    ctl["rope_delta"],
+                    jnp.asarray(drafts_np),  # fresh per-dispatch, no alias
+                    jnp.asarray(dlens_np),
+                )
+            for arr in (toks, logps, accepted):
+                copy_async = getattr(arr, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
+            # worst-case projection: the verify can emit up to spec_w
+            # tokens per slot; _consume_chunk reconciles the difference
+            # (spec_w - accepted - 1) back out, and retire rewinds set the
+            # absolute end as for normal chunks
+            self._slot_lengths[active] += spec_w
+            # same per-chunk KV copy accounting as the normal chunk: the
+            # workspace verify gathers + scatters its blocks, the paged
+            # xla verify gathers (inside each layer's attention — same
+            # total bytes), the Pallas verify reads in place
+            copies = (
+                2 if self.config.kv_layout == "workspace"
+                else 1 if self._paged_impl == "xla"
+                else 0
+            )
+            with self._metrics_lock:
+                self._chunks_dispatched += 1
+                if copies:
+                    cfgm = self.model_config
+                    self._ws_copy_bytes += (
+                        copies * 2 * cfgm.num_hidden_layers * R * nb
+                        * self._alloc.block_size * cfgm.num_key_value_heads
+                        * cfgm.head_dim_
+                        * jnp.dtype(self.config.kv_cache_dtype).itemsize
+                    )
+            return _Inflight(
+                toks=toks,
+                logps=logps,
+                items=list(self._slots),
+                active=active.copy(),
+                epochs=self._slot_epoch.copy(),
+                version=version_at_chunk,
+                t_dispatch=t_dispatch,
+                n_chunk=spec_w,
+                spec_w=spec_w,
+                accepted=accepted,
+                draft_lens=dlens_np,
+            )
+        chunk_fn = self._get_chunk_fn(use_topp, use_freq, nb)
         t_dispatch = time.monotonic()
         with self._weight_lock:
             args = [
@@ -2323,6 +2684,8 @@ class JaxDecodeEngine(InferenceEngine):
     def _consume_chunk(self, rec: "_Inflight") -> None:
         toks = np.asarray(rec.toks)  # [n_chunk, R]
         logps = np.asarray(rec.logps)
+        spec = rec.spec_w > 0
+        acc = np.asarray(rec.accepted) if spec else None
         t_ready = time.monotonic()
         n_chunk = rec.n_chunk
         # dispatch→ready is the device window; anything between the
@@ -2342,8 +2705,7 @@ class JaxDecodeEngine(InferenceEngine):
             dev_s = max(t_ready - busy_start, 0.0)
             self._dev_busy_s += dev_s
             self._last_ready_t = t_ready
-            per_tok_s = dev_s / max(n_chunk, 1)
-            self._chunk_itl_ms.append(per_tok_s * 1000.0)
+        emitted_counts: list[int] = []
         for i, s in enumerate(rec.items):
             if s is None or not rec.active[i]:
                 continue
@@ -2354,15 +2716,36 @@ class JaxDecodeEngine(InferenceEngine):
                 # the KV rows). The epoch check also rejects a preempted
                 # item that re-admitted into the same slot.
                 with self._metrics_lock:
-                    self._runahead_discarded += n_chunk
+                    self._runahead_discarded += (
+                        int(acc[i]) + 1 if spec else n_chunk
+                    )
                 continue
+            # a verify chunk emits only the accepted draft prefix plus the
+            # bonus token; a normal chunk emits its full depth
+            e = int(acc[i]) + 1 if spec else n_chunk
+            emitted_counts.append(e)
+            if spec:
+                # reconcile the dispatch's worst-case length projection
+                # (+spec_w) down to what the slot actually emitted
+                self._slot_lengths[i] -= n_chunk - e
+                d = int(rec.draft_lens[i])
+                with self._metrics_lock:
+                    self._spec_chunk_slots += 1
+                    self._spec_hist[min(int(acc[i]), len(self._spec_hist) - 1)] += 1
+                    self._spec_drafted += d
+                    self._spec_accepted += int(acc[i])
+                    self._spec_rejected += d - int(acc[i])
             if s.ttft == float("inf"):
                 s.ttft = time.monotonic() - s.start_time
             n_before = len(s.tokens)
-            s.tokens.extend(toks[:, i].tolist())
-            s.logprobs.extend(logps[:, i].tolist())
-            s.versions.extend([rec.version] * n_chunk)
-            s.itl.extend([per_tok_s] * n_chunk)
+            s.tokens.extend(toks[:e, i].tolist())
+            s.logprobs.extend(logps[:e, i].tolist())
+            s.versions.extend([rec.version] * e)
+            # honest per-token ITL: the device window divided by tokens
+            # actually emitted for THIS slot (accepted + bonus), not the
+            # dispatched draft width — a verify chunk that emitted 2 of 8
+            # dispatched positions really delivered 2 tokens in dev_s
+            s.itl.extend([dev_s / max(e, 1)] * e)
             self._truncate_at_stop(s)
             # consumed tokens only: tokens trimmed past a stop boundary
             # never reach the client and must not inflate throughput
@@ -2374,6 +2757,17 @@ class JaxDecodeEngine(InferenceEngine):
                 # past it are never attended again before overwrite)
                 self._slot_lengths[i] = len(s.prompt) - 1 + len(s.tokens)
                 self._retire(i)
+        # chunk-level ITL sample: device window over the MEAN tokens a
+        # surviving slot emitted (== n_chunk for normal chunks; accepted+1
+        # for verify chunks — dividing by the dispatched draft width would
+        # understate spec ITL by the rejection rate)
+        with self._metrics_lock:
+            mean_e = (
+                sum(emitted_counts) / len(emitted_counts)
+                if emitted_counts
+                else float(max(n_chunk, 1))
+            )
+            self._chunk_itl_ms.append(dev_s / max(mean_e, 1e-9) * 1000.0)
 
     # -- InferenceEngine surface ---------------------------------------
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
@@ -2635,17 +3029,23 @@ class JaxDecodeEngine(InferenceEngine):
         )
         return dt
 
-    def _expected_chunk_buckets(self, prompt_len: int, new_tokens: int) -> list[int]:
+    def _expected_chunk_buckets(
+        self, prompt_len: int, new_tokens: int, grow: int | None = None
+    ) -> list[int]:
         """KV buckets `_chunk_bucket` will select as a request grows from
-        `prompt_len` through `prompt_len + new_tokens`."""
+        `prompt_len` through `prompt_len + new_tokens`. `grow` overrides
+        the per-dispatch growth horizon (verify chunks grow by their
+        q-width, which can exceed new_tokens_per_chunk)."""
         S = self.config.context_length
         n_chunk = self.config.new_tokens_per_chunk
+        if grow is None:
+            grow = n_chunk
         out: set[int] = set()
         length = max(prompt_len - 1, 0)
         end = min(prompt_len - 1 + new_tokens, S)
         while True:
             b = 256
-            while b < length + n_chunk + 1:
+            while b < length + grow + 1:
                 b *= 2
             out.add(min(b, S))
             if length >= end:
@@ -2712,6 +3112,50 @@ class JaxDecodeEngine(InferenceEngine):
                                 "at this bucket will hit a first-compile "
                                 "stall"
                             )
+                if self.config.spec_decode == "ngram":
+                    # the verify chunk is keyed on the q-width bucket too:
+                    # every (draft bucket x sampler class x nb) the drafter
+                    # can select must be compiled, or the first drafted
+                    # dispatch traces mid-stream. Buckets recomputed with
+                    # the verify growth horizon — the q-width can exceed
+                    # new_tokens_per_chunk near a bucket boundary.
+                    spec_k = int(self.config.spec_k)
+                    spec_buckets = self._expected_chunk_buckets(
+                        prompt_len, new_tokens, grow=spec_k + 1
+                    )
+                    for b in spec_buckets:
+                        nb = -(-b // self._alloc.block_size)
+                        for use_topp in classes:
+                            for db in self._spec_draft_buckets():
+                                W = db + 1
+                                if (use_topp, nb, W) in self._verify_fns:
+                                    continue
+                                layout = self.config.kv_layout
+                                spec_desc = (
+                                    f"spec_decode=ngram spec_k={spec_k} "
+                                    f"{layout} verify variant (W={W}, "
+                                    f"top_p<1={use_topp}, nb={nb})"
+                                )
+                                if nb > self._alloc.max_blocks_per_slot:
+                                    logger.warning(
+                                        f"prewarm: {spec_desc} skipped — "
+                                        "exceeds the pool's "
+                                        "max_blocks_per_slot="
+                                        f"{self._alloc.max_blocks_per_slot};"
+                                        " a live verify dispatch at this "
+                                        "bucket will hit a first-compile "
+                                        "stall"
+                                    )
+                                    continue
+                                try:
+                                    self._ghost_verify(use_topp, nb, W)
+                                except Exception as e:  # noqa: BLE001
+                                    logger.warning(
+                                        f"prewarm: {spec_desc} skipped — "
+                                        f"ghost compile failed: {e}; live "
+                                        "traffic at this bucket will hit "
+                                        "a first-compile stall"
+                                    )
         finally:
             self.continue_generation()
 
@@ -2750,6 +3194,41 @@ class JaxDecodeEngine(InferenceEngine):
                 ctl["top_ps"],
                 ctl["greedy"],
                 ctl["rope_delta"],
+            )
+
+    def _ghost_verify(self, use_topp: bool, nb: int, W: int) -> None:
+        """Dispatch one VERIFY chunk with every slot inactive: same
+        engine-state-preserving contract as `_ghost_chunk` (paged writes
+        park in the reserved null block 0, the workspace verify write
+        rounds inactive rows through unchanged), only the jit variant's
+        compile happens."""
+        R = self.config.max_running_requests
+        verify_fn = self._get_verify_fn(use_topp, nb, W)
+        ctl = self._refresh_ctl()
+        with self._weight_lock:
+            (
+                self._k_cache,
+                self._v_cache,
+                self._dev_last,
+                self._dev_lengths,
+                _toks,
+                _logps,
+                _acc,
+            ) = verify_fn(
+                self.params,
+                self._k_cache,
+                self._v_cache,
+                self._table_device(nb),
+                self._dev_last,
+                self._dev_lengths,
+                jnp.zeros(R, dtype=bool),
+                ctl["base_keys"],
+                ctl["temps"],
+                ctl["top_ps"],
+                ctl["greedy"],
+                ctl["rope_delta"],
+                jnp.zeros((R, W - 1), dtype=jnp.int32),
+                jnp.zeros(R, dtype=jnp.int32),
             )
 
     def _warn_wave_not_compiled(self, bucket: int, w: int) -> None:
@@ -3057,6 +3536,11 @@ class JaxDecodeEngine(InferenceEngine):
             runahead_discarded = self._runahead_discarded
             table_uploads = self._table_uploads
             ws_copy_bytes = self._ws_copy_bytes
+            spec_hist = self._spec_hist.copy()
+            spec_chunk_slots = self._spec_chunk_slots
+            spec_drafted = self._spec_drafted
+            spec_accepted = self._spec_accepted
+            spec_rejected = self._spec_rejected
         # prefix-cache hit rate: admissions served by KV reuse (fork /
         # in-place / suffix) over all admissions that could have reused
         prefix_hits = (
@@ -3107,6 +3591,31 @@ class JaxDecodeEngine(InferenceEngine):
             # per-chunk KV copy traffic: workspace = gather + scatter,
             # paged/xla = gather only, paged/pallas = 0 (in-pool reads)
             "kv_workspace_copy_bytes_total": ws_copy_bytes,
+            # speculative decoding (spec_decode="ngram"): histogram of
+            # accepted draft tokens per (slot, verify chunk), draft hit
+            # rate, and the rejected-token waste — the knobs-vs-payoff
+            # surface for tuning spec_k / spec_ngram_max
+            "spec_decode": self.config.spec_decode,
+            "spec_chunks_total": spec_chunk_slots,
+            "spec_accepted_per_chunk": {
+                str(n): int(c) for n, c in enumerate(spec_hist)
+            },
+            "spec_accepted_per_chunk_mean": (
+                round(spec_accepted / spec_chunk_slots, 6)
+                if spec_chunk_slots
+                else 0.0
+            ),
+            # emitted = accepted + the bonus token every verify chunk adds
+            "spec_emitted_per_chunk_mean": (
+                round(spec_accepted / spec_chunk_slots + 1.0, 6)
+                if spec_chunk_slots
+                else 0.0
+            ),
+            "spec_draft_hit_rate": (
+                round(spec_accepted / spec_drafted, 6) if spec_drafted else 0.0
+            ),
+            "spec_drafted_tokens_total": spec_drafted,
+            "spec_rejected_tokens_total": spec_rejected,
             "weight_version": self._version,
             "paused": self._gen_paused.is_set(),
         }

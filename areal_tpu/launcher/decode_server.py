@@ -124,6 +124,9 @@ class DecodeServer:
                 "decode_runahead_chunks": self.config.decode_runahead_chunks,
                 "kv_layout": self.config.kv_layout,
                 "paged_attn_impl": self.config.paged_attn_impl,
+                "spec_decode": self.config.spec_decode,
+                "spec_k": self.config.spec_k,
+                "spec_ngram_max": self.config.spec_ngram_max,
                 "version": self.engine.get_version(),
             }
         )
@@ -452,6 +455,9 @@ async def _serve(args: argparse.Namespace) -> None:
         decode_runahead_chunks=args.decode_runahead_chunks,
         kv_layout=args.kv_layout,
         paged_attn_impl=args.paged_attn_impl,
+        spec_decode=args.spec_decode,
+        spec_k=args.spec_k,
+        spec_ngram_max=args.spec_ngram_max,
         random_seed=args.seed,
         tensor_parallel_size=args.tp_size,
     )
@@ -550,6 +556,28 @@ def main(argv: list[str] | None = None) -> None:
         help="kernel for the in-pool attention read: 'pallas' (TPU "
              "split-KV flash-decode; needs page_size %% 128 == 0), 'xla' "
              "(gather-per-block fallback), 'auto' picks per backend",
+    )
+    p.add_argument(
+        "--spec-decode",
+        default="off",
+        choices=["off", "ngram"],
+        help="draft-free speculative decoding: 'ngram' drafts from each "
+             "request's own context (prompt lookup) and verifies all "
+             "draft positions in one chunk — token streams and logprobs "
+             "stay bit-identical to 'off'",
+    )
+    p.add_argument(
+        "--spec-k",
+        type=int,
+        default=4,
+        help="max draft tokens proposed (and verified) per chunk per slot",
+    )
+    p.add_argument(
+        "--spec-ngram-max",
+        type=int,
+        default=3,
+        help="longest trailing n-gram matched against the request's own "
+             "earlier context when drafting",
     )
     p.add_argument(
         "--tp-size",

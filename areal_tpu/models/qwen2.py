@@ -2079,3 +2079,239 @@ def decode_step_paged(
     else:
         logits = jnp.einsum("rh,hv->rv", x, params["lm_head"]["kernel"])
     return logits.astype(jnp.float32), k_pool, v_pool
+
+
+def verify_step(
+    params: dict,
+    tokens: jax.Array,  # [R, W]: draft inputs, column 0 = the last token
+    positions0: jax.Array,  # [R] base index column 0 occupies
+    k_cache: jax.Array,  # [L, R, S, nKV, hd]
+    v_cache: jax.Array,  # [L, R, S, nKV, hd]
+    cfg: ModelConfig,
+    active: jax.Array | None = None,  # [R] bool
+    rope_offset: jax.Array | None = None,  # [R] added to rope pos only
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative VERIFY step over the workspace cache: score W token
+    positions per slot in ONE forward (q_len = W self-extension) instead
+    of W sequential `decode_step`s.
+
+    Column j of `tokens` sits at position `positions0 + j`; its KV row is
+    written there and its logits predict the token at the NEXT position —
+    exactly what `decode_step` would have produced had it been fed the
+    same inputs one at a time (the bit-parity contract the engine's
+    speculative accept relies on; tests/test_spec_decode.py pins it).
+    Rejected positions' rows are simply dead: the next write at that
+    position overwrites them, and the causal mask (`s <= position`) hides
+    them from every query that matters before then. Returns
+    (logits [R, W, V] f32, k_cache, v_cache).
+    """
+    from areal_tpu.ops.chunked_attention import verify_attention
+
+    compute_dtype = jnp.dtype(cfg.dtype)
+    R, W = tokens.shape
+    S = k_cache.shape[2]
+    nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    positions = positions0[:, None] + jnp.arange(W, dtype=positions0.dtype)
+    flat_pos = positions.reshape(-1)  # [R*W]
+    x = _scale_embed(
+        params["embed"]["embedding"][tokens.reshape(-1)].astype(compute_dtype),
+        cfg,
+    )  # [R*W, H]
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"]["embedding"][flat_pos].astype(
+            compute_dtype
+        )
+    rope_pos = (
+        positions if rope_offset is None else positions + rope_offset[:, None]
+    ).reshape(-1)
+    cos, sin = rope_table(rope_pos, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)
+    # per-query causal horizon over the slot's rows
+    valid = jnp.arange(S)[None, None, :] <= positions[:, :, None]  # [R, W, S]
+    if cfg.sliding_window is not None:
+        valid = valid & (
+            jnp.arange(S)[None, None, :]
+            > positions[:, :, None] - cfg.sliding_window
+        )
+    pos_c = jnp.clip(positions, 0, S - 1)
+    row_idx = jnp.arange(R)[:, None]
+    active_flat = (
+        None if active is None else jnp.repeat(active, W, axis=0)
+    )
+
+    def write(cache_l, new):  # [R, S, nKV, hd] <- [R*W, nKV, hd]
+        new_r = new.reshape(R, W, nKV, hd)
+        if active is not None:
+            # inactive slots (and stale positions) must round-trip their
+            # rows unchanged — same guarantee decode_step's masked one-hot
+            # write gives retired donors and parked KV
+            old = jnp.take_along_axis(
+                cache_l, pos_c[..., None, None], axis=1
+            )
+            new_r = jnp.where(active[:, None, None, None], new_r, old)
+        return cache_l.at[row_idx, pos_c].set(new_r)
+
+    def layer(x, inputs):
+        layer_p, kc, vc = inputs
+        h = _norm(x, layer_p["input_norm"], cfg, layer_p.get("input_norm_bias"))
+        q, k_new, v_new = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
+        kc = write(kc, k_new.astype(kc.dtype))
+        vc = write(vc, v_new.astype(vc.dtype))
+        attn_out = verify_attention(
+            q.reshape(R, W, nH, hd), kc.astype(q.dtype), vc.astype(q.dtype),
+            valid,
+        ).reshape(R * W, nH, hd)
+        proj = jnp.einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"])
+        if cfg.attn_out_bias:
+            proj = proj + layer_p["attn"]["o_bias"]
+        x = x + proj
+        h = _norm(x, layer_p["post_attn_norm"], cfg, layer_p.get("post_attn_norm_bias"))
+        if cfg.num_experts:
+            y, _ = moe_mlp(layer_p["mlp"], h, cfg, valid=active_flat)
+        else:
+            y = mlp(layer_p["mlp"], h, cfg)
+        x = x + y
+        return x, (kc, vc)
+
+    if cfg.scan_layers:
+        x, (k_cache, v_cache) = jax.lax.scan(
+            layer, x, (params["layers"], k_cache, v_cache)
+        )
+    else:
+        kcs, vcs = [], []
+        for i in range(cfg.num_hidden_layers):
+            x, (kc, vc) = layer(
+                x, (params[f"layers_{i}"], k_cache[i], v_cache[i])
+            )
+            kcs.append(kc)
+            vcs.append(vc)
+        k_cache, v_cache = jnp.stack(kcs), jnp.stack(vcs)
+
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum(
+            "th,vh->tv", x, params["embed"]["embedding"].astype(compute_dtype)
+        )
+    else:
+        logits = jnp.einsum("th,hv->tv", x, params["lm_head"]["kernel"])
+    return (
+        logits.astype(jnp.float32).reshape(R, W, -1),
+        k_cache,
+        v_cache,
+    )
+
+
+def verify_step_paged(
+    params: dict,
+    tokens: jax.Array,  # [R, W]: draft inputs, column 0 = the last token
+    positions0: jax.Array,  # [R] base index column 0 occupies
+    k_pool: jax.Array,  # [L, n_blocks, bsz, nKV, hd]
+    v_pool: jax.Array,  # [L, n_blocks, bsz, nKV, hd]
+    block_tables: jax.Array,  # [R, nb]
+    cfg: ModelConfig,
+    active: jax.Array | None = None,
+    rope_offset: jax.Array | None = None,
+    attn_impl: str = "auto",
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The in-pool twin of `verify_step` (see its contract): W positions
+    per slot scored in one forward DIRECTLY over the paged pool. The KV
+    write is an O(W) row scatter through the block table (inactive slots
+    redirect to the reserved null block 0, like `decode_step_paged`), and
+    attention reads through the table with per-query causal masks
+    (ops/paged_attention.paged_attention_qlen — the Pallas impl DMAs each
+    pool block once for all W queries)."""
+    from areal_tpu.ops.paged_attention import paged_attention_qlen
+
+    compute_dtype = jnp.dtype(cfg.dtype)
+    R, W = tokens.shape
+    bsz = k_pool.shape[2]
+    nb = block_tables.shape[1]
+    span = nb * bsz
+    nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
+    positions = positions0[:, None] + jnp.arange(W, dtype=positions0.dtype)
+    flat_pos = positions.reshape(-1)
+    x = _scale_embed(
+        params["embed"]["embedding"][tokens.reshape(-1)].astype(compute_dtype),
+        cfg,
+    )  # [R*W, H]
+    if cfg.pos_embed == "learned":
+        x = x + params["pos_embed"]["embedding"][flat_pos].astype(
+            compute_dtype
+        )
+    rope_pos = (
+        positions if rope_offset is None else positions + rope_offset[:, None]
+    ).reshape(-1)
+    cos, sin = rope_table(rope_pos, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling_)
+    valid = (
+        jnp.arange(span)[None, None, :] <= positions[:, :, None]
+    )  # [R, W, span]
+    if cfg.sliding_window is not None:
+        valid = valid & (
+            jnp.arange(span)[None, None, :]
+            > positions[:, :, None] - cfg.sliding_window
+        )
+
+    # pool coordinates of each (slot, position) row; inactive slots land in
+    # the null block 0 so donors/parked KV stay untouched
+    blk_col = jnp.clip(positions // bsz, 0, nb - 1)  # [R, W]
+    dest_block = jnp.take_along_axis(block_tables, blk_col, axis=1)
+    dest_off = positions % bsz
+    if active is not None:
+        dest_block = jnp.where(active[:, None], dest_block, 0)
+        dest_off = jnp.where(active[:, None], dest_off, 0)
+    dest_block_f = dest_block.reshape(-1)
+    dest_off_f = dest_off.reshape(-1)
+    active_flat = (
+        None if active is None else jnp.repeat(active, W, axis=0)
+    )
+
+    def write(pool_l, new):  # [n_blocks, bsz, nKV, hd] <- [R*W, nKV, hd]
+        return pool_l.at[dest_block_f, dest_off_f].set(new)
+
+    def layer(x, inputs):
+        layer_p, kp, vp = inputs
+        h = _norm(x, layer_p["input_norm"], cfg, layer_p.get("input_norm_bias"))
+        q, k_new, v_new = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
+        kp = write(kp, k_new.astype(kp.dtype))
+        vp = write(vp, v_new.astype(vp.dtype))
+        attn_out = paged_attention_qlen(
+            q.reshape(R, W, nH, hd), kp, vp, block_tables, valid,
+            impl=attn_impl,
+        ).reshape(R * W, nH, hd)
+        proj = jnp.einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"])
+        if cfg.attn_out_bias:
+            proj = proj + layer_p["attn"]["o_bias"]
+        x = x + proj
+        h = _norm(x, layer_p["post_attn_norm"], cfg, layer_p.get("post_attn_norm_bias"))
+        if cfg.num_experts:
+            y, _ = moe_mlp(layer_p["mlp"], h, cfg, valid=active_flat)
+        else:
+            y = mlp(layer_p["mlp"], h, cfg)
+        x = x + y
+        return x, (kp, vp)
+
+    if cfg.scan_layers:
+        x, (k_pool, v_pool) = jax.lax.scan(
+            layer, x, (params["layers"], k_pool, v_pool)
+        )
+    else:
+        kps, vps = [], []
+        for i in range(cfg.num_hidden_layers):
+            x, (kp, vp) = layer(
+                x, (params[f"layers_{i}"], k_pool[i], v_pool[i])
+            )
+            kps.append(kp)
+            vps.append(vp)
+        k_pool, v_pool = jnp.stack(kps), jnp.stack(vps)
+
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum(
+            "th,vh->tv", x, params["embed"]["embedding"].astype(compute_dtype)
+        )
+    else:
+        logits = jnp.einsum("th,hv->tv", x, params["lm_head"]["kernel"])
+    return (
+        logits.astype(jnp.float32).reshape(R, W, -1),
+        k_pool,
+        v_pool,
+    )

@@ -19,10 +19,55 @@ body checkpointed (logits recomputed per chunk, as in ops/fused_xent.py).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 PADDING_SEGMENT = -1
+
+
+def verify_attention(
+    q: jax.Array,  # [R, W, nH, hd] — W query positions per slot
+    k_cache: jax.Array,  # [R, S, nKV, hd] per-slot contiguous KV
+    v_cache: jax.Array,  # [R, S, nKV, hd]
+    valid: jax.Array,  # [R, W, S] bool: rows query position w may attend
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """q_len>1 decode attention over per-slot KV (speculative verify).
+
+    The multi-query twin of the single-token decode attention inside
+    `models/qwen2.decode_step`: the verify chunk of draft-free speculative
+    decoding scores all `W` draft positions of a slot in ONE forward, so
+    each of the W queries needs its own causal horizon (`valid[r, w, s]`,
+    typically `s <= base_position + w`) over the same cache rows.
+
+    Deliberately the exact op/cast sequence of `decode_step`'s attention
+    with one extra query axis — the engine's bitwise contract is that a
+    verify chunk's logits at position j equal the chunked decode loop's
+    logits for the same context, and the paged XLA verify path reaches
+    bit-parity with the workspace layout by gathering its blocks and
+    calling THIS function. W is small (spec_k + 1), so the dense
+    [R, W, S] score tensor is the same order of memory the single-step
+    path already pays.
+    """
+    R, W, nH, hd = q.shape
+    nKV = k_cache.shape[2]
+    group = nH // nKV
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(hd)
+    qg = q.reshape(R, W, nKV, group, hd)
+    scores = jnp.einsum("rwkgd,rskd->rwkgs", qg, k_cache.astype(q.dtype))
+    if scale == 1.0 / math.sqrt(hd):
+        # decode_step divides by sqrt(hd): reproduce that op exactly (not a
+        # mathematically-equal multiply) for bit parity with the oracle
+        scores = (scores / np.sqrt(hd)).astype(jnp.float32)
+    else:
+        scores = (scores * scale).astype(jnp.float32)
+    scores = jnp.where(valid[:, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("rwkgs,rskd->rwkgd", probs, v_cache.astype(q.dtype))
+    return out.reshape(R, W, nH, hd)
 
 
 def chunked_attention(

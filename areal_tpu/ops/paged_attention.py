@@ -190,6 +190,168 @@ def _paged_attention_pallas(
 
 
 # ---------------------------------------------------------------------------
+# q_len > 1 (speculative verify): W query positions per slot, one pass
+# ---------------------------------------------------------------------------
+
+
+def _paged_verify_kernel(
+    bt_ref,  # [R, nb] scalar-prefetch block table
+    mask_ref,  # (1, W, bsz) int32 validity rows for this block, per query
+    q_ref,  # (1, 1, W, group, hd)
+    k_ref,  # (1, bsz, 1, hd) — THE pool block bt[r, b], DMA'd once for all W
+    v_ref,  # (1, bsz, 1, hd)
+    o_ref,  # (1, 1, W, group, hd)
+    acc_ref,  # VMEM (W*group, hd) f32
+    m_ref,  # VMEM (W*group, 1) f32
+    l_ref,  # VMEM (W*group, 1) f32
+    *,
+    sm_scale: float,
+):
+    """The W=1 split-KV kernel generalized to W query positions: each grid
+    step still DMAs exactly ONE pool block, but scores all W queries
+    against it — the block read is amortized W-fold versus running the
+    single-query kernel over W virtual slots."""
+    b = pl.program_id(2)
+    nb = pl.num_programs(2)
+    W, group, hd = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
+    bsz = k_ref.shape[1]
+
+    @pl.when(b == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32).reshape(W * group, hd)
+    k = k_ref[0, :, 0].astype(jnp.float32)  # [bsz, hd]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * sm_scale
+    # per-query causal horizon: mask row w applies to that query's `group`
+    # score rows
+    m2 = jnp.broadcast_to(
+        mask_ref[0][:, None, :], (W, group, bsz)
+    ).reshape(W * group, bsz)
+    s = jnp.where(m2 != 0, s, _NEG_INF)
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    m_ref[:] = m_new
+    v = v_ref[0, :, 0].astype(jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(b == nb - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).reshape(W, group, hd).astype(
+            o_ref.dtype
+        )
+
+
+def _paged_verify_pallas(
+    q, k_pool, v_pool, block_table, valid, sm_scale, interpret
+):
+    R, W, nH, hd = q.shape
+    bsz, nKV = k_pool.shape[1], k_pool.shape[2]
+    nb = block_table.shape[1]
+    group = nH // nKV
+    if not interpret and bsz % 128 != 0:
+        raise ValueError(
+            f"pallas paged attention needs page_size % 128 == 0 on TPU "
+            f"(got {bsz}); use impl='xla' or a 128-multiple page size"
+        )
+    # [R, nKV, W, group, hd]: kv-head is a grid axis, (W, group) ride in
+    # the q block so one block DMA serves every query position
+    qg = q.reshape(R, W, nKV, group, hd).transpose(0, 2, 1, 3, 4)
+    mask = valid.astype(jnp.int32)  # [R, W, nb*bsz]
+
+    kernel = functools.partial(_paged_verify_kernel, sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(R, nKV, nb),
+        in_specs=[
+            pl.BlockSpec((1, W, bsz), lambda r, h, b, bt: (r, 0, b)),
+            pl.BlockSpec(
+                (1, 1, W, group, hd), lambda r, h, b, bt: (r, h, 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0)
+            ),
+            pl.BlockSpec(
+                (1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, W, group, hd), lambda r, h, b, bt: (r, h, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((W * group, hd), jnp.float32),
+            pltpu.VMEM((W * group, 1), jnp.float32),
+            pltpu.VMEM((W * group, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, nKV, W, group, hd), q.dtype),
+        interpret=interpret,
+    )(block_table, mask, qg, k_pool, v_pool)
+    return out.transpose(0, 2, 1, 3, 4).reshape(R, W, nH, hd)
+
+
+def paged_attention_qlen(
+    q: jax.Array,  # [R, W, nH, hd]: W query positions per slot
+    k_pool: jax.Array,  # [n_blocks, bsz, nKV, hd] ONE layer's pool
+    v_pool: jax.Array,  # [n_blocks, bsz, nKV, hd]
+    block_table: jax.Array,  # [R, nb] int32 pool-block ids per slot
+    valid: jax.Array,  # [R, W, nb*bsz] bool per-query attendable rows
+    *,
+    impl: str = "auto",
+    sm_scale: float | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """q_len>1 decode attention against the block table (speculative
+    verify chunks): slot r's W queries (positions base..base+W-1) attend
+    the slot's paged rows under per-query causal masks. Returns
+    [R, W, nH, hd] in q's dtype.
+
+    The XLA impl gathers the slot's blocks and runs
+    `ops/chunked_attention.verify_attention` — the exact op sequence of
+    the workspace verify step, so the two layouts stay bitwise-equal (the
+    same parity contract `paged_attention` keeps for W=1). The Pallas
+    impl extends the split-KV flash-decode kernel with the W query
+    positions riding in the q block: one block DMA per grid step serves
+    all W queries instead of W re-reads.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _default_interpret()
+    impl = resolve_impl(impl)
+    if impl == "xla":
+        from areal_tpu.ops.chunked_attention import verify_attention
+
+        R, W, nH, hd = q.shape
+        bsz, nKV = k_pool.shape[1], k_pool.shape[2]
+        nb = block_table.shape[1]
+        idx = block_table.reshape(-1)
+        kc = jnp.take(k_pool, idx, axis=0).reshape(R, nb * bsz, nKV, hd)
+        vc = jnp.take(v_pool, idx, axis=0).reshape(R, nb * bsz, nKV, hd)
+        return verify_attention(q, kc, vc, valid, sm_scale=sm_scale)
+    return _paged_verify_pallas(
+        q, k_pool, v_pool, block_table, valid, sm_scale, interpret
+    )
+
+
+# ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
 

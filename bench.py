@@ -363,6 +363,141 @@ def bench_paged_compare(model, n_requests, prompt_len, new_tokens,
     return out
 
 
+def bench_spec_compare(model, n_requests, prompt_len, new_tokens, max_running,
+                       chunk=None, spec_k=7, echo_vocab=64):
+    """n-gram speculative decoding (spec_decode="ngram") vs the
+    non-speculative oracle on a prompt-echoing workload.
+
+    Untrained random weights never repeat under greedy decoding (no
+    induction behavior), so the workload makes the model itself echo:
+    the residual-mixing kernels (attn o_kernel, mlp down_kernel) are
+    zeroed, which reduces greedy decoding to a deterministic
+    last-token -> next-token map over a small vocab (`echo_vocab`) — it
+    must enter a cycle within O(sqrt(vocab)) steps, the repetition regime
+    prompt-lookup exploits in trained math/code rollouts that quote their
+    prompts. BOTH engines serve the same echo model, so the comparison
+    isolates the engine cost: one W-wide verify forward per up-to-W
+    emitted tokens versus `chunk` sequential decode steps per chunk.
+
+    Reports end-to-end tok/s for both engines, the speedup, and the
+    acceptance telemetry (mean accepted-per-chunk, draft hit rate,
+    rejected waste). The spec engine runs FIRST so the warm-XLA-process
+    advantage goes to the baseline (same conservative ordering as
+    bench_decode_compare)."""
+    import dataclasses as _dc
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.models.qwen2 import init_params
+
+    echo_model = _dc.replace(model, vocab_size=min(model.vocab_size, echo_vocab))
+    params = init_params(echo_model, jax.random.PRNGKey(0))
+    zero = lambda a: a * 0.0  # noqa: E731
+
+    def echoify(layer):
+        return {
+            **layer,
+            "attn": {**layer["attn"], "o_kernel": zero(layer["attn"]["o_kernel"])},
+            "mlp": {**layer["mlp"], "down_kernel": zero(layer["mlp"]["down_kernel"])},
+        }
+
+    if "layers" in params:
+        params["layers"] = echoify(params["layers"])
+    else:
+        for name in list(params):
+            if name.startswith("layers_"):
+                params[name] = echoify(params[name])
+
+    g = GenerationHyperparameters(max_new_tokens=new_tokens, greedy=True)
+    rng = np.random.RandomState(5)
+    n_warm = max(2, max_running)
+    prompts = [
+        rng.randint(1, echo_model.vocab_size, (prompt_len,)).tolist()
+        for _ in range(n_warm + n_requests)
+    ]
+
+    def run(spec: bool):
+        dcfg = JaxDecodeConfig(
+            context_length=prompt_len + new_tokens + 128,
+            max_running_requests=max_running,
+            new_tokens_per_chunk=chunk or min(128, new_tokens),
+            spec_decode="ngram" if spec else "off",
+            spec_k=spec_k,
+            dtype=model.dtype,
+            kv_cache_dtype=model.dtype,
+        )
+        eng = JaxDecodeEngine(
+            dcfg, InferenceEngineConfig(max_concurrent_rollouts=n_requests)
+        )
+        eng.set_model(params, echo_model)
+        eng.initialize()
+        try:
+            eng.prewarm(prompt_len=prompt_len, gconfig=g, include_fork=False)
+
+            def one(i):
+                return eng.generate(
+                    ModelRequest(input_ids=prompts[i], gconfig=g), timeout=1800
+                )
+
+            with ThreadPoolExecutor(max_workers=n_requests) as pool:
+                # untimed load pass: live-traffic interleavings + the spec
+                # path's first drafted dispatches land outside the clock
+                list(pool.map(one, range(n_warm)))
+                m0 = eng.get_metrics()
+                t0 = time.perf_counter()
+                results = list(
+                    pool.map(one, range(n_warm, n_warm + n_requests))
+                )
+                dt = time.perf_counter() - t0
+                m1 = eng.get_metrics()
+            gen = sum(len(r.output_tokens) for r in results)
+            out = dict(tok_s=gen / dt, m0=m0, m1=m1, results=results)
+            return out
+        finally:
+            eng.destroy()
+
+    spec = run(True)
+    base = run(False)
+    # greedy streams must agree between the engines — a speedup bought
+    # with different tokens would be a correctness bug, not a win
+    for a, b in zip(spec["results"], base["results"]):
+        assert a.output_tokens == b.output_tokens, "spec stream diverged"
+    m0, m1 = spec["m0"], spec["m1"]
+    d_chunks = m1["spec_chunks_total"] - m0["spec_chunks_total"]
+    d_drafted = (
+        m1["spec_drafted_tokens_total"] - m0["spec_drafted_tokens_total"]
+    )
+    d_rejected = (
+        m1["spec_rejected_tokens_total"] - m0["spec_rejected_tokens_total"]
+    )
+    d_accept = d_drafted - d_rejected  # accepted = drafted - rejected
+    return dict(
+        spec_tokens_per_sec_per_chip=spec["tok_s"],
+        spec_off_tokens_per_sec_per_chip=base["tok_s"],
+        spec_over_off_speedup=(
+            spec["tok_s"] / base["tok_s"] if base["tok_s"] > 0 else 0.0
+        ),
+        spec_accepted_per_chunk_mean=(
+            d_accept / d_chunks if d_chunks else 0.0
+        ),
+        spec_draft_hit_rate=(
+            (d_drafted - d_rejected) / d_drafted if d_drafted else 0.0
+        ),
+        spec_rejected_tokens=d_rejected,
+        spec_verify_chunks=d_chunks,
+        spec_k=spec_k,
+        spec_itl_p50_ms=m1["itl_p50_ms"],
+        spec_new_tokens=new_tokens,
+    )
+
+
 def bench_weightsync(model, n_pushes, chunk_mb, prompt_len, new_tokens):
     """Staged weight-sync bench: transfer time vs commit-pause time.
 
@@ -910,6 +1045,32 @@ def _bench_grpo_run(
     )
 
 
+# --mode choice -> bench entry point. The argparse choices are derived from
+# this table and the dev-mode headline metrics live beside it, so a new mode
+# cannot ship half-wired; tests/test_bench_modes.py pins the sync.
+BENCH_MODE_FNS = {
+    "train": bench_train,
+    "decode": bench_decode_compare,
+    "pagedattn": bench_paged_compare,
+    "prefix": bench_prefix_decode,
+    "grpo": bench_grpo,
+    "ppsched": bench_pp_schedules,
+    "weightsync": bench_weightsync,
+    "specdecode": bench_spec_compare,
+}
+BENCH_MODES = ("all", *BENCH_MODE_FNS)
+# headline metric per dev mode (modes that skip the trainer MFU line)
+MODE_HEADLINES = {
+    "decode": ("decode_tokens_per_sec_per_chip", "tok/s/chip"),
+    "pagedattn": ("paged_over_ws_speedup", "x"),
+    "prefix": ("prefix_share_speedup", "x"),
+    "grpo": ("grpo_samples_per_sec_per_chip", "samples/s/chip"),
+    "ppsched": ("pp_temp_ratio_gpipe_over_1f1b", "x"),
+    "weightsync": ("weightsync_commit_pause_s", "s"),
+    "specdecode": ("spec_over_off_speedup", "x"),
+}
+
+
 def _emit(metric: str, value: float, detail: dict) -> None:
     print(
         json.dumps(
@@ -1215,6 +1376,18 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("specdecode"):
+            decode.update(
+                _retry_transport(
+                    lambda: bench_spec_compare(
+                        model, n_requests=64, prompt_len=128, new_tokens=256,
+                        max_running=64, spec_k=7,
+                    ),
+                    what="bench_spec_compare",
+                    attempts=3,
+                    base_delay=15.0,
+                )
+            )
         if want("grpo"):
             # GRPO co-locates trainer (fwd+bwd+opt) and decode engine on
             # one chip: run the actor with remat on to leave HBM headroom
@@ -1330,6 +1503,16 @@ def main() -> None:
                     new_tokens=32,
                 )
             )
+        if want("specdecode"):
+            # long enough generation that the greedy echo cycle locks in
+            # and most verify chunks ride at full acceptance (the ramp-in
+            # chunks before the cycle establishes accept little)
+            decode.update(
+                bench_spec_compare(
+                    model, n_requests=8, prompt_len=16, new_tokens=192,
+                    max_running=4, chunk=8, spec_k=7,
+                )
+            )
         if want("grpo"):
             decode.update(
                 bench_grpo(
@@ -1352,14 +1535,7 @@ def main() -> None:
     else:
         # dev modes skip the trainer: emitting the MFU metric as 0.0 would
         # read as a catastrophic regression. Headline the mode's own number.
-        headline = {
-            "decode": ("decode_tokens_per_sec_per_chip", "tok/s/chip"),
-            "pagedattn": ("paged_over_ws_speedup", "x"),
-            "prefix": ("prefix_share_speedup", "x"),
-            "grpo": ("grpo_samples_per_sec_per_chip", "samples/s/chip"),
-            "ppsched": ("pp_temp_ratio_gpipe_over_1f1b", "x"),
-            "weightsync": ("weightsync_commit_pause_s", "s"),
-        }[mode]
+        headline = MODE_HEADLINES[mode]
         print(
             json.dumps(
                 {
@@ -1385,10 +1561,7 @@ if __name__ == "__main__":
         p.add_argument(
             "--mode",
             default=os.environ.get("AREAL_BENCH_MODE", "all"),
-            choices=[
-                "all", "train", "decode", "pagedattn", "prefix", "grpo",
-                "ppsched", "weightsync",
-            ],
+            choices=list(BENCH_MODES),
             help="which measurements to run (default: all)",
         )
         args = p.parse_args()

@@ -126,6 +126,48 @@ def test_baseline_covers_and_reports_stale(tmp_path):
     assert len(Baseline.load(str(p)).entries) == len(bl.entries)
 
 
+def test_baseline_invalid_justifications_reported(tmp_path):
+    """Regression (ISSUE 6 satellite): entries whose justification is
+    empty, whitespace, missing, or still the `--write-baseline`
+    placeholder are INVALID — they waive a rule without the review the
+    justification field exists to force. Baseline.invalid() must surface
+    them, and the CLI must report them through the same stderr-note
+    channel as stale entries (exit code unchanged: the entry still
+    suppresses its finding until someone justifies or fixes it)."""
+    fs = _run_fixture("ar201_host_sync.py")
+    bl = Baseline.from_findings(fs)  # placeholder justifications
+    assert len(bl.invalid()) == len(bl.entries) > 0
+    bl.entries[0]["justification"] = "real reason: oracle loop, sync is fine"
+    bl.entries.append(
+        {"file": "a.py", "rule": "AR201", "key": "k", "justification": "   "}
+    )
+    bl.entries.append({"file": "b.py", "rule": "AR201", "key": "k2"})
+    invalid = bl.invalid()
+    assert bl.entries[0] not in invalid
+    assert bl.entries[-1] in invalid and bl.entries[-2] in invalid
+    # CLI channel: same stderr note stream as stale entries, exit 0 when
+    # every finding is covered
+    p = tmp_path / "bl.json"
+    bl.save(str(p))
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "areal_tpu.analysis",
+            str(FIXTURES / "ar201_host_sync.py"),
+            "--baseline",
+            str(p),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "invalid baseline entry" in r.stderr
+    # the justified entry is not reported; the placeholder/empty ones are
+    assert r.stderr.count("invalid baseline entry") == len(invalid)
+
+
 def test_cli_exit_codes(tmp_path):
     bad = FIXTURES / "ar201_host_sync.py"
     env_cmd = [sys.executable, "-m", "areal_tpu.analysis"]

@@ -1,0 +1,62 @@
+"""bench.py mode wiring: every `--mode` choice maps to a runnable bench.
+
+Regression surface (ISSUE 6 satellite): the mode list used to live in three
+places — the argparse `choices`, the `want(...)` if-chains, and the
+dev-mode headline dict — so a new bench could ship selectable-but-unwired
+(`--mode foo` accepted, nothing runs, or KeyError at the headline print).
+The dispatch table `BENCH_MODE_FNS` is now the single source the choices
+derive from; these tests pin that every choice resolves to a callable and
+every dev mode has its headline metric.
+"""
+
+import importlib.util
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_mode_choice_maps_to_a_runnable_bench():
+    bench = _load_bench()
+    modes = set(bench.BENCH_MODES)
+    assert "all" in modes
+    # choices == {"all"} + dispatch-table keys, exactly
+    assert modes - {"all"} == set(bench.BENCH_MODE_FNS), (
+        modes, set(bench.BENCH_MODE_FNS),
+    )
+    for mode, fn in bench.BENCH_MODE_FNS.items():
+        assert callable(fn), mode
+        # a dispatch entry must be a real bench function defined in bench.py
+        assert fn.__name__.startswith("bench_"), (mode, fn.__name__)
+
+
+def test_every_dev_mode_has_a_headline_metric():
+    bench = _load_bench()
+    # dev modes = everything but "all" and "train" (those emit the trainer
+    # MFU line); each needs a (metric_key, unit) headline or main() KeyErrors
+    dev_modes = set(bench.BENCH_MODE_FNS) - {"train"}
+    assert dev_modes == set(bench.MODE_HEADLINES), (
+        dev_modes, set(bench.MODE_HEADLINES),
+    )
+    for mode, (key, unit) in bench.MODE_HEADLINES.items():
+        assert isinstance(key, str) and key, mode
+        assert isinstance(unit, str) and unit, mode
+
+
+def test_argparse_choices_accept_every_mode():
+    """The CLI surface itself: argparse must accept exactly BENCH_MODES
+    (a mode present in the table but missing from choices would be
+    unreachable from the command line)."""
+    bench = _load_bench()
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--mode", choices=list(bench.BENCH_MODES))
+    for m in bench.BENCH_MODES:
+        assert p.parse_args(["--mode", m]).mode == m

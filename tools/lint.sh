@@ -6,11 +6,44 @@
 #   tools/lint.sh --all      # also sweep bench.py, tools/ and tests/
 #                            # (informational; tests/ has known AR201s in
 #                            # oracle loops where sync cost is irrelevant)
+#   tools/lint.sh --changed [BASE]
+#                            # fast pre-commit mode: lint + compile ONLY
+#                            # the .py files changed vs BASE (default
+#                            # main) — committed AND working-tree changes
 #
 # Run from the repo root. Exit 0 = clean.
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--changed" ]]; then
+    base="${2:-main}"
+    # worktree-vs-base diff catches staged, unstaged AND committed changes;
+    # --diff-filter=d drops deletions (nothing left to lint)
+    changed=()
+    while IFS= read -r f; do
+        [[ -f "$f" ]] && changed+=("$f")
+    done < <(
+        {
+            git diff --name-only --diff-filter=d "$base" -- '*.py'
+            # untracked new files are changes too — a brand-new module
+            # must not skip its own pre-commit lint
+            git ls-files --others --exclude-standard -- '*.py'
+        } | sort -u
+    )
+    if [[ ${#changed[@]} -eq 0 ]]; then
+        echo "lint --changed: no python files changed vs $base"
+        echo "lint: OK"
+        exit 0
+    fi
+    echo "== areal-lint --changed (${#changed[@]} file(s) vs $base) =="
+    printf '  %s\n' "${changed[@]}"
+    python -m areal_tpu.analysis "${changed[@]}" --baseline tools/lint_baseline.json
+    echo "== compileall (changed files) =="
+    python -m compileall -q "${changed[@]}"
+    echo "lint: OK"
+    exit 0
+fi
 
 echo "== areal-lint (areal_tpu/ vs tools/lint_baseline.json) =="
 python -m areal_tpu.analysis areal_tpu/ --baseline tools/lint_baseline.json
