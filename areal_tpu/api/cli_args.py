@@ -293,6 +293,18 @@ class JaxDecodeConfig:
     # hold, with parked-KV eviction / donor-registry drop / active-slot
     # preemption (internal requeue) when the pool runs dry.
     kv_pool_tokens: int | None = None
+    # Host-RAM tier under the paged pool (MiB; 0 disables — eviction then
+    # DROPS parked/preempted KV and the resume re-prefills, exactly the
+    # pre-tier behavior). When enabled, the eviction paths offload the
+    # victim slot's blocks to a budgeted pinned host store
+    # (engine/kv_pool.py HostKVStore, its own LRU) via async
+    # device→host copies, and a resume promotes them back — fresh device
+    # blocks + async upload — instead of re-running prefill. Turns
+    # kv_pool_tokens from a hard capacity wall into a working-set knob;
+    # resumed token/logprob streams are bit-identical to never-evicted
+    # ones (the restored bytes ARE the original KV, and the slot's
+    # sampling base key travels with the entry).
+    kv_host_pool_mb: float = 0.0
     # How decode attention reaches the paged pool:
     #   "paged" (default): attend IN PLACE over the pool through the block
     #     table (ops/paged_attention.py) with an O(1) per-token cache

@@ -84,7 +84,12 @@ from areal_tpu.api.io_struct import (
     ModelResponse,
     WeightUpdateMeta,
 )
-from areal_tpu.engine.kv_pool import KVBlockAllocator, PoolDry
+from areal_tpu.engine.kv_pool import (
+    HostKVEntry,
+    HostKVStore,
+    KVBlockAllocator,
+    PoolDry,
+)
 from areal_tpu.models import hf_io
 from areal_tpu.models.qwen2 import (
     ModelConfig,
@@ -108,7 +113,8 @@ logger = logging.getLogger("jax_decode")
 # is provably parked (it re-checks the flag under the lock and drains all
 # in-flight chunks), so main-thread mutation until continue_generation() is
 # exclusive. Lock hierarchy (runtime-enforced by OrderedLock, statically by
-# AR102/AR103): _sched_lock (10) > _weight_lock (20) > _metrics_lock (30).
+# AR102/AR103): _sched_lock (10) > _weight_lock (20) > _host_lock (25) >
+# _metrics_lock (30).
 _GUARDED_BY = {
     # scheduler/slot state: mutated by the scheduler pass (under
     # _sched_lock) and by main-thread lifecycle/pause-fenced paths
@@ -145,6 +151,14 @@ _GUARDED_BY = {
     "JaxDecodeEngine._suffix_prefill_fns": "_sched_lock",
     "JaxDecodeEngine._vision_fns": "_sched_lock",
     "JaxDecodeEngine._embed_prefill_fns": "_sched_lock",
+    # host-KV-tier jit caches: populated lazily by the scheduler's
+    # offload/promotion paths, cleared by destroy()
+    "JaxDecodeEngine._host_gather_fn": "_sched_lock",
+    "JaxDecodeEngine._host_upload_fn": "_sched_lock",
+    # the host tier itself: every access (scheduler offload/promote, the
+    # pause-fenced weight-install clear, get_metrics snapshots from the
+    # HTTP thread) goes through _host_lock (rank 25)
+    "JaxDecodeEngine._host_store": "_host_lock",
     # device buffers swapped under _weight_lock at every mutation site
     # that can race a dispatched chunk
     "JaxDecodeEngine._k_cache": "_weight_lock",
@@ -279,6 +293,13 @@ class _Slot:
     start_time: float = field(default_factory=time.monotonic)
     ttft: float = float("inf")
     stop_reason: str | None = None
+    # sampling base key assigned at FIRST admission and reused on every
+    # re-admission (pool-pressure preemption requeues the same _Slot):
+    # the stream stays fold_in(original_key, position)-pure, so a
+    # preempted-and-resumed request emits bit-identical tokens/logprobs
+    # to the never-preempted schedule — whether it came back through the
+    # host KV tier or through a re-prefill
+    base_key: np.ndarray | None = None
 
 
 @dataclass
@@ -346,6 +367,12 @@ class JaxDecodeEngine(InferenceEngine):
         # get_metrics() from the HTTP/main threads (previously unguarded:
         # torn busy/idle reads and lost counter increments were possible)
         self._metrics_lock = OrderedLock("jax_decode._metrics_lock", rank=30)
+        # guards the host KV tier (HostKVStore): the scheduler offloads/
+        # promotes under it, weight installs clear it (pause-fenced), and
+        # get_metrics snapshots its counters from the HTTP/main threads.
+        # Rank 25: acquired after _weight_lock (a gather/upload dispatch
+        # precedes the store bookkeeping) and before _metrics_lock.
+        self._host_lock = OrderedLock("jax_decode._host_lock", rank=25)
         self._thread: threading.Thread | None = None
         self._thread_exc: BaseException | None = None
 
@@ -384,6 +411,13 @@ class JaxDecodeEngine(InferenceEngine):
         self._n_suffix_prefills = 0  # partial-prefix hits (multi-turn)
         self._n_preemptions = 0  # pool-pressure internal requeues
         self._alloc: KVBlockAllocator | None = None  # set in initialize
+        # host-RAM KV tier (kv_host_pool_mb > 0): eviction offloads
+        # parked/preempted slots' blocks here instead of dropping them;
+        # resume promotes them back without a prefill. None = disabled
+        # (today's drop-and-reprefill behavior, bit for bit).
+        self._host_store: HostKVStore | None = None
+        self._host_gather_fn: Callable | None = None
+        self._host_upload_fn: Callable | None = None
         self._gen_token_count = 0  # guarded-by: _metrics_lock
         # admission counter: seeds the host-derived per-slot base keys
         self._admission_seq = 0
@@ -578,6 +612,28 @@ class JaxDecodeEngine(InferenceEngine):
         else:
             n_blocks = R * max_bps + 1
         self._alloc = KVBlockAllocator(R, n_blocks, bs, max_bps)
+        # host-RAM tier under the pool: budgeted by kv_host_pool_mb
+        # (0 = disabled — eviction drops KV and resume re-prefills,
+        # exactly the pre-tier behavior)
+        block_nbytes = (
+            2  # K and V
+            * cfg.num_hidden_layers
+            * bs
+            * cfg.num_key_value_heads
+            * cfg.head_dim_
+            * jnp.dtype(self.config.kv_cache_dtype).itemsize
+        )
+        with self._host_lock:
+            if float(self.config.kv_host_pool_mb) > 0:
+                self._host_store = HostKVStore(
+                    budget_bytes=int(
+                        float(self.config.kv_host_pool_mb) * 1024 * 1024
+                    ),
+                    block_nbytes=block_nbytes,
+                    block_size=bs,
+                )
+            else:
+                self._host_store = None
         shape = (
             cfg.num_hidden_layers,
             n_blocks,
@@ -650,6 +706,12 @@ class JaxDecodeEngine(InferenceEngine):
         self.params = None
         self._k_cache = self._v_cache = None
         self._alloc = None
+        with self._host_lock:
+            if self._host_store is not None:
+                self._host_store.clear()
+            self._host_store = None
+        self._host_gather_fn = None
+        self._host_upload_fn = None
         # vision tower + compiled-fn caches hold device buffers too
         self._vision_params = None
         self._freq_counts = None
@@ -1570,6 +1632,127 @@ class JaxDecodeEngine(InferenceEngine):
                     jnp.asarray(dst_b, jnp.int32),
                 )
 
+    # -- host KV tier (kv_host_pool_mb) --------------------------------
+    def _get_host_gather_fn(self):
+        """Gather one slot's first `nb` pool blocks into fresh
+        [L, nb, bs, nKV, hd] buffers for the device→host offload copy.
+        NOT donated: the pool stays intact (its blocks are freed by the
+        host-side allocator after the gather is dispatched). jit
+        re-specialises per nb; the trace is a pair of takes."""
+        if self._host_gather_fn is None:
+
+            def gather(kp, vp, bt_row):
+                return jnp.take(kp, bt_row, axis=1), jnp.take(vp, bt_row, axis=1)
+
+            self._host_gather_fn = jax.jit(gather)
+        return self._host_gather_fn
+
+    def _get_host_upload_fn(self):
+        """Scatter a promoted entry's blocks into the slot's freshly
+        allocated pool blocks. Donates the pool; the upload is dispatched
+        asynchronously — the promoted slot's first chunk (and every other
+        slot's) simply queues behind it on the device stream, so other
+        slots keep decoding while the bytes land."""
+        if self._host_upload_fn is None:
+
+            def upload(kp, vp, bt_row, hk, hv):
+                kp = kp.at[:, bt_row].set(hk.astype(kp.dtype))
+                vp = vp.at[:, bt_row].set(hv.astype(vp.dtype))
+                return kp, vp
+
+            self._host_upload_fn = jax.jit(upload, donate_argnums=(0, 1))
+        return self._host_upload_fn
+
+    def _offload_slot_kv(
+        self, rid: str, slot: int, covered: int, tokens: list[int]
+    ) -> bool:
+        """Swap a victim slot's KV to the host tier before its device
+        blocks are freed. Gathers the covering blocks off the pool and
+        starts the device→host copies asynchronously (the store
+        materialises them behind a small pending window — the
+        iter_prefetched double-buffering shape); the caller frees the
+        device blocks immediately after. False when the tier is disabled
+        or the entry cannot fit its budget — the caller then drops the
+        KV, exactly the pre-tier behavior."""
+        if self._host_store is None or covered <= 0:
+            return False
+        nb = self._alloc.blocks_for(covered)
+        if nb <= 0 or nb > int(self._alloc.nblocks[slot]):
+            return False
+        fn = self._get_host_gather_fn()
+        with self._weight_lock:
+            hk, hv = fn(
+                self._k_cache,
+                self._v_cache,
+                jnp.asarray(self._alloc.row(slot, nb)),
+            )
+        for arr in (hk, hv):
+            copy_async = getattr(arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        entry = HostKVEntry(
+            rid=rid,
+            k=hk,
+            v=hv,
+            nb=nb,
+            covered=int(covered),
+            tokens=list(tokens),
+            rope_delta=int(self._slot_rope_delta[slot]),
+            base_key=np.array(self._slot_keys[slot]),
+            ts=time.monotonic(),
+            pending=True,
+        )
+        with self._host_lock:
+            return self._host_store.put(entry)
+
+    def _host_match(self, rid: str, covered: int, tokens: list[int]) -> bool:
+        """Exact-resume peek into the host tier (no side effects beyond
+        stale-entry drop + miss accounting inside the store)."""
+        if self._host_store is None:
+            return False
+        with self._host_lock:
+            return self._host_store.match(rid, covered, tokens)
+
+    def _host_promote(self, item: _Slot, slot_idx: int, covered: int) -> bool:
+        """Promote item's host-tier entry into `slot_idx`: fresh device
+        blocks + async upload of the stored bytes — no transformer
+        prefill. Raises PoolDry when the device pool cannot back the
+        blocks even after reclaim (the entry is put back and the caller
+        requeues the request); returns False only if the entry vanished.
+        The upload is dispatched, not awaited: the run-ahead `_dispatch`/
+        `_consume` split means other slots' chunks keep flowing while the
+        transfer drains on the device stream."""
+        with self._host_lock:
+            entry = self._host_store.take(item.rid)
+        if entry is None:
+            return False
+        self._unregister_prefix(slot_idx)
+        self._alloc.free_slot(slot_idx)
+        self._slot_lengths[slot_idx] = 0
+        if not self._ensure_tokens(slot_idx, covered):
+            with self._host_lock:
+                self._host_store.restore(entry)
+            raise PoolDry("no device blocks for host-tier promotion")
+        fn = self._get_host_upload_fn()
+        with self._weight_lock:
+            self._k_cache, self._v_cache = fn(
+                self._k_cache,
+                self._v_cache,
+                jnp.asarray(self._alloc.row(slot_idx, entry.nb)),
+                jnp.asarray(entry.k),
+                jnp.asarray(entry.v),
+            )
+        self._slot_rope_delta[slot_idx] = entry.rope_delta
+        self._slot_keys[slot_idx] = entry.base_key
+        item.base_key = np.array(entry.base_key)
+        if not item.image_data:
+            # rows [0, covered) hold exactly these tokens — as valid a
+            # donor registration as a full prefill's
+            self._register_prefix(slot_idx, list(entry.tokens))
+        with self._host_lock:
+            self._host_store.note_hit(entry)
+        return True
+
     def _get_suffix_prefill_fn(self, suffix_bucket: int, prefix_bucket: int,
                                nb: int):
         """Prefill a SUFFIX whose context is prefix KV already in the
@@ -1704,15 +1887,23 @@ class JaxDecodeEngine(InferenceEngine):
     def _evict_parked_lru(
         self, protect: frozenset[int] = frozenset()
     ) -> int | None:
-        """Free the least-recently-parked slot; returns its index."""
+        """Free the least-recently-parked slot; returns its index.
+
+        With the host tier enabled (kv_host_pool_mb > 0) the victim's
+        blocks are offloaded to host RAM first — the interrupted
+        request's resume promotes them back instead of re-prefilling;
+        only a host-tier miss (budget-evicted, weight-invalidated) pays
+        the re-prefill the pre-tier engine always paid."""
         candidates = [
             r for r, (s, _, _) in self._parked.items() if s not in protect
         ]
         if not candidates:
             return None
         rid = min(candidates, key=lambda r: self._parked[r][2])
-        slot, _, _ = self._parked.pop(rid)
-        self._parked_tokens.pop(rid, None)
+        slot, covered, _ = self._parked.pop(rid)
+        cached = self._parked_tokens.pop(rid, None)
+        if cached:
+            self._offload_slot_kv(rid, slot, covered, cached)
         self._release_slot_blocks(slot)
         return slot
 
@@ -1757,8 +1948,25 @@ class JaxDecodeEngine(InferenceEngine):
         client sees nothing: the request re-admits with its generated
         tokens as part of the coverage prompt and decoding continues where
         it left off — stronger than the reference's abort-and-resubmit
-        over HTTP (remote_inf_engine.py:428-478)."""
+        over HTTP (remote_inf_engine.py:428-478). With the host tier
+        enabled the slot's CONSUMED coverage is offloaded first — rows
+        written by still-in-flight run-ahead chunks sit past it and are
+        never claimed — so the re-admission promotes the KV back instead
+        of re-prefilling the whole conversation."""
         item = self._slots[slot]
+        if item is not None:
+            # true coverage: prompt + consumed tokens, minus the
+            # never-consumed last one (_slot_lengths may be projected
+            # ahead by dispatched-but-unconsumed chunks whose tokens the
+            # reconcile will discard)
+            covered = len(item.prompt) - 1 + len(item.tokens)
+            if covered > 0:
+                self._offload_slot_kv(
+                    item.rid,
+                    slot,
+                    covered,
+                    (list(item.prompt) + list(item.tokens))[:covered],
+                )
         self._slots[slot] = None
         self._release_slot_blocks(slot)
         self._mark_slot_dirty(slot)
@@ -1839,12 +2047,19 @@ class JaxDecodeEngine(InferenceEngine):
                 if P > 1
                 else 0
             )
+            # Host-tier peek FIRST: an exact offloaded match means this
+            # resume needs neither prefill work nor a donor fork — the
+            # original KV bytes come back from host RAM (bit-identical,
+            # where a donor's rows are merely same-tokens-same-weights).
+            host_hit = P > 1 and self._host_match(
+                item.rid, P - 1, prompt[:-1]
+            )
             # Prefix-KV lookup (decided once, here, so the budget gate can
             # wave forks through: a fork is a memcpy, not prefill work).
             # Image requests are excluded — their KV depends on pixel data
             # the token-tuple key cannot see.
             donor = None
-            if P > 1 and not item.image_data:
+            if P > 1 and not item.image_data and not host_hit:
                 covered_t = tuple(prompt[:-1])
                 donor = self._prefix_lookup.get(covered_t)
                 if donor is None:
@@ -1858,7 +2073,13 @@ class JaxDecodeEngine(InferenceEngine):
             is_wave_dup = (
                 P > 1 and not item.image_data and covered_t in wave_primaries
             )
-            if donor is None and P > 1 and not item.image_data and not is_wave_dup:
+            if (
+                donor is None
+                and P > 1
+                and not item.image_data
+                and not is_wave_dup
+                and not host_hit
+            ):
                 found = self._find_shared_prefix(covered_t)
                 if found is not None:
                     donor_slot, plen = found
@@ -1887,6 +2108,7 @@ class JaxDecodeEngine(InferenceEngine):
                 did_prefill
                 and donor is None
                 and not is_wave_dup  # duplicates are memcpy forks: free
+                and not host_hit  # a promotion is an upload, not prefill
                 and needs_prefill_bucket > prefill_budget
             ):
                 # budget exhausted for this pass; run the decode chunk first
@@ -1922,7 +2144,22 @@ class JaxDecodeEngine(InferenceEngine):
                 # no prefill: the decode loop writes KV from row 0, which
                 # invalidates whatever prefix this slot may have donated
                 self._release_slot_blocks(slot_idx)
-            if resumed is None and P > 1 and donor is not None:
+            promoted = False
+            if resumed is None and host_hit:
+                # Host-tier swap-in: fresh device blocks + async upload
+                # of the offloaded bytes — the resumed stream continues
+                # from KV that is bit-identical to what eviction took
+                # away. Falls back to the normal (re-prefill) paths only
+                # if the entry vanished between peek and take.
+                try:
+                    promoted = self._host_promote(item, slot_idx, P - 1)
+                except PoolDry:
+                    # device pool cannot back the blocks even after
+                    # reclaim: the entry went back to the host store;
+                    # hold the request for a later pass
+                    self._overflow.insert(0, item)
+                    break
+            if resumed is None and P > 1 and not promoted and donor is not None:
                 # Prefix-KV hit (the GRPO group case: group_size requests
                 # share one prompt). The donor slot's blocks [0, P-1)
                 # already hold this prefix — alias them in the block table
@@ -1999,7 +2236,7 @@ class JaxDecodeEngine(InferenceEngine):
                         plen,
                     )
                 self._register_prefix(slot_idx, list(prompt[:-1]))
-            elif resumed is None and P > 1:
+            elif resumed is None and P > 1 and not promoted:
                 pre = P - 1
                 bucket = min(_next_bucket(pre), self.config.context_length)
                 self._unregister_prefix(slot_idx)
@@ -2058,17 +2295,31 @@ class JaxDecodeEngine(InferenceEngine):
             self._slots[slot_idx] = item
             self._slot_lengths[slot_idx] = P - 1
             self._slot_epoch[slot_idx] += 1
-            # one base key per admission, in admission (FIFO) order — the
-            # key stream is identical for the sync and run-ahead schedules.
-            # Derived on the HOST (SeedSequence mixing of (seed, admission
-            # index)): the old jax.random.split chain forced a blocking
-            # device round-trip per admission inside the scheduler loop
-            # (areal-lint AR201) for 8 bytes of key material.
-            seq = np.random.SeedSequence(
-                entropy=(int(self.config.random_seed), self._admission_seq)
-            )
-            self._admission_seq += 1
-            self._slot_keys[slot_idx] = seq.generate_state(2, np.uint32)
+            # One base key per REQUEST, assigned at its first admission in
+            # admission (FIFO) order — the key stream is identical for the
+            # sync and run-ahead schedules. Derived on the HOST
+            # (SeedSequence mixing of (seed, admission index)): the old
+            # jax.random.split chain forced a blocking device round-trip
+            # per admission inside the scheduler loop (areal-lint AR201)
+            # for 8 bytes of key material. Re-admissions KEEP the original
+            # key — a parked resume's slot still holds it, a host-tier
+            # promotion restores it from the entry, and a pool-pressure
+            # requeue carries it on the _Slot — so an evicted-and-resumed
+            # request samples fold_in(original_key, position) at every
+            # position: bit-identical to the never-evicted schedule.
+            if resumed is not None or promoted:
+                item.base_key = np.array(self._slot_keys[slot_idx])
+            elif item.base_key is not None:  # pool-pressure re-admission
+                self._slot_keys[slot_idx] = item.base_key
+            else:
+                seq = np.random.SeedSequence(
+                    entropy=(
+                        int(self.config.random_seed), self._admission_seq
+                    )
+                )
+                self._admission_seq += 1
+                self._slot_keys[slot_idx] = seq.generate_state(2, np.uint32)
+                item.base_key = np.array(self._slot_keys[slot_idx])
             self._mark_slot_dirty(slot_idx)
             admitted = True
         self._flush_wave(wave_pending, wave_forks)
@@ -3290,8 +3541,16 @@ class JaxDecodeEngine(InferenceEngine):
             self._parked_tokens.pop(rid, None)
             self._alloc.free_slot(slot)
             self._slot_lengths[slot] = 0
-        # same staleness argument applies to the prefix-KV registry
+        # same staleness argument applies to the prefix-KV registry …
         self._invalidate_prefixes()
+        # … and to the host tier: offloaded blocks were computed by the
+        # OLD weights; a promotion after the install would resume a
+        # stream the new policy never produced. Dropped rids are
+        # tombstoned, so their resumes count as honest host-tier misses
+        # (and re-prefill under the new weights, like parked resumes do).
+        with self._host_lock:
+            if self._host_store is not None:
+                self._host_store.clear()
 
     def init_weights_update_group(self, meta: WeightUpdateMeta):
         pass
@@ -3541,6 +3800,36 @@ class JaxDecodeEngine(InferenceEngine):
             spec_drafted = self._spec_drafted
             spec_accepted = self._spec_accepted
             spec_rejected = self._spec_rejected
+        # host-KV-tier snapshot (own lock — rank 25, before _metrics at
+        # 30): occupancy + swap traffic are the pressure signals the
+        # prefix-aware router will route on, next to
+        # kv_pool_fragmentation / prefix_cache_hit_rate below
+        with self._host_lock:
+            hs = self._host_store
+            # NOTE: `if hs` would be False for an EMPTY store (__len__)
+            if hs is not None:
+                host = dict(
+                    enabled=True,
+                    budget_bytes=hs.budget_bytes,
+                    bytes_used=hs.bytes_used,
+                    entries=len(hs),
+                    resident_tokens=hs.resident_tokens(),
+                    occupancy=round(hs.occupancy(), 6),
+                    swap_out=hs.swap_out_bytes_total,
+                    swap_in=hs.swap_in_bytes_total,
+                    hits=hs.hits,
+                    misses=hs.misses,
+                    evictions=hs.evictions,
+                    rejected=hs.rejected_puts,
+                    avoided=hs.reprefill_tokens_avoided,
+                )
+            else:
+                host = dict(
+                    enabled=False, budget_bytes=0, bytes_used=0, entries=0,
+                    resident_tokens=0, occupancy=0.0, swap_out=0, swap_in=0,
+                    hits=0, misses=0, evictions=0, rejected=0, avoided=0,
+                )
+        host_lookups = host["hits"] + host["misses"]
         # prefix-cache hit rate: admissions served by KV reuse (fork /
         # in-place / suffix) over all admissions that could have reused
         prefix_hits = (
@@ -3585,6 +3874,28 @@ class JaxDecodeEngine(InferenceEngine):
             "kv_tokens_allocated": (
                 self._alloc.allocated_tokens() if self._alloc else 0
             ),
+            # host-RAM KV tier (kv_host_pool_mb): the eviction paths
+            # offload parked/preempted KV here instead of dropping it;
+            # resume promotes it back. All zeros when disabled.
+            "kv_host_pool_enabled": host["enabled"],
+            "kv_host_pool_bytes": host["budget_bytes"],
+            "kv_host_pool_bytes_used": host["bytes_used"],
+            "kv_host_pool_entries": host["entries"],
+            "kv_host_pool_tokens": host["resident_tokens"],
+            "kv_host_pool_occupancy": host["occupancy"],
+            "kv_swap_out_bytes_total": host["swap_out"],
+            "kv_swap_in_bytes_total": host["swap_in"],
+            "kv_host_hits_total": host["hits"],
+            "kv_host_misses_total": host["misses"],
+            "kv_host_evictions_total": host["evictions"],
+            "kv_host_rejected_puts_total": host["rejected"],
+            # exact-resume lookups served from host RAM over all lookups
+            # that had ever been offloaded (fresh requests don't count)
+            "kv_host_hit_rate": (
+                round(host["hits"] / host_lookups, 6) if host_lookups else 0.0
+            ),
+            # prompt+generated tokens whose prefill the host tier skipped
+            "reprefill_tokens_avoided_total": host["avoided"],
             # dirty-tracked block-table uploads: chunks_dispatched_total -
             # this = steady-state dispatches that skipped the copy+upload
             "block_table_uploads_total": table_uploads,

@@ -38,6 +38,10 @@ stealing a live block's rows.
 
 from __future__ import annotations
 
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any
+
 import numpy as np
 
 
@@ -173,3 +177,214 @@ class KVBlockAllocator:
             self.nblocks[dst] = full + 1
             return int(self.tables[src, full]), got[0]
         return None
+
+
+@dataclass
+class HostKVEntry:
+    """One offloaded slot's KV: the slot's first `nb` pool blocks gathered
+    into `[L, nb, block_size, nKV, hd]` K/V buffers, plus the resume
+    metadata the admission path needs to promote it without a prefill.
+
+    `k`/`v` may still be device arrays with their device→host copies in
+    flight (copy_to_host_async started at offload); `HostKVStore`
+    materialises them to host numpy behind a small pending window — the
+    same double-buffering shape as `core/weight_transfer.iter_prefetched`.
+    """
+
+    rid: str
+    k: Any
+    v: Any
+    nb: int
+    covered: int  # tokens the blocks actually hold ([0, covered) valid)
+    tokens: list[int]  # the covered token ids, for the exact-resume check
+    rope_delta: int  # mrope offset restored at promotion (vision slots)
+    base_key: np.ndarray  # the slot's sampling base key (uint32 [2]) —
+    # restored at promotion so the resumed stream keeps sampling with
+    # fold_in(original_key, position): bit-identical to never-evicted
+    ts: float = 0.0
+    nbytes: int = 0
+    pending: bool = field(default=False, repr=False)
+
+    def materialize(self) -> None:
+        """Finish the device→host copy (blocks only if still in flight)
+        and drop the device references."""
+        if self.pending:
+            self.k = np.asarray(self.k)
+            self.v = np.asarray(self.v)
+            self.pending = False
+
+
+class HostKVStore:
+    """Host-RAM tier under the paged pool: a byte-budgeted block store
+    keyed by rid, with its own LRU.
+
+    Eviction paths that used to DROP parked/preempted slots' blocks (and
+    pay a full re-prefill at resume) offload them here instead; promotion
+    allocates fresh device blocks and uploads the stored bytes — turning
+    `kv_pool_tokens` from a hard capacity wall into a working-set knob
+    (the recompute-vs-communicate tradeoff LlamaRL/Podracer resolve by
+    keeping actor state resident across interruptions; parity surface:
+    SGLang HiCache / vLLM CPU KV offload).
+
+    NOT thread-safe by itself: the decode engine serialises every access
+    under its `_host_lock` (rank 25 — between `_weight_lock` and
+    `_metrics_lock` in the engine's OrderedLock hierarchy).
+
+    Counters (`swap_out_bytes_total`, `swap_in_bytes_total`, `hits`,
+    `misses`, `evictions`, `rejected_puts`, `reprefill_tokens_avoided`)
+    feed the engine's `get_metrics()`; a "miss" is an exact-resume lookup
+    whose entry was dropped (LRU / weight-install clear, tracked through a
+    bounded tombstone set) or went stale (prompt diverged) — fresh
+    requests that were never offloaded do not count.
+    """
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        block_nbytes: int,
+        block_size: int,
+        pending_window: int = 2,
+        tombstone_cap: int = 1024,
+    ):
+        assert budget_bytes > 0 and block_nbytes > 0 and block_size > 0
+        self.budget_bytes = int(budget_bytes)
+        self.block_nbytes = int(block_nbytes)  # K+V bytes per pool block
+        self.block_size = int(block_size)
+        self.bytes_used = 0
+        self._entries: OrderedDict[str, HostKVEntry] = OrderedDict()
+        # rids whose entries were dropped (LRU / clear): a later resume
+        # lookup for one of these is an honest host-tier MISS. Bounded
+        # FIFO so the set cannot grow with traffic.
+        self._tombstones: OrderedDict[str, None] = OrderedDict()
+        self._tombstone_cap = int(tombstone_cap)
+        # offload entries whose device→host copies may still be in
+        # flight, oldest first; materialised once more than
+        # `pending_window` are outstanding (iter_prefetched's shape)
+        self._pending: list[str] = []
+        self._pending_window = max(int(pending_window), 0)
+        # counters (engine snapshots under its _host_lock)
+        self.swap_out_bytes_total = 0
+        self.swap_in_bytes_total = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.rejected_puts = 0
+        self.reprefill_tokens_avoided = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def resident_tokens(self) -> int:
+        return sum(e.covered for e in self._entries.values())
+
+    def occupancy(self) -> float:
+        return self.bytes_used / self.budget_bytes if self.budget_bytes else 0.0
+
+    # -- internals ------------------------------------------------------
+    def _tombstone(self, rid: str) -> None:
+        self._tombstones[rid] = None
+        self._tombstones.move_to_end(rid)
+        while len(self._tombstones) > self._tombstone_cap:
+            self._tombstones.popitem(last=False)
+
+    def _drop(self, rid: str, tombstone: bool) -> None:
+        e = self._entries.pop(rid, None)
+        if e is None:
+            return
+        self.bytes_used -= e.nbytes
+        if rid in self._pending:
+            self._pending.remove(rid)
+        if tombstone:
+            self._tombstone(rid)
+
+    def _drain_pending(self, keep: int) -> None:
+        while len(self._pending) > keep:
+            rid = self._pending.pop(0)
+            e = self._entries.get(rid)
+            if e is not None:
+                e.materialize()
+
+    # -- offload (swap-out) --------------------------------------------
+    def put(self, entry: HostKVEntry) -> bool:
+        """Admit an offloaded slot's KV, LRU-evicting other entries to
+        fit. False (counted in `rejected_puts`) when the entry alone
+        exceeds the budget — the caller falls back to dropping the
+        blocks, exactly the pre-tier behavior."""
+        entry.nbytes = entry.nb * self.block_nbytes
+        if entry.nbytes > self.budget_bytes:
+            # tombstoned: this rid's resume will look here and must count
+            # as an honest miss (the KV is about to be dropped)
+            self._tombstone(entry.rid)
+            self.rejected_puts += 1
+            return False
+        self._drop(entry.rid, tombstone=False)  # replace, not duplicate
+        while self.bytes_used + entry.nbytes > self.budget_bytes:
+            lru_rid = next(iter(self._entries))
+            self._drop(lru_rid, tombstone=True)
+            self.evictions += 1
+        self._entries[entry.rid] = entry
+        self._entries.move_to_end(entry.rid)
+        self.bytes_used += entry.nbytes
+        if entry.pending:
+            self._pending.append(entry.rid)
+            self._drain_pending(self._pending_window)
+        self.swap_out_bytes_total += entry.nbytes
+        return True
+
+    # -- promotion (swap-in) -------------------------------------------
+    def match(self, rid: str, covered: int, tokens: list[int]) -> bool:
+        """Exact-resume peek: does an entry cover precisely `tokens`?
+        Counts a MISS (and drops the stale entry) when the rid was
+        offloaded but can no longer serve this resume; counts nothing for
+        rids that were never offloaded."""
+        e = self._entries.get(rid)
+        if e is None:
+            if rid in self._tombstones:
+                del self._tombstones[rid]
+                self.misses += 1
+            return False
+        if e.covered == covered and e.tokens == tokens:
+            return True
+        # prompt diverged (edited/truncated): the cache cannot serve it
+        self._drop(rid, tombstone=False)
+        self.misses += 1
+        return False
+
+    def take(self, rid: str) -> HostKVEntry | None:
+        """Pop the entry for promotion (host bytes materialised). The
+        caller reports the outcome: `note_hit` after a successful device
+        upload, or `restore` if promotion failed (pool dry) so a later
+        pass can retry."""
+        e = self._entries.pop(rid, None)
+        if e is None:
+            return None
+        self.bytes_used -= e.nbytes
+        if rid in self._pending:
+            self._pending.remove(rid)
+        e.materialize()
+        return e
+
+    def note_hit(self, entry: HostKVEntry) -> None:
+        self.hits += 1
+        self.swap_in_bytes_total += entry.nbytes
+        self.reprefill_tokens_avoided += entry.covered
+
+    def restore(self, entry: HostKVEntry) -> None:
+        """Undo a `take` whose promotion could not get device blocks."""
+        self.bytes_used += entry.nbytes
+        self._entries[entry.rid] = entry
+        self._entries.move_to_end(entry.rid, last=False)  # retry soon: MRU-protect others
+
+    # -- lifecycle ------------------------------------------------------
+    def flush_pending(self) -> None:
+        self._drain_pending(0)
+
+    def clear(self) -> int:
+        """Drop everything (weight installs: KV from old weights must not
+        seed generation under new ones — same rule as parked KV). Each
+        dropped rid is tombstoned, so its resume counts as a miss."""
+        n = len(self._entries)
+        for rid in list(self._entries):
+            self._drop(rid, tombstone=True)
+        self._pending.clear()
+        return n

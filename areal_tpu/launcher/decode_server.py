@@ -123,6 +123,7 @@ class DecodeServer:
                 "max_running_requests": self.config.max_running_requests,
                 "decode_runahead_chunks": self.config.decode_runahead_chunks,
                 "kv_layout": self.config.kv_layout,
+                "kv_host_pool_mb": self.config.kv_host_pool_mb,
                 "paged_attn_impl": self.config.paged_attn_impl,
                 "spec_decode": self.config.spec_decode,
                 "spec_k": self.config.spec_k,
@@ -454,6 +455,7 @@ async def _serve(args: argparse.Namespace) -> None:
         new_tokens_per_chunk=args.new_tokens_per_chunk,
         decode_runahead_chunks=args.decode_runahead_chunks,
         kv_layout=args.kv_layout,
+        kv_host_pool_mb=args.kv_host_pool_mb,
         paged_attn_impl=args.paged_attn_impl,
         spec_decode=args.spec_decode,
         spec_k=args.spec_k,
@@ -548,6 +550,16 @@ def main(argv: list[str] | None = None) -> None:
         help="decode KV access: 'paged' attends in place over the paged "
              "pool through the block table (no per-chunk gather/scatter); "
              "'workspace' is the legacy copy-in/copy-out numerics oracle",
+    )
+    p.add_argument(
+        "--kv-host-pool-mb",
+        type=float,
+        default=0.0,
+        help="host-RAM KV tier budget in MiB (0 disables): eviction "
+             "offloads parked/preempted slots' KV blocks to pinned host "
+             "memory and a resume swaps them back asynchronously instead "
+             "of re-prefilling — kv_pool_tokens becomes a working-set "
+             "knob, not a capacity wall",
     )
     p.add_argument(
         "--paged-attn-impl",

@@ -498,6 +498,192 @@ def bench_spec_compare(model, n_requests, prompt_len, new_tokens, max_running,
     )
 
 
+def bench_kvoffload(model, n_sessions, prompt_len, new_tokens, max_running,
+                    host_mb=256.0, chunk=None):
+    """Tiered KV cache under oversubscription: host-RAM offload
+    (`kv_host_pool_mb`) vs today's drop-and-reprefill, on a session-reuse
+    trace whose working set exceeds the device slots.
+
+    Trace (identical for both engines): `n_sessions` > `max_running`
+    sessions start concurrently and are interrupted mid-stream
+    (pause+abort — the weight-update flush every async-RL step performs);
+    the sessions that never got a slot run to completion first, which
+    forces the LRU eviction of every parked session's KV; then the
+    interrupted sessions RESUME (prompt + partial tokens, same rid). With
+    the host tier the eviction offloaded their KV and the resume promotes
+    it back (fresh blocks + async upload); without it the resume re-runs
+    prefill over the whole conversation. Reported: resume TTFT for both
+    engines (the number long-context session reuse lives or dies on),
+    re-prefill tokens avoided, and the swap traffic that bought it. The
+    offload engine runs FIRST so the warm-XLA-process advantage goes to
+    the re-prefill baseline (same conservative ordering as
+    bench_decode_compare)."""
+    import asyncio
+    import threading
+    import uuid as _uuid
+    from dataclasses import replace as _dc_replace
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.models.qwen2 import init_params
+
+    params = init_params(model, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(11)
+    prompts = [
+        rng.randint(1, model.vocab_size, (prompt_len,)).tolist()
+        for _ in range(n_sessions)
+    ]
+    g = GenerationHyperparameters(
+        max_new_tokens=new_tokens, temperature=1.0, top_p=1.0
+    )
+
+    def run(mb: float) -> dict:
+        dcfg = JaxDecodeConfig(
+            context_length=prompt_len + new_tokens + 128,
+            max_running_requests=max_running,
+            new_tokens_per_chunk=chunk or min(128, new_tokens),
+            kv_host_pool_mb=mb,
+            dtype=model.dtype,
+            kv_cache_dtype=model.dtype,
+        )
+        eng = JaxDecodeEngine(
+            dcfg, InferenceEngineConfig(max_concurrent_rollouts=n_sessions)
+        )
+        eng.set_model(params, model)
+        eng.initialize()
+        try:
+            eng.prewarm(prompt_len=prompt_len, gconfig=g, include_fork=False)
+            # phase 1: all sessions start; interrupt them mid-stream
+            first = [None] * n_sessions
+            rids = [f"sess-{i}-{_uuid.uuid4()}" for i in range(n_sessions)]
+
+            def one_first(i):
+                first[i] = eng.generate(
+                    ModelRequest(
+                        rid=rids[i], input_ids=prompts[i], gconfig=g
+                    ),
+                    timeout=1800,
+                )
+
+            threads = [
+                threading.Thread(target=one_first, args=(i,), daemon=True)
+                for i in range(n_sessions)
+            ]
+            for t in threads:
+                t.start()
+            if not _wait_for_running(eng, 60.0):
+                raise RuntimeError("kvoffload bench: sessions never started")
+            # let the running wave emit some tokens before the flush
+            deadline = time.perf_counter() + 60.0
+            while (
+                eng.get_metrics()["generated_tokens_total"] < max_running
+                and time.perf_counter() < deadline
+            ):
+                time.sleep(0.005)
+            eng.pause_generation()
+            eng.abort_all()
+            eng.continue_generation()
+            for t in threads:
+                t.join(120)
+            interrupted = [
+                i for i, r in enumerate(first)
+                if r is not None and len(r.output_tokens) > 0
+            ]
+            fresh = [
+                i for i, r in enumerate(first)
+                if r is not None and len(r.output_tokens) == 0
+            ]
+            # phase 2a: the never-ran sessions complete first — their slot
+            # demand LRU-evicts every parked session (offload vs drop)
+            with ThreadPoolExecutor(max_workers=max(len(fresh), 1)) as pool:
+                list(
+                    pool.map(
+                        lambda i: eng.generate(
+                            ModelRequest(input_ids=prompts[i], gconfig=g),
+                            timeout=1800,
+                        ),
+                        fresh,
+                    )
+                )
+            m0 = eng.get_metrics()
+            # phase 2b: the interrupted sessions resume (same rid,
+            # prompt + partials) — TTFT here is swap-in vs re-prefill
+            def resume(i):
+                r1 = first[i]
+                return eng.generate(
+                    ModelRequest(
+                        rid=rids[i],  # same rid: the resume-affinity key
+                        input_ids=list(prompts[i]) + list(r1.output_tokens),
+                        gconfig=_dc_replace(
+                            g,
+                            max_new_tokens=max(
+                                new_tokens - len(r1.output_tokens), 1
+                            ),
+                        ),
+                    ),
+                    timeout=1800,
+                )
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(
+                max_workers=max(len(interrupted), 1)
+            ) as pool:
+                resumed = list(pool.map(resume, interrupted))
+            resume_wall = time.perf_counter() - t0
+            m1 = eng.get_metrics()
+            ttfts = np.asarray([r.ttft for r in resumed], dtype=np.float64)
+            return dict(
+                ttft_mean_ms=float(ttfts.mean() * 1e3) if ttfts.size else 0.0,
+                ttft_p50_ms=(
+                    float(np.percentile(ttfts, 50) * 1e3) if ttfts.size else 0.0
+                ),
+                resume_wall_s=resume_wall,
+                n_resumes=len(interrupted),
+                avoided=(
+                    m1["reprefill_tokens_avoided_total"]
+                    - m0["reprefill_tokens_avoided_total"]
+                ),
+                swap_out=m1["kv_swap_out_bytes_total"],
+                swap_in=m1["kv_swap_in_bytes_total"],
+                hit_rate=m1["kv_host_hit_rate"],
+                prefills=m1["prefills_total"] - m0["prefills_total"],
+            )
+        finally:
+            eng.destroy()
+
+    on = run(host_mb)
+    off = run(0.0)
+    return dict(
+        kvoffload_resume_ttft_ms=on["ttft_mean_ms"],
+        kvoffload_resume_ttft_p50_ms=on["ttft_p50_ms"],
+        kvoffload_reprefill_resume_ttft_ms=off["ttft_mean_ms"],
+        kvoffload_reprefill_resume_ttft_p50_ms=off["ttft_p50_ms"],
+        kvoffload_resume_ttft_speedup=(
+            off["ttft_mean_ms"] / on["ttft_mean_ms"]
+            if on["ttft_mean_ms"] > 0
+            else 0.0
+        ),
+        kvoffload_resumes=on["n_resumes"],
+        kvoffload_reprefill_tokens_avoided=on["avoided"],
+        kvoffload_baseline_tokens_avoided=off["avoided"],  # must be 0
+        kvoffload_swap_out_bytes=on["swap_out"],
+        kvoffload_swap_in_bytes=on["swap_in"],
+        kvoffload_host_hit_rate=on["hit_rate"],
+        kvoffload_resume_prefills=on["prefills"],
+        kvoffload_baseline_resume_prefills=off["prefills"],
+        kvoffload_host_pool_mb=host_mb,
+        kvoffload_sessions=n_sessions,
+        kvoffload_prompt_len=prompt_len,
+    )
+
+
 def bench_weightsync(model, n_pushes, chunk_mb, prompt_len, new_tokens):
     """Staged weight-sync bench: transfer time vs commit-pause time.
 
@@ -1057,6 +1243,7 @@ BENCH_MODE_FNS = {
     "ppsched": bench_pp_schedules,
     "weightsync": bench_weightsync,
     "specdecode": bench_spec_compare,
+    "kvoffload": bench_kvoffload,
 }
 BENCH_MODES = ("all", *BENCH_MODE_FNS)
 # headline metric per dev mode (modes that skip the trainer MFU line)
@@ -1068,6 +1255,7 @@ MODE_HEADLINES = {
     "ppsched": ("pp_temp_ratio_gpipe_over_1f1b", "x"),
     "weightsync": ("weightsync_commit_pause_s", "s"),
     "specdecode": ("spec_over_off_speedup", "x"),
+    "kvoffload": ("kvoffload_resume_ttft_speedup", "x"),
 }
 
 
@@ -1388,6 +1576,18 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("kvoffload"):
+            decode.update(
+                _retry_transport(
+                    lambda: bench_kvoffload(
+                        model, n_sessions=96, prompt_len=512, new_tokens=256,
+                        max_running=64, host_mb=2048.0,
+                    ),
+                    what="bench_kvoffload",
+                    attempts=3,
+                    base_delay=15.0,
+                )
+            )
         if want("grpo"):
             # GRPO co-locates trainer (fwd+bwd+opt) and decode engine on
             # one chip: run the actor with remat on to leave HBM headroom
@@ -1511,6 +1711,15 @@ def main() -> None:
                 bench_spec_compare(
                     model, n_requests=8, prompt_len=16, new_tokens=192,
                     max_running=4, chunk=8, spec_k=7,
+                )
+            )
+        if want("kvoffload"):
+            # pool slots (4) well below the 8-session working set, long
+            # prompts so the avoided re-prefill dominates the resume TTFT
+            decode.update(
+                bench_kvoffload(
+                    model, n_sessions=8, prompt_len=256, new_tokens=64,
+                    max_running=4, host_mb=64.0, chunk=8,
                 )
             )
         if want("grpo"):

@@ -36,6 +36,19 @@ def test_every_mode_choice_maps_to_a_runnable_bench():
         assert fn.__name__.startswith("bench_"), (mode, fn.__name__)
 
 
+def test_kvoffload_mode_is_pinned():
+    """ISSUE 7 satellite: the tiered-KV bench must stay reachable as
+    `--mode kvoffload` — a rename/removal of the dispatch entry (which
+    the derived-choices tests above would silently absorb) is a breaking
+    CLI change and must fail here."""
+    bench = _load_bench()
+    assert "kvoffload" in bench.BENCH_MODE_FNS
+    assert bench.BENCH_MODE_FNS["kvoffload"] is bench.bench_kvoffload
+    assert bench.MODE_HEADLINES["kvoffload"] == (
+        "kvoffload_resume_ttft_speedup", "x",
+    )
+
+
 def test_every_dev_mode_has_a_headline_metric():
     bench = _load_bench()
     # dev modes = everything but "all" and "train" (those emit the trainer

@@ -83,7 +83,9 @@ def _make_oracle(params):
         for _ in range(n_new):
             ids = np.zeros(_ORACLE_PAD, dtype=np.int32)
             ids[: len(seq)] = seq
-            seq.append(int(step(ids, len(seq))))
+            # the oracle IS a per-token host sync: each step feeds the
+            # emitted token back into the next python-built input
+            seq.append(int(step(ids, len(seq))))  # areal-lint: disable=AR201
         return seq[len(prompt):]
 
     return greedy_reference
@@ -267,6 +269,83 @@ def test_pool_pressure_preemption_runahead_paged(cpu_devices):
     # the pool pressure must actually have bitten
     assert m["preemptions_total"] > 0, m
     assert m["kv_layout"] == "paged"
+
+
+def test_pool_pressure_offload_swapback_runahead_spec_paged(cpu_devices):
+    """Zero-slack pool + HOST TIER x run-ahead x speculation x paged.
+
+    Same 24-usable-block geometry as the preemption test above, but with
+    `kv_host_pool_mb` enabled and `spec_decode="ngram"` on: the forced
+    `_preempt_slot` now OFFLOADS the victim's KV to host RAM, and the
+    invisible re-admission promotes it back (fresh blocks + async
+    upload) instead of re-prefilling — while runahead=1 keeps a chunk in
+    flight and the drafter/verify path is live. Every completed stream
+    must still match the naive greedy oracle token for token, and the
+    metrics must prove the preempt -> offload -> swap-back cycle
+    actually ran (nonzero swap traffic + avoided re-prefill tokens)."""
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    cfg = JaxDecodeConfig(
+        context_length=128,
+        max_running_requests=3,
+        new_tokens_per_chunk=4,
+        page_size=8,
+        kv_pool_tokens=192,
+        kv_host_pool_mb=64,
+        decode_runahead_chunks=1,
+        kv_layout="paged",
+        paged_attn_impl="xla",
+        spec_decode="ngram",
+        spec_k=3,
+        dtype="float32",
+        kv_cache_dtype="float32",
+    )
+    eng = JaxDecodeEngine(cfg, InferenceEngineConfig(), tokenizer=DigitTok())
+    eng.set_model(params, TINY)
+    eng.initialize()
+    greedy_reference = _make_oracle(params)
+    rng = np.random.default_rng(SEED + 7)
+    jobs = []
+    for _ in range(3):
+        prompt = [int(x) for x in rng.integers(1, 60, 8)]
+        jobs.append(
+            {
+                "prompt": prompt,
+                "full": greedy_reference(prompt, 60),
+                "gconfig": GenerationHyperparameters(
+                    greedy=True, max_new_tokens=60
+                ),
+            }
+        )
+
+    async def main():
+        return await asyncio.gather(
+            *[
+                eng.agenerate(
+                    ModelRequest(input_ids=j["prompt"], gconfig=j["gconfig"])
+                )
+                for j in jobs
+            ]
+        )
+
+    try:
+        results = asyncio.run(main())
+        m = eng.get_metrics()
+    finally:
+        eng.destroy()
+    for i, (j, r) in enumerate(zip(jobs, results)):
+        assert r.output_tokens == j["full"], (
+            f"job {i}: preempt->offload->swap-back broke greedy parity: "
+            f"{r.output_tokens} != {j['full']}"
+        )
+        assert r.stop_reason == "length", (i, r.stop_reason)
+        assert len(r.output_logprobs) == len(r.output_tokens), i
+    # the whole tiered lifecycle must actually have run
+    assert m["preemptions_total"] > 0, m
+    assert m["kv_swap_out_bytes_total"] > 0, m
+    assert m["kv_swap_in_bytes_total"] > 0, m
+    assert m["kv_host_hits_total"] > 0, m
+    assert m["reprefill_tokens_avoided_total"] > 0, m
+    assert m["spec_chunks_total"] > 0, m  # speculation was live throughout
 
 
 @pytest.mark.slow
