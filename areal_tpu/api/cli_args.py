@@ -360,6 +360,70 @@ class JaxDecodeConfig:
     log_level: str = "info"
     enable_metrics: bool = False
     decode_log_interval: int = 40
+    # Server-side idempotency table (launcher/decode_server.py): /generate
+    # requests carrying an `xid` delivery id are deduplicated — a retry of
+    # an in-flight submission awaits the SAME engine future and a replay of
+    # a completed one returns the cached response, so client retry + router
+    # failover-requeue can never double-generate a rollout. Entries are
+    # bounded (LRU) and completed entries expire after the TTL.
+    idempotency_entries: int = 4096
+    idempotency_ttl_s: float = 600.0
+
+
+@dataclass
+class RouterConfig:
+    """Fleet router (launcher/router.py) policy knobs.
+
+    The router turns N decode-server replicas into one service: policy
+    scheduling with prefix affinity, pressure-aware admission with a
+    bounded queue, and exactly-once failover (parity:
+    realhf/system/gserver_manager.py, grown per ROADMAP item 3).
+    """
+
+    # "prefix_affinity" (default: bucketed prompt-prefix hashing with a
+    # load override), "least_token_usage", "least_requests", "round_robin"
+    schedule_policy: str = "prefix_affinity"
+    max_concurrent_rollouts: int = 1024
+    max_head_offpolicyness: int = 1_000_000_000
+    train_batch_size: int = 1
+    health_poll_interval: float = 5.0
+    # -- prefix affinity ------------------------------------------------
+    # prompt prefixes are hashed at block granularity: the first
+    # prefix_block_tokens, 2x, ... up to prefix_max_blocks blocks; the
+    # LONGEST hash with a live affinity entry wins (a cheap radix-tree
+    # approximation), so GRPO group members / multi-turn sessions /
+    # dup-prompt forks land on the replica already holding their donor KV
+    prefix_block_tokens: int = 64
+    prefix_max_blocks: int = 4
+    # affinity-vs-load override: the affine server is skipped when its
+    # token load exceeds factor x the least-loaded admissible server's
+    # (plus one block of slack) — affinity must not melt a hot replica
+    affinity_load_factor: float = 1.5
+    # -- pressure-aware admission --------------------------------------
+    # fraction of a replica's kv pool the router may fill before the
+    # replica stops being admissible (fragmented blocks are subtracted);
+    # replicas whose host KV tier is enabled admit to the full pool
+    # (eviction offloads instead of dropping)
+    kv_pressure_high: float = 0.9
+    # cap on running+queued requests per replica (0 = unlimited)
+    max_inflight_per_server: int = 0
+    # -- bounded queueing ----------------------------------------------
+    # requests that no replica can admit wait in a bounded FIFO; past the
+    # bound (or past the deadline) they are shed with 429 + Retry-After
+    queue_max: int = 1024
+    queue_timeout_s: float = 30.0
+    retry_after_s: float = 1.0
+    # -- failover -------------------------------------------------------
+    # consecutive failed health polls before a replica is declared dead:
+    # its in-flight qids are requeued onto survivors and its affinity
+    # entries drained
+    dead_after_failures: int = 2
+    # -- state expiry ---------------------------------------------------
+    # TTL for qid/prefix affinity entries (a crashed client must not leak
+    # load accounting forever); 0 disables TTL expiry. route_max_entries
+    # LRU-bounds the qid and prefix maps independently of the TTL.
+    route_ttl_s: float = 600.0
+    route_max_entries: int = 65536
 
 
 @dataclass
@@ -388,6 +452,20 @@ class InferenceEngineConfig:
     # staged push (device→host gather of bucket N+1 overlaps the HTTP POST
     # of bucket N; bounded so host memory stays at inflight × chunk_mb).
     weight_sync_inflight_buckets: int = 2
+    # Router-aware failover: when a /generate attempt exhausts its
+    # transport retries (replica died mid-request), the client re-schedules
+    # via the fleet router (or the local least-load fallback, excluding the
+    # failed address) and re-sends with the SAME delivery id (xid) — the
+    # server-side idempotency table makes the retry exactly-once. This caps
+    # how many distinct replicas one submission may fail over across.
+    fleet_failover_retries: int = 2
+    # per-attempt timeout for /schedule_request against the fleet router
+    # (queued requests are held by the router up to its queue_timeout_s,
+    # so this must comfortably exceed it)
+    router_request_timeout: float = 60.0
+    # Fleet router policy knobs (launcher/router.py); launchers pass these
+    # through when they spawn the router job.
+    router: RouterConfig = field(default_factory=RouterConfig)
 
 
 @dataclass

@@ -9,8 +9,17 @@ the way SGLang/vLLM do in the reference.
 Key behaviors preserved:
 - Server discovery: explicit addrs -> name_resolve subtree ->
   AREAL_LLM_SERVER_ADDRS env (reference :280-307).
-- Round-robin scheduling with rid->server affinity so resumed (interrupted)
-  requests land on the server holding their KV prefix (reference :404-413).
+- Least-token-load local scheduling (the same estimate the fleet router
+  uses: prompt_len + 0.4*max_new_tokens) with rid->server affinity so
+  resumed (interrupted) requests land on the server holding their KV
+  prefix (reference :404-413); round-robin breaks ties.
+- Router-aware failover: a /generate whose transport retries are
+  exhausted (replica died mid-request) is re-scheduled — via the fleet
+  router with requeue=True, or locally excluding the failed address — and
+  re-sent with the SAME delivery id (xid), which the servers' idempotency
+  table makes exactly-once (no double-generation, no lost rollout). A 429
+  from the router's bounded admission queue is honored by sleeping
+  Retry-After and re-asking instead of dogpiling servers directly.
 - Interruptible generation loop: when a server flushes a request during a
   weight update the response carries stop_reason="interrupt"; the client
   appends the partial tokens to the prompt and re-submits until finishing
@@ -26,8 +35,10 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import os
+import re
 import threading
 import time
+import uuid
 from typing import Any
 
 from areal_tpu.api.cli_args import InferenceEngineConfig
@@ -38,6 +49,7 @@ from areal_tpu.utils import logging, names
 from areal_tpu.utils import name_resolve
 from areal_tpu.utils.lock import OrderedLock
 from areal_tpu.utils.http import (
+    HttpRequestError,
     arequest_with_retry,
     close_current_session,
     wait_server_healthy,
@@ -118,10 +130,15 @@ class RemoteInfEngine(InferenceEngine):
         self.addresses: list[str] = []
         self._router: str | None = None  # cached names.rollout_router lookup
         self._router_next_lookup = 0.0  # negative-lookup cooldown clock
-        # round-robin cursor + rid affinity map, both mutated from the
-        # rollout event loop AND main-thread callers — one lock for both
+        # round-robin cursor + rid affinity map + per-server estimated
+        # token load, all mutated from the rollout event loop AND
+        # main-thread callers — one lock for all three
         self._server_idx = 0  # guarded-by: _rid_lock
-        self._rid_to_addr: dict[str, str] = {}
+        self._rid_to_addr: dict[str, str] = {}  # guarded-by: _rid_lock
+        # local least-token-load fallback (same estimate the router uses):
+        # cost added at choose_server, released when the rid finishes
+        self._addr_est_load: dict[str, float] = {}  # guarded-by: _rid_lock
+        self._rid_cost: dict[str, float] = {}  # guarded-by: _rid_lock
         self._rid_lock = OrderedLock("remote_inf._rid_lock", rank=10)
         self._version = 0
         self._executor: WorkflowExecutor | None = None
@@ -223,58 +240,179 @@ class RemoteInfEngine(InferenceEngine):
         self._router = addr
         return addr or None
 
-    async def _schedule_via_router(self, req: ModelRequest) -> str | None:
+    async def _schedule_via_router(
+        self, req: ModelRequest, requeue: bool = False
+    ) -> str | None:
         router = self._router_addr()
         if router is None:
             return None
-        try:
-            out = await arequest_with_retry(
-                router,
-                "/schedule_request",
-                payload=dict(
-                    qid=req.rid,
-                    prompt_len=len(req.input_ids),
-                    group_size=req.gconfig.n_samples,
-                    new_token_budget=req.gconfig.max_new_tokens,
-                ),
-                max_retries=2,
-                timeout=30,
-            )
-            return out["url"]
-        except Exception as e:  # noqa: BLE001 — degrade to local policy
-            logger.warning(f"router schedule failed ({e!r}); using local policy")
-            # invalidate the cached address: a restarted router registers
-            # under a new port, the cooldown re-lookup will find it
-            self._router = ""
-            self._router_next_lookup = time.monotonic() + 30.0
-            return None
+        # the prefix the router's affinity hashing buckets (64-token
+        # blocks, up to 4): enough for the longest bucket, cheap to ship
+        payload = dict(
+            qid=req.rid,
+            prompt_len=len(req.input_ids),
+            group_size=req.gconfig.n_samples,
+            new_token_budget=req.gconfig.max_new_tokens,
+            input_prefix=[int(t) for t in req.input_ids[:256]],
+        )
+        if requeue:
+            payload["requeue"] = True
+        deadline = time.monotonic() + self.config.request_timeout
+        backoff = 1.0
+        while True:
+            try:
+                out = await arequest_with_retry(
+                    router,
+                    "/schedule_request",
+                    payload=payload,
+                    max_retries=2,
+                    timeout=self.config.router_request_timeout,
+                )
+                return out["url"]
+            except HttpRequestError as e:
+                if e.status == 429 and time.monotonic() < deadline:
+                    # the router's bounded admission queue shed us: honor
+                    # Retry-After instead of dogpiling a server directly
+                    # (which would trigger the preemption storm the queue
+                    # exists to prevent)
+                    m = re.search(r'"retry_after":\s*([0-9.]+)', str(e))
+                    wait = float(m.group(1)) if m else backoff
+                    backoff = min(backoff * 2, 10.0)
+                    await asyncio.sleep(wait)
+                    continue
+                return self._router_schedule_failed(e)
+            except Exception as e:  # noqa: BLE001 — degrade to local policy
+                return self._router_schedule_failed(e)
 
-    def choose_server(self, rid: str | None = None) -> str:
-        # the whole affinity-lookup + round-robin bump sits under _rid_lock:
-        # the cursor increment was previously outside it, so concurrent
-        # callers (rollout event loop vs main thread) could lose increments
-        # and dogpile one server
+    def _router_schedule_failed(self, e: Exception) -> None:
+        logger.warning(f"router schedule failed ({e!r}); using local policy")
+        # invalidate the cached address: a restarted router registers
+        # under a new port, the cooldown re-lookup will find it
+        self._router = ""
+        self._router_next_lookup = time.monotonic() + 30.0
+        return None
+
+    def choose_server(
+        self,
+        rid: str | None = None,
+        cost: float = 0.0,
+        exclude: str | None = None,
+    ) -> str:
+        """Routerless fallback: pick the server with the least ESTIMATED
+        token load (the same prompt + 0.4*budget estimate the fleet
+        router's accounting uses — ISSUE 8 satellite: the fallback must
+        not bypass the routing policy), round-robin on ties. `cost` is
+        charged to the chosen address until `_release_local(rid)`;
+        `exclude` skips a failed address during failover."""
+        # the whole affinity-lookup + pick sits under _rid_lock: the cursor
+        # increment was previously outside it, so concurrent callers
+        # (rollout event loop vs main thread) could lose increments and
+        # dogpile one server
         with self._rid_lock:
             if rid is not None:
                 cached = self._rid_to_addr.get(rid)
-                if cached is not None:
+                if cached is not None and cached != exclude:
                     return cached
-            addr = self.addresses[self._server_idx % len(self.addresses)]
+            pool = [a for a in self.addresses if a != exclude] or list(
+                self.addresses
+            )
+            # tie-break by round-robin order so equal-load picks rotate
+            n = len(pool)
+            order = {
+                a: i for i, a in enumerate(
+                    pool[self._server_idx % n:] + pool[: self._server_idx % n]
+                )
+            }
+            addr = min(
+                pool,
+                key=lambda a: (self._addr_est_load.get(a, 0.0), order[a]),
+            )
             self._server_idx += 1
+            if cost:
+                self._addr_est_load[addr] = (
+                    self._addr_est_load.get(addr, 0.0) + cost
+                )
             if rid is not None:
                 self._rid_to_addr[rid] = addr
+                if cost:
+                    self._rid_cost[rid] = self._rid_cost.get(rid, 0.0) + cost
                 if len(self._rid_to_addr) > 65536:
-                    # drop oldest half to bound memory
+                    # drop oldest half to bound memory (and release their
+                    # load estimate — leaked rids must not skew scheduling)
                     for k in list(self._rid_to_addr)[:32768]:
-                        self._rid_to_addr.pop(k, None)
+                        self._release_local_locked(k)
         return addr
 
+    def _release_local_locked(self, rid: str) -> None:
+        addr = self._rid_to_addr.pop(rid, None)
+        c = self._rid_cost.pop(rid, None)
+        if addr is not None and c:
+            self._addr_est_load[addr] = max(
+                0.0, self._addr_est_load.get(addr, 0.0) - c
+            )
+
+    def _release_local(self, rid: str) -> None:
+        with self._rid_lock:
+            self._release_local_locked(rid)
+
     # -- generation -----------------------------------------------------
+    @staticmethod
+    def _local_cost(req: ModelRequest) -> float:
+        """The router's load estimate, reused by the local fallback."""
+        return float(len(req.input_ids)) + 0.4 * float(
+            req.gconfig.max_new_tokens
+        )
+
+    async def _generate_failover(
+        self, req: ModelRequest, payload: dict[str, Any], addr: str
+    ) -> tuple[dict[str, Any], str]:
+        """POST /generate with router-aware failover: when the transport
+        retries to `addr` are exhausted (the replica died mid-request),
+        re-schedule — via the router with requeue=True (whose failover has
+        re-pointed the qid at a survivor), or locally excluding the failed
+        address — and re-send the SAME payload (same xid: the server-side
+        idempotency table makes the retry exactly-once). Returns (response,
+        address that served it)."""
+        for attempt in range(self.config.fleet_failover_retries + 1):
+            try:
+                data = await arequest_with_retry(
+                    addr,
+                    "/generate",
+                    payload=payload,
+                    max_retries=self.config.request_retries,
+                    timeout=self.config.request_timeout,
+                )
+                return data, addr
+            except Exception as e:  # noqa: BLE001 — classify below
+                if (
+                    isinstance(e, HttpRequestError)
+                    and e.status is not None
+                    and e.status < 500
+                ):
+                    raise  # a real 4xx: retrying elsewhere cannot help
+                if attempt >= self.config.fleet_failover_retries:
+                    raise
+                logger.warning(
+                    f"/generate to {addr} failed ({e!r}); failing over"
+                )
+                routed = await self._schedule_via_router(req, requeue=True)
+                if routed is None or routed == addr:
+                    self._release_local(req.rid)
+                    routed = self.choose_server(
+                        req.rid, cost=self._local_cost(req), exclude=addr
+                    )
+                if routed == addr:
+                    raise  # single-server fleet: nowhere to fail over
+                addr = routed
+        raise AssertionError("unreachable")
+
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         """Generate with the interrupt-resume loop (reference :428-478)."""
         start = time.monotonic()
         routed = await self._schedule_via_router(req)
-        addr = routed or self.choose_server(req.rid)
+        addr = routed or self.choose_server(
+            req.rid, cost=self._local_cost(req)
+        )
         prompt = list(req.input_ids)
         acc_tokens: list[int] = []
         acc_logprobs: list[float] = []
@@ -291,13 +429,13 @@ class RemoteInfEngine(InferenceEngine):
                         0, req.gconfig.min_new_tokens - len(acc_tokens)
                     ),
                 )
-                data = await arequest_with_retry(
-                    addr,
-                    "/generate",
-                    payload=self.backend.build_generate_payload(work),
-                    max_retries=self.config.request_retries,
-                    timeout=self.config.request_timeout,
-                )
+                payload = self.backend.build_generate_payload(work)
+                # delivery id: stable across transport retries AND the
+                # failover re-send of THIS submission (so a duplicate can
+                # never double-generate), fresh for each resume iteration
+                # (which is a new logical submission)
+                payload["xid"] = uuid.uuid4().hex
+                data, addr = await self._generate_failover(req, payload, addr)
                 out = self.backend.parse_generate_response(data)
                 acc_tokens.extend(out["output_tokens"])
                 acc_logprobs.extend(out["output_logprobs"])
@@ -315,8 +453,7 @@ class RemoteInfEngine(InferenceEngine):
         finally:
             # release bookkeeping even when generation fails — a leaked
             # router qid biases least-load scheduling forever
-            with self._rid_lock:
-                self._rid_to_addr.pop(req.rid, None)
+            self._release_local(req.rid)
             if routed is not None:
                 try:
                     await arequest_with_retry(

@@ -3874,6 +3874,23 @@ class JaxDecodeEngine(InferenceEngine):
             "kv_tokens_allocated": (
                 self._alloc.allocated_tokens() if self._alloc else 0
             ),
+            # pool capacity + fill fraction in token units — the signals
+            # the fleet router's pressure-aware admission routes on
+            # (launcher/router.py _kv_headroom)
+            "kv_pool_tokens_total": (
+                self._alloc.usable_blocks * self._alloc.block_size
+                if self._alloc
+                else 0
+            ),
+            "kv_pool_occupancy": (
+                round(
+                    self._alloc.allocated_tokens()
+                    / (self._alloc.usable_blocks * self._alloc.block_size),
+                    6,
+                )
+                if self._alloc and self._alloc.usable_blocks
+                else 0.0
+            ),
             # host-RAM KV tier (kv_host_pool_mb): the eviction paths
             # offload parked/preempted KV here instead of dropping it;
             # resume promotes it back. All zeros when disabled.

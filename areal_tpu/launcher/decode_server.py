@@ -9,7 +9,13 @@ name_resolve registration).
 Endpoints:
   GET  /health                  -> {"status": "ok", "version": N}
   GET  /info                    -> model/config metadata
-  POST /generate                -> one completion w/ token logprobs+versions
+  POST /generate                -> one completion w/ token logprobs+versions;
+                                   an optional "xid" delivery id makes the
+                                   call idempotent: a retry of an in-flight
+                                   submission awaits the SAME engine future
+                                   and a replay of a completed one returns
+                                   the cached response (exactly-once under
+                                   client retry + router failover-requeue)
   POST /pause_generation        -> pause on chunk boundary; {"abort": true}
                                    flushes in-flight requests, which return
                                    stop_reason="interrupt" (partial rollout)
@@ -36,6 +42,7 @@ import dataclasses
 import os
 import socket
 import time
+from collections import OrderedDict
 from typing import Any
 
 from aiohttp import web
@@ -67,10 +74,15 @@ class DecodeServer:
         inference_config: InferenceEngineConfig | None = None,
         tokenizer: Any = None,
         engine: Any = None,
+        shutdown_grace: float = 5.0,
     ):
         from areal_tpu.engine.jax_decode import JaxDecodeEngine
 
         self.config = config
+        # how long stop() waits for in-flight handlers before cancelling
+        # them (aiohttp shutdown_timeout); short so a killed replica's
+        # clients fail fast into their router-aware failover retry
+        self.shutdown_grace = shutdown_grace
         self.engine = engine or JaxDecodeEngine(
             config, inference_config or InferenceEngineConfig(), tokenizer
         )
@@ -108,6 +120,18 @@ class DecodeServer:
             commit_pause_secs=0.0,
             aborted_pushes=0,
         )
+        # Idempotency table (exactly-once failover, ISSUE 8): xid ->
+        # {"done": False, "fut": Future} while a submission is in flight,
+        # {"done": True, "resp": dict, "t": monotonic} afterwards. All
+        # reads/writes happen on the one aiohttp event loop with no await
+        # between check-and-insert, so the table needs no lock; duplicates
+        # await the in-flight future via asyncio.shield (a shed duplicate
+        # must not cancel the original generation). Bounded by
+        # config.idempotency_entries (LRU) + idempotency_ttl_s (completed
+        # entries only — in-flight ones are naturally bounded by the
+        # engine's concurrency).
+        self._idem: "OrderedDict[str, dict[str, Any]]" = OrderedDict()
+        self._idem_hits = 0
 
     # -- handlers -------------------------------------------------------
     async def _health(self, request: web.Request) -> web.Response:
@@ -132,26 +156,77 @@ class DecodeServer:
             }
         )
 
+    def _prune_idem(self) -> None:
+        now = time.monotonic()
+        ttl = self.config.idempotency_ttl_s
+        for xid in list(self._idem):
+            ent = self._idem[xid]
+            if ent["done"] and now - ent["t"] > ttl:
+                del self._idem[xid]
+        while len(self._idem) > max(1, self.config.idempotency_entries):
+            # oldest completed entry first; in-flight entries only under
+            # pathological pressure (they are few: engine concurrency)
+            victim = next(
+                (x for x, e in self._idem.items() if e["done"]),
+                next(iter(self._idem)),
+            )
+            del self._idem[victim]
+
     async def _generate(self, request: web.Request) -> web.Response:
         body = await request.json()
+        xid = body.get("xid")
+        if xid is not None:
+            ent = self._idem.get(xid)
+            if ent is not None:
+                # duplicate delivery (client transport retry, or a retry
+                # after failover raced the original): never re-generate
+                self._idem_hits += 1
+                if ent["done"]:
+                    self._idem.move_to_end(xid)
+                    return web.json_response(
+                        {**ent["resp"], "dedup": "completed"}
+                    )
+                out = await asyncio.shield(ent["fut"])
+                return web.json_response({**out, "dedup": "in_progress"})
+            ent = {
+                "done": False,
+                "fut": asyncio.get_running_loop().create_future(),
+                "t": time.monotonic(),
+            }
+            self._idem[xid] = ent
         req = ModelRequest(
             rid=body.get("rid") or ModelRequest().rid,
             input_ids=[int(t) for t in body["input_ids"]],
             gconfig=_parse_gconfig(body.get("gconfig", {})),
             image_data=body.get("image_data"),
         )
-        resp = await self.engine.agenerate(req)
-        return web.json_response(
-            {
-                "output_tokens": resp.output_tokens,
-                "output_logprobs": resp.output_logprobs,
-                "output_versions": resp.output_versions,
-                "stop_reason": resp.stop_reason,
-                "latency": resp.latency,
-                "ttft": resp.ttft,
-                "itl": resp.itl,
-            }
-        )
+        try:
+            resp = await self.engine.agenerate(req)
+        except BaseException as e:
+            if xid is not None and self._idem.get(xid) is ent:
+                del self._idem[xid]
+                if not ent["fut"].done():
+                    ent["fut"].set_exception(e)
+                    # mark retrieved: with no duplicate awaiting, an
+                    # unconsumed future exception would log noise
+                    ent["fut"].exception()
+            raise
+        out = {
+            "output_tokens": resp.output_tokens,
+            "output_logprobs": resp.output_logprobs,
+            "output_versions": resp.output_versions,
+            "stop_reason": resp.stop_reason,
+            "latency": resp.latency,
+            "ttft": resp.ttft,
+            "itl": resp.itl,
+        }
+        if xid is not None and self._idem.get(xid) is ent:
+            self._idem[xid] = {"done": True, "resp": out, "t": time.monotonic()}
+            self._idem.move_to_end(xid)
+            if not ent["fut"].done():
+                ent["fut"].set_result(out)
+            self._prune_idem()
+        return web.json_response(out)
 
     async def _metrics(self, request: web.Request) -> web.Response:
         """Live engine load counters (running/queued requests, active KV
@@ -170,6 +245,10 @@ class DecodeServer:
         out["weight_sync"] = dict(
             self._sync_stats, staged_tensors=len(self._weight_staging)
         )
+        # rid-dedup observability: table occupancy + duplicate deliveries
+        # prevented (the exactly-once evidence bench --mode fleet reads)
+        out["idem_entries"] = len(self._idem)
+        out["idem_hits_total"] = self._idem_hits
         return web.json_response(out)
 
     async def _pause(self, request: web.Request) -> web.Response:
@@ -408,7 +487,9 @@ class DecodeServer:
             await asyncio.get_running_loop().run_in_executor(
                 None, lambda: self.engine.prewarm(**prewarm)
             )
-        self._runner = web.AppRunner(self.build_app())
+        self._runner = web.AppRunner(
+            self.build_app(), shutdown_timeout=self.shutdown_grace
+        )
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
         await site.start()

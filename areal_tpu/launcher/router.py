@@ -1,25 +1,52 @@
-"""Decode-fleet router: request scheduling + server-side staleness gate.
+"""Decode-fleet router: prefix-affinity scheduling, pressure-aware
+admission with bounded queueing, and exactly-once failover.
 
 Parity: realhf/system/gserver_manager.py:32 (GserverManager) — the service
 that turns N independent decode servers into one fleet:
 
 - **/schedule_request**: pick a server for a new generation request by
-  policy — `round_robin`, `least_requests`, or `least_token_usage` — with
-  qid affinity (all samples of one prompt group land on the same server, so
-  its prefix cache works; gserver_manager.py:371-390). A request that
-  resumes on the same weight version keeps its previous server (KV reuse).
+  policy — `prefix_affinity` (default), `round_robin`, `least_requests`,
+  or `least_token_usage` — with qid affinity (all samples of one prompt
+  group land on the same server, so its prefix cache works;
+  gserver_manager.py:371-390). A request that resumes on the same weight
+  version keeps its previous server (KV reuse). `prefix_affinity`
+  additionally hashes the tokenized prompt prefix at block granularity
+  (`prefix_block_tokens` x 1..`prefix_max_blocks`, longest match wins)
+  into a per-server affinity map so GRPO group members, multi-turn
+  sessions, and dup-prompt forks land on the replica already holding
+  their donor KV blocks — overridden when the affine server is hot
+  (`affinity_load_factor`).
+
+  Admission is pressure-aware: the health poll snapshots each replica's
+  kv-pool occupancy/fragmentation, host-tier state, and in-flight depth
+  from `/metrics`; a request that would overflow EVERY replica's pool
+  enters a bounded FIFO (deadline-based shedding; past `queue_max` or the
+  deadline it is shed with 429 + Retry-After) instead of dogpiling the
+  least-bad server and triggering a preemption storm.
+
 - **/allocate_rollout**: the server-side staleness gate
   (gserver_manager.py:334 `is_staled`): expected_version =
   (trainer-consumed samples + running rollouts) // train_batch_size must
   not exceed current weight version + max_head_offpolicyness. The trainer
   publishes its consumed-sample counter under names.training_samples.
 - **/finish_rollout**: decrement running, release load accounting.
+- **/metrics**: routing observability — queue depth/sheds/timeouts,
+  affinity hit rate, requeues, per-server pressure snapshots.
+
+**Failover**: `dead_after_failures` consecutive failed health polls
+declare a replica dead; its in-flight qids are requeued onto the
+least-loaded survivors (so the clients' router-aware retries land there
+deterministically) and every affinity entry pointing at the corpse is
+drained. Exactly-once delivery is the pair of this requeue with the
+decode servers' idempotency table (rid/xid dedup in
+launcher/decode_server.py): a client retry can never double-generate or
+double-count a rollout.
 
 TPU-shape differences from the reference: weight versions come from the
 decode servers' /health (they learn versions via the DCN push path, not
 disk-reload polling), so the router polls health rather than orchestrating
-`/update_weights_from_disk`; and load metrics are the router's own
-accounting (our servers don't export Prometheus counters).
+`/update_weights_from_disk`; load metrics combine the servers' own
+/metrics gauges with the router's routed-since-poll estimates.
 
 Run: ``python -m areal_tpu.launcher.router --experiment-name e --trial-name t``
 (servers discovered via name_resolve) or ``--servers host:p1,host:p2``.
@@ -29,12 +56,15 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
+import math
 import time
-from collections import defaultdict
+from collections import OrderedDict, defaultdict, deque
 from typing import Any
 
 from aiohttp import web
 
+from areal_tpu.api.cli_args import RouterConfig
 from areal_tpu.utils import logging, name_resolve, names
 from areal_tpu.utils.http import arequest_with_retry
 from areal_tpu.utils.network import find_free_ports, gethostip
@@ -45,6 +75,61 @@ logger = logging.getLogger("rollout_router")
 # considered stale and dropped (least_token_usage then uses the estimate)
 _METRICS_FAIL_LIMIT = 3
 
+# Concurrency contract, checked by areal-lint (AR101/AR104; docs/ANALYSIS.md).
+# Every handler AND the poll loop run on ONE aiohttp event loop; _lock is an
+# asyncio.Lock making multi-field updates atomic across the awaits inside
+# handlers. The registry declares the shared routing state that contract
+# serializes (the lexical `async with self._lock` blocks are the guard).
+_GUARDED_BY = {
+    "DecodeRouter._rr": "_lock",
+    "DecodeRouter._request_counts": "_lock",
+    "DecodeRouter._token_usage": "_lock",
+    "DecodeRouter._measured_tokens": "_lock",
+    "DecodeRouter._est_since_poll": "_lock",
+    "DecodeRouter._metrics_fail": "_lock",
+    "DecodeRouter._health_fail": "_lock",
+    "DecodeRouter._pressure": "_lock",
+    "DecodeRouter._qid_to_server": "_lock",
+    "DecodeRouter._qid_cost": "_lock",
+    "DecodeRouter._qid_pending": "_lock",
+    "DecodeRouter._qid_touched": "_lock",
+    "DecodeRouter._prefix_map": "_lock",
+    "DecodeRouter._waitq": "_lock",
+    "DecodeRouter._counters": "_lock",
+    "DecodeRouter._versions": "_lock",
+    "DecodeRouter._running": "_lock",
+    "DecodeRouter._submitted": "_lock",
+    "DecodeRouter._accepted": "_lock",
+}
+
+# /metrics keys the admission controller snapshots per replica
+_PRESSURE_KEYS = (
+    "running_requests",
+    "queued_requests",
+    "queued_tokens",
+    "active_tokens",
+    "kv_block_size",
+    "kv_blocks_total",
+    "kv_blocks_free",
+    "kv_pool_fragmentation",
+    "kv_tokens_allocated",
+    "kv_host_pool_enabled",
+    "kv_host_pool_occupancy",
+    "prefix_cache_hit_rate",
+)
+
+
+class _Waiter:
+    """One queued /schedule_request: resolved by the drain, or shed."""
+
+    __slots__ = ("fut", "req", "enq_t", "deadline")
+
+    def __init__(self, fut: asyncio.Future, req: dict, enq_t: float, deadline: float):
+        self.fut = fut
+        self.req = req
+        self.enq_t = enq_t
+        self.deadline = deadline
+
 
 class DecodeRouter:
     def __init__(
@@ -53,19 +138,20 @@ class DecodeRouter:
         trial_name: str = "",
         servers: list[str] | None = None,
         *,
-        schedule_policy: str = "least_requests",
-        max_concurrent_rollouts: int = 1024,
-        max_head_offpolicyness: int = 1_000_000_000,
-        train_batch_size: int = 1,
-        health_poll_interval: float = 5.0,
+        config: RouterConfig | None = None,
+        **overrides: Any,
     ):
+        cfg = config or RouterConfig()
+        if overrides:
+            cfg = dataclasses.replace(cfg, **overrides)
+        self.config = cfg
         self.experiment_name = experiment_name
         self.trial_name = trial_name
-        self.schedule_policy = schedule_policy
-        self.max_concurrent_rollouts = max_concurrent_rollouts
-        self.max_head_offpolicyness = max_head_offpolicyness
-        self.train_batch_size = max(1, train_batch_size)
-        self.health_poll_interval = health_poll_interval
+        self.schedule_policy = cfg.schedule_policy
+        self.max_concurrent_rollouts = cfg.max_concurrent_rollouts
+        self.max_head_offpolicyness = cfg.max_head_offpolicyness
+        self.train_batch_size = max(1, cfg.train_batch_size)
+        self.health_poll_interval = cfg.health_poll_interval
 
         self._seed_servers: list[str] = list(servers or [])
         self.servers: list[str] = list(self._seed_servers)
@@ -82,11 +168,36 @@ class DecodeRouter:
         # degrades to the router's own estimate instead of keeping an
         # arbitrarily stale measurement forever
         self._metrics_fail: dict[str, int] = defaultdict(int)
+        # consecutive failed /health polls: crossing dead_after_failures
+        # triggers failover (requeue + affinity drain) exactly once
+        self._health_fail: dict[str, int] = defaultdict(int)
+        # last /metrics pressure snapshot per server (admission inputs)
+        self._pressure: dict[str, dict[str, Any]] = {}
         self._qid_to_server: dict[str, str] = {}
         self._qid_cost: dict[str, float] = {}
         # one qid may carry several in-flight requests (a GRPO group shares
         # its prompt's rid); release accounting one unit per finish
         self._qid_pending: dict[str, int] = {}
+        # last-touched clock per qid (TTL expiry of leaked entries)
+        self._qid_touched: dict[str, float] = {}
+        # prefix-hash -> (server, last_used); recency-ordered (LRU + TTL)
+        self._prefix_map: "OrderedDict[int, tuple[str, float]]" = OrderedDict()
+        # bounded FIFO of unschedulable requests (pressure everywhere)
+        self._waitq: deque[_Waiter] = deque()
+        self._counters: dict[str, int] = dict(
+            schedules_total=0,
+            affinity_hits_total=0,
+            affinity_overrides_total=0,
+            queue_enqueues_total=0,
+            queue_admits_total=0,
+            queue_sheds_total=0,
+            queue_timeouts_total=0,
+            client_requeues_total=0,
+            requeues_total=0,
+            failovers_total=0,
+            expired_qids_total=0,
+            expired_prefixes_total=0,
+        )
         self._versions: dict[str, int] = {}
         self._running = 0  # guarded-by: _lock
         self._submitted = 0  # guarded-by: _lock
@@ -132,7 +243,7 @@ class DecodeRouter:
                         version = int(data.get("version", 0))
                     except Exception:  # noqa: BLE001 — dead server drops out
                         logger.warning(f"server {s} failed health poll")
-                        return s, None, None, 0.0
+                        return s, None, None, 0.0, None
                     est_snapshot = self._est_since_poll[s]
                     try:
                         m = await arequest_with_retry(
@@ -148,38 +259,168 @@ class DecodeRouter:
                             if "active_tokens" in m
                             else None
                         )
+                        pressure = (
+                            {k: m[k] for k in _PRESSURE_KEYS if k in m}
+                            if "active_tokens" in m
+                            else None
+                        )
                     except Exception:  # noqa: BLE001 — metrics optional
                         load = None
-                    return s, version, load, est_snapshot
+                        pressure = None
+                    return s, version, load, est_snapshot, pressure
 
                 # fan out: one hung server must not stale the whole fleet's
                 # measurements for its full timeout
                 probes = await asyncio.gather(*(probe(s) for s in servers))
                 async with self._lock:
-                    versions = {
-                        s: v for s, v, _, _ in probes if v is not None
-                    }
-                    self.servers = [s for s in servers if s in versions]
-                    self._versions = versions
-                    for s, v, load, est_snapshot in probes:
-                        if v is None or load is None:
-                            self._metrics_fail[s] += 1
-                            if (
-                                self._metrics_fail[s] >= _METRICS_FAIL_LIMIT
-                                and s in self._measured_tokens
-                            ):
-                                del self._measured_tokens[s]
-                            continue
-                        self._metrics_fail[s] = 0
-                        self._measured_tokens[s] = load
-                        # subtract only what the measurement could have
-                        # seen; later routings keep their estimated cost
-                        self._est_since_poll[s] = max(
-                            0.0, self._est_since_poll[s] - est_snapshot
-                        )
+                    self._apply_probes_locked(servers, probes)
+                    self._expire_locked(time.monotonic(), servers)
+                    self._drain_queue_locked()
             except Exception as e:  # noqa: BLE001 — keep the loop alive
                 logger.warning(f"router poll loop error: {e!r}")
             await asyncio.sleep(self.health_poll_interval)
+
+    def _apply_probes_locked(self, servers: list[str], probes) -> None:
+        """Fold one poll round into the fleet view: live-server set,
+        versions, measured loads, pressure snapshots, and the
+        failed-health / failed-metrics staleness counters (split out of
+        _poll_loop so the staleness arithmetic unit-tests directly)."""
+        versions = {s: v for s, v, _, _, _ in probes if v is not None}
+        self.servers = [s for s in servers if s in versions]
+        self._versions = versions
+        for s, v, load, est_snapshot, pressure in probes:
+            if v is None:
+                self._health_fail[s] += 1
+                if self._health_fail[s] == self.config.dead_after_failures:
+                    self._failover_locked(s)
+            else:
+                self._health_fail[s] = 0
+            if v is None or load is None:
+                self._metrics_fail[s] += 1
+                if (
+                    self._metrics_fail[s] >= _METRICS_FAIL_LIMIT
+                    and s in self._measured_tokens
+                ):
+                    del self._measured_tokens[s]
+                    self._pressure.pop(s, None)
+                continue
+            self._metrics_fail[s] = 0
+            self._measured_tokens[s] = load
+            if pressure is not None:
+                self._pressure[s] = pressure
+            # subtract only what the measurement could have
+            # seen; later routings keep their estimated cost
+            self._est_since_poll[s] = max(
+                0.0, self._est_since_poll[s] - est_snapshot
+            )
+
+    def _failover_locked(self, dead: str) -> None:
+        """A replica crossed dead_after_failures: requeue its in-flight
+        qids onto the least-loaded survivors (the clients' router-aware
+        retries then land there deterministically — exactly-once paired
+        with the servers' idempotency tables) and drain every affinity
+        entry pointing at the corpse."""
+        self._counters["failovers_total"] += 1
+        survivors = [s for s in self.servers if s != dead]
+        stale = [h for h, (s, _) in self._prefix_map.items() if s == dead]
+        for h in stale:
+            del self._prefix_map[h]
+        moved = 0
+        now = time.monotonic()
+        for qid, srv in list(self._qid_to_server.items()):
+            if srv != dead:
+                continue
+            pending = self._qid_pending.get(qid, 1)
+            cost = self._qid_cost.get(qid, 0.0)
+            self._request_counts[dead] = max(
+                0, self._request_counts[dead] - pending
+            )
+            self._token_usage[dead] = max(0.0, self._token_usage[dead] - cost)
+            self._est_since_poll[dead] = max(
+                0.0, self._est_since_poll[dead] - cost
+            )
+            if survivors:
+                new = min(survivors, key=self._token_load)
+                self._qid_to_server[qid] = new
+                self._qid_touched[qid] = now
+                self._request_counts[new] += pending
+                self._token_usage[new] += cost
+                self._est_since_poll[new] += cost
+                moved += 1
+            else:
+                # no survivor to carry the affinity: drop the entry; the
+                # client's re-schedule queues until a replica returns
+                self._qid_to_server.pop(qid, None)
+                self._qid_cost.pop(qid, None)
+                self._qid_pending.pop(qid, None)
+                self._qid_touched.pop(qid, None)
+        self._counters["requeues_total"] += moved
+        # stale measurements must not keep the corpse looking admissible
+        self._measured_tokens.pop(dead, None)
+        self._pressure.pop(dead, None)
+        if moved or stale:
+            logger.warning(
+                f"failover: {dead} declared dead; requeued {moved} qids, "
+                f"drained {len(stale)} prefix affinities"
+            )
+
+    def _expire_locked(self, now: float, discovered: list[str]) -> None:
+        """TTL/LRU expiry of routing state (a crashed client or a replaced
+        fleet must not leak load accounting forever)."""
+        ttl = self.config.route_ttl_s
+        if ttl > 0:
+            for qid, t in list(self._qid_touched.items()):
+                if now - t <= ttl:
+                    continue
+                # release every pending unit: the client that owned this
+                # qid is gone, its /finish_request will never arrive
+                while qid in self._qid_to_server:
+                    self._release_qid(qid)
+                self._qid_touched.pop(qid, None)
+                self._counters["expired_qids_total"] += 1
+            # _prefix_map is recency-ordered (touch == move_to_end), so
+            # the stale entries are all at the front
+            while self._prefix_map:
+                h, (_, t) = next(iter(self._prefix_map.items()))
+                if now - t <= ttl:
+                    break
+                del self._prefix_map[h]
+                self._counters["expired_prefixes_total"] += 1
+        while len(self._prefix_map) > self.config.route_max_entries:
+            self._prefix_map.popitem(last=False)
+            self._counters["expired_prefixes_total"] += 1
+        over = len(self._qid_to_server) - self.config.route_max_entries
+        if over > 0:
+            oldest = sorted(self._qid_touched.items(), key=lambda kv: kv[1])
+            for qid, _ in oldest[:over]:
+                while qid in self._qid_to_server:
+                    self._release_qid(qid)
+                self._qid_touched.pop(qid, None)
+                self._counters["expired_qids_total"] += 1
+        # per-server counters for servers gone from discovery AND the seed
+        # list (a server merely failing health stays — it may return)
+        keep = set(discovered) | set(self._seed_servers)
+        tracked = (
+            set(self._request_counts)
+            | set(self._token_usage)
+            | set(self._est_since_poll)
+            | set(self._metrics_fail)
+            | set(self._health_fail)
+            | set(self._measured_tokens)
+            | set(self._pressure)
+        )
+        for s in tracked - keep:
+            for d in (
+                self._request_counts,
+                self._token_usage,
+                self._est_since_poll,
+                self._metrics_fail,
+                self._health_fail,
+                self._measured_tokens,
+                self._pressure,
+                self._versions,
+            ):
+                d.pop(s, None)
 
     @property
     def fleet_version(self) -> int:
@@ -213,9 +454,63 @@ class DecodeRouter:
             return self._measured_tokens[s] + self._est_since_poll[s]
         return self._token_usage[s]
 
-    def _pick(self, req: dict[str, Any]) -> str:
-        if not self.servers:
-            raise web.HTTPServiceUnavailable(reason="no decode servers")
+    @staticmethod
+    def _request_cost(req: dict[str, Any]) -> float:
+        return float(req.get("prompt_len", 0)) + 0.4 * float(
+            req.get("new_token_budget", 0)
+        ) * float(req.get("group_size", 1))
+
+    def _kv_headroom(self, s: str, need: float) -> float | None:
+        """Tokens of pool capacity left on `s` after admitting a request
+        needing `need` tokens, or None when the server never reported
+        pressure (unknown => admissible, the pre-admission behaviour).
+        Fragmented free blocks are subtracted (they cannot back another
+        worst-case admission); a replica with the host KV tier enabled
+        admits to the full pool — its evictions offload instead of
+        dropping, so overflow degrades gracefully there."""
+        p = self._pressure.get(s)
+        if not p or not p.get("kv_blocks_total"):
+            return None
+        block = float(p.get("kv_block_size", 1) or 1)
+        cap = float(p["kv_blocks_total"]) * block
+        if not p.get("kv_host_pool_enabled"):
+            cap *= self.config.kv_pressure_high
+        frag = float(p.get("kv_pool_fragmentation", 0)) * block
+        used = float(p.get("kv_tokens_allocated", 0.0)) + self._est_since_poll[s]
+        return cap - frag - used - need
+
+    def _admissible(self, s: str, need: float) -> bool:
+        limit = self.config.max_inflight_per_server
+        if limit:
+            p = self._pressure.get(s)
+            if p is not None:
+                depth = int(p.get("running_requests", 0)) + int(
+                    p.get("queued_requests", 0)
+                )
+                if depth >= limit:
+                    return False
+        h = self._kv_headroom(s, need)
+        return h is None or h >= 0.0
+
+    def _prefix_hashes(self, req: dict[str, Any]) -> list[int]:
+        """Block-bucketed prompt-prefix hashes, longest first."""
+        prefix = req.get("input_prefix")
+        if not prefix:
+            return []
+        block = max(1, self.config.prefix_block_tokens)
+        nb = min(len(prefix) // block, self.config.prefix_max_blocks)
+        return [hash(tuple(prefix[: b * block])) for b in range(nb, 0, -1)]
+
+    def _pick_locked(
+        self, req: dict[str, Any]
+    ) -> tuple[str | None, float]:
+        """Choose a server for `req` -> (addr, prefix_discount_tokens);
+        addr None when no admissible server exists right now (the caller
+        queues). The discount is the prompt work the chosen server SKIPS
+        because it already holds the request's prefix KV (fork / suffix
+        prefill instead of a full prefill) — the accounting charges the
+        marginal cost, not the blind estimate, so affinity does not
+        self-destruct by inflating the affine server's apparent load."""
         qid = req.get("qid")
         prev_url = req.get("previous_server_url")
         prev_version = req.get("previous_version")
@@ -224,43 +519,150 @@ class DecodeRouter:
             and prev_url in self.servers
             and prev_version == self.fleet_version
         ):
-            return prev_url  # resume with live KV on the same weights
+            return prev_url, 0.0  # resume with live KV on the same weights
         if qid and qid in self._qid_to_server:
             cached = self._qid_to_server[qid]
             if cached in self.servers:
-                return cached
-        if self.schedule_policy == "round_robin":
-            addr = self.servers[self._rr % len(self.servers)]
+                return cached, 0.0
+        need = self._request_cost(req)
+        candidates = [s for s in self.servers if self._admissible(s, need)]
+        if not candidates:
+            return None, 0.0
+        policy = self.schedule_policy
+        if policy == "prefix_affinity":
+            return self._pick_prefix_affine_locked(req, candidates, need)
+        if policy == "round_robin":
+            addr = candidates[self._rr % len(candidates)]
             self._rr += 1
-        elif self.schedule_policy == "least_requests":
-            addr = min(self.servers, key=lambda s: self._request_counts[s])
-        elif self.schedule_policy == "least_token_usage":
-            addr = min(self.servers, key=self._token_load)
+        elif policy == "least_requests":
+            addr = min(candidates, key=lambda s: self._request_counts[s])
+        elif policy == "least_token_usage":
+            addr = min(candidates, key=self._token_load)
         else:
             raise web.HTTPBadRequest(
-                reason=f"unknown schedule policy {self.schedule_policy}"
+                reason=f"unknown schedule policy {policy}"
             )
-        return addr
+        return addr, 0.0
+
+    def _pick_prefix_affine_locked(
+        self, req: dict[str, Any], candidates: list[str], need: float
+    ) -> tuple[str, float]:
+        hashes = self._prefix_hashes(req)
+        block = max(1, self.config.prefix_block_tokens)
+        now = time.monotonic()
+        best = min(candidates, key=self._token_load)
+        chosen = None
+        discount = 0.0
+        for i, h in enumerate(hashes):  # longest prefix first
+            ent = self._prefix_map.get(h)
+            if ent is None or ent[0] not in self.servers:
+                continue
+            affine = ent[0]
+            # tokens of prompt the affine server's prefix cache covers
+            matched = (len(hashes) - i) * block
+            saved = min(matched, float(req.get("prompt_len", 0)))
+            # affinity-vs-load override, by MARGINAL cost: routing here
+            # costs load + (need - saved); routing to the least-loaded
+            # candidate costs load_best + need, padded by the factor. A
+            # hot (or inadmissible) affine server must not melt further
+            # while siblings idle.
+            hot = affine not in candidates or (
+                self._token_load(affine) + need - saved
+                > self.config.affinity_load_factor
+                * (self._token_load(best) + need)
+            )
+            if hot:
+                self._counters["affinity_overrides_total"] += 1
+                break
+            self._counters["affinity_hits_total"] += 1
+            chosen = affine
+            discount = saved
+            break
+        if chosen is None:
+            chosen = best
+        for h in hashes:
+            self._prefix_map[h] = (chosen, now)
+            self._prefix_map.move_to_end(h)
+        return chosen, discount
+
+    def _try_schedule_locked(self, req: dict[str, Any]) -> dict[str, Any] | None:
+        """Pick + account, or None when every replica is saturated."""
+        addr, discount = self._pick_locked(req)
+        if addr is None:
+            return None
+        qid = req.get("qid")
+        cost = max(self._request_cost(req) - discount, 0.0)
+        self._counters["schedules_total"] += 1
+        self._request_counts[addr] += 1
+        self._token_usage[addr] += cost
+        self._est_since_poll[addr] += cost
+        if qid:
+            self._qid_to_server[qid] = addr
+            self._qid_cost[qid] = self._qid_cost.get(qid, 0.0) + cost
+            self._qid_pending[qid] = self._qid_pending.get(qid, 0) + 1
+            self._qid_touched[qid] = time.monotonic()
+        return {"url": addr, "version": self.fleet_version}
+
+    def _drain_queue_locked(self) -> None:
+        """Admit queued requests in FIFO order while pressure allows; an
+        unschedulable head blocks the tail (ordering fairness)."""
+        while self._waitq:
+            w = self._waitq[0]
+            if w.fut.done():  # already shed by its own deadline
+                self._waitq.popleft()
+                continue
+            out = self._try_schedule_locked(w.req)
+            if out is None:
+                break
+            self._waitq.popleft()
+            self._counters["queue_admits_total"] += 1
+            w.fut.set_result(out)
+
+    def _shed_response(self, why: str) -> web.Response:
+        ra = self.config.retry_after_s
+        return web.json_response(
+            {"url": None, "reason": why, "retry_after": ra},
+            status=429,
+            headers={"Retry-After": str(max(1, math.ceil(ra)))},
+        )
 
     # -- handlers -------------------------------------------------------
     async def _schedule_request(self, request: web.Request) -> web.Response:
         req = await request.json()
+        loop = asyncio.get_running_loop()
         async with self._lock:
-            addr = self._pick(req)
-            qid = req.get("qid")
-            cost = float(req.get("prompt_len", 0)) + 0.4 * float(
-                req.get("new_token_budget", 0)
-            ) * float(req.get("group_size", 1))
-            self._request_counts[addr] += 1
-            self._token_usage[addr] += cost
-            self._est_since_poll[addr] += cost
-            if qid:
-                self._qid_to_server[qid] = addr
-                self._qid_cost[qid] = self._qid_cost.get(qid, 0.0) + cost
-                self._qid_pending[qid] = self._qid_pending.get(qid, 0) + 1
-            return web.json_response(
-                {"url": addr, "version": self.fleet_version}
+            if req.get("requeue") and req.get("qid"):
+                # a router-aware client retry re-schedules the SAME logical
+                # request: release the prior unit so accounting stays
+                # balanced (its /finish_request fires only once)
+                self._release_qid(req.get("qid"))
+                self._counters["client_requeues_total"] += 1
+            out = self._try_schedule_locked(req)
+            if out is not None:
+                return web.json_response(out)
+            if len(self._waitq) >= self.config.queue_max:
+                self._counters["queue_sheds_total"] += 1
+                return self._shed_response("admission queue full")
+            now = time.monotonic()
+            w = _Waiter(
+                loop.create_future(), req, now,
+                now + self.config.queue_timeout_s,
             )
+            self._waitq.append(w)
+            self._counters["queue_enqueues_total"] += 1
+        try:
+            out = await asyncio.wait_for(
+                w.fut, timeout=self.config.queue_timeout_s
+            )
+        except asyncio.TimeoutError:
+            async with self._lock:
+                try:
+                    self._waitq.remove(w)
+                except ValueError:
+                    pass
+                self._counters["queue_timeouts_total"] += 1
+            return self._shed_response("admission deadline exceeded")
+        return web.json_response(out)
 
     async def _allocate_rollout(self, request: web.Request) -> web.Response:
         req = await request.json()
@@ -303,6 +705,7 @@ class DecodeRouter:
             self._qid_to_server.pop(qid, None)
             self._qid_cost.pop(qid, None)
             self._qid_pending.pop(qid, None)
+            self._qid_touched.pop(qid, None)
         else:
             self._qid_pending[qid] = pending - 1
             self._qid_cost[qid] = self._qid_cost[qid] - unit_cost
@@ -314,6 +717,7 @@ class DecodeRouter:
             if req.get("accepted"):
                 self._accepted += 1
             self._release_qid(req.get("qid"))
+            self._drain_queue_locked()
             return web.json_response({"success": True})
 
     async def _finish_request(self, request: web.Request) -> web.Response:
@@ -323,6 +727,7 @@ class DecodeRouter:
         req = await request.json()
         async with self._lock:
             self._release_qid(req.get("qid"))
+            self._drain_queue_locked()
             return web.json_response({"success": True})
 
     async def _health(self, request: web.Request) -> web.Response:
@@ -342,10 +747,44 @@ class DecodeRouter:
                 }
             )
 
+    async def _metrics(self, request: web.Request) -> web.Response:
+        """Routing observability: queue/shedding state, affinity quality,
+        failover activity, and the per-server pressure snapshots the
+        admission controller is acting on — what `bench.py --mode fleet`
+        and the ops layer read to judge routing quality."""
+        async with self._lock:
+            sched = self._counters["schedules_total"]
+            hits = self._counters["affinity_hits_total"]
+            return web.json_response(
+                {
+                    "schedule_policy": self.schedule_policy,
+                    "servers": self.servers,
+                    "queue_depth": sum(
+                        1 for w in self._waitq if not w.fut.done()
+                    ),
+                    "queue_max": self.config.queue_max,
+                    **self._counters,
+                    "affinity_hit_rate": (
+                        round(hits / sched, 6) if sched else 0.0
+                    ),
+                    "tracked_qids": len(self._qid_to_server),
+                    "tracked_prefixes": len(self._prefix_map),
+                    "running": self._running,
+                    "request_counts": dict(self._request_counts),
+                    "token_loads": {
+                        s: self._token_load(s) for s in self.servers
+                    },
+                    "pressure": {
+                        s: dict(p) for s, p in self._pressure.items()
+                    },
+                }
+            )
+
     # -- lifecycle ------------------------------------------------------
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
         app.router.add_post("/schedule_request", self._schedule_request)
         app.router.add_post("/allocate_rollout", self._allocate_rollout)
         app.router.add_post("/finish_rollout", self._finish_rollout)
@@ -386,10 +825,33 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--servers", default="", help="comma-separated host:port")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0)
-    p.add_argument("--schedule-policy", default="least_requests")
-    p.add_argument("--max-concurrent-rollouts", type=int, default=1024)
-    p.add_argument("--max-head-offpolicyness", type=int, default=1_000_000_000)
-    p.add_argument("--train-batch-size", type=int, default=1)
+    defaults = RouterConfig()
+    p.add_argument("--schedule-policy", default=defaults.schedule_policy)
+    p.add_argument(
+        "--max-concurrent-rollouts", type=int,
+        default=defaults.max_concurrent_rollouts,
+    )
+    p.add_argument(
+        "--max-head-offpolicyness", type=int,
+        default=defaults.max_head_offpolicyness,
+    )
+    p.add_argument(
+        "--train-batch-size", type=int, default=defaults.train_batch_size
+    )
+    p.add_argument(
+        "--health-poll-interval", type=float,
+        default=defaults.health_poll_interval,
+    )
+    p.add_argument("--queue-max", type=int, default=defaults.queue_max)
+    p.add_argument(
+        "--queue-timeout-s", type=float, default=defaults.queue_timeout_s
+    )
+    p.add_argument(
+        "--kv-pressure-high", type=float, default=defaults.kv_pressure_high
+    )
+    p.add_argument(
+        "--route-ttl-s", type=float, default=defaults.route_ttl_s
+    )
     args = p.parse_args(argv)
 
     async def _serve():
@@ -397,10 +859,17 @@ def main(argv: list[str] | None = None) -> None:
             args.experiment_name,
             args.trial_name,
             [s for s in args.servers.split(",") if s],
-            schedule_policy=args.schedule_policy,
-            max_concurrent_rollouts=args.max_concurrent_rollouts,
-            max_head_offpolicyness=args.max_head_offpolicyness,
-            train_batch_size=args.train_batch_size,
+            config=RouterConfig(
+                schedule_policy=args.schedule_policy,
+                max_concurrent_rollouts=args.max_concurrent_rollouts,
+                max_head_offpolicyness=args.max_head_offpolicyness,
+                train_batch_size=args.train_batch_size,
+                health_poll_interval=args.health_poll_interval,
+                queue_max=args.queue_max,
+                queue_timeout_s=args.queue_timeout_s,
+                kv_pressure_high=args.kv_pressure_high,
+                route_ttl_s=args.route_ttl_s,
+            ),
         )
         await router.start(args.host, args.port)
         await asyncio.Event().wait()

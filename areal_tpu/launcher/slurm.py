@@ -117,6 +117,7 @@ def plan_decoupled_jobs(
     time_limit: str | None = None,
     name_resolve_env: dict[str, str] | None = None,
     decode_args: str = "",
+    router_args: str = "",
 ) -> list[SlurmJobSpec]:
     """Plan the sbatch jobs for one experiment from its allocation mode
     (parity: the job-array planning of areal/launcher/slurm.py:46):
@@ -161,14 +162,19 @@ def plan_decoupled_jobs(
                     env=dict(common_env),
                 )
             )
+        router_cmd = (
+            "python -m areal_tpu.launcher.router "
+            f"--experiment-name {experiment_name} "
+            f"--trial-name {trial_name}"
+        )
+        if router_args:
+            # policy/admission knobs (RouterConfig surface: e.g.
+            # "--schedule-policy prefix_affinity --queue-max 2048")
+            router_cmd += f" {router_args}"
         jobs.append(
             SlurmJobSpec(
                 name=f"{experiment_name}_{trial_name}:router",
-                cmd=(
-                    "python -m areal_tpu.launcher.router "
-                    f"--experiment-name {experiment_name} "
-                    f"--trial-name {trial_name}"
-                ),
+                cmd=router_cmd,
                 n_nodes=1,
                 cpus_per_task=2,
                 mem_mb=4 * 1024,

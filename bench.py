@@ -684,6 +684,423 @@ def bench_kvoffload(model, n_sessions, prompt_len, new_tokens, max_running,
     )
 
 
+def bench_fleet(model, n_replicas, n_groups, group_size, prompt_len,
+                new_tokens, max_running, chunk=None, turns=2):
+    """Fleet router bench (ISSUE 8): prefix-affinity routing vs
+    least_requests across in-process decode replicas, plus a mid-trace
+    replica kill proving exactly-once failover.
+
+    Trace (identical for both policies, fresh replicas per run): n_groups
+    GRPO-style groups of group_size same-prompt members (distinct rids),
+    mixed prompt lengths across groups, bursty staggered arrival, and
+    `turns` session turns per member (turn k+1 extends turn k's context —
+    the multi-turn reuse shape). Prefix affinity should land group members
+    and session turns on the replica already holding their donor KV
+    (dup-prompt fork / suffix prefill instead of a full prefill), which is
+    the mechanism behind the p50 TTFT win; least_requests spreads them
+    blindly. The affinity run goes FIRST so any warm-process advantage
+    goes to the baseline.
+
+    Failover leg (fresh 2-replica fleet, prefix_affinity): a wave of
+    requests starts, one replica is killed mid-trace (HTTP listener down +
+    engine aborted), and every request must still complete exactly once —
+    the router's health poll requeues the corpse's qids onto the survivor
+    and the clients' router-aware retries re-send with the same delivery
+    id (xid), which the servers' idempotency table deduplicates. Reported:
+    recovery time (kill -> last affected completion), requests lost (must
+    be 0), router requeues, and a direct dedup probe (two concurrent
+    /generate with one xid -> one generation)."""
+    import asyncio
+    import threading
+    import uuid as _uuid
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+        RouterConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.launcher.decode_server import DecodeServer
+    from areal_tpu.launcher.router import DecodeRouter
+    from areal_tpu.utils import name_resolve
+    from areal_tpu.utils.http import arequest_with_retry, close_current_session
+    from areal_tpu.models.qwen2 import init_params
+
+    name_resolve.reconfigure(name_resolve.NameResolveConfig(type="memory"))
+    params = init_params(model, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(23)
+    plens = [int(prompt_len * f) for f in (1.0, 0.75, 1.25, 0.5)]
+    ctx = int(prompt_len * 1.25) + turns * (new_tokens + 8) + 128
+    gcfg = GenerationHyperparameters(
+        max_new_tokens=new_tokens, temperature=1.0, top_p=1.0
+    )
+    group_prompts = [
+        rng.randint(1, model.vocab_size, (plens[g % len(plens)],)).tolist()
+        for g in range(n_groups)
+    ]
+
+    def _http_get(addr, ep):
+        async def _g():
+            try:
+                return await arequest_with_retry(
+                    addr, ep, method="GET", max_retries=1, timeout=10
+                )
+            finally:
+                await close_current_session()
+
+        return asyncio.run(_g())
+
+    class _Replica:
+        """One decode engine + HTTP server on a private loop thread."""
+
+        def __init__(self, warm_plen):
+            dcfg = JaxDecodeConfig(
+                context_length=ctx,
+                max_running_requests=max_running,
+                new_tokens_per_chunk=chunk or min(128, new_tokens),
+                dtype=model.dtype,
+                kv_cache_dtype=model.dtype,
+            )
+            self.engine = JaxDecodeEngine(dcfg, InferenceEngineConfig())
+            self.engine.set_model(params, model)
+            self.engine.initialize()
+            self.engine.prewarm(prompt_len=warm_plen, gconfig=gcfg)
+            self.server = DecodeServer(
+                JaxDecodeConfig(), engine=self.engine, shutdown_grace=0.5
+            )
+            self.addr = None
+            self._loop = None
+            self._ready = threading.Event()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+            assert self._ready.wait(60), "fleet replica failed to start"
+
+        def _run(self):
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _start():
+                self.addr = await self.server.start(host="127.0.0.1", port=0)
+                self._ready.set()
+
+            self._loop.run_until_complete(_start())
+            self._loop.run_forever()
+
+        def kill(self):
+            """Die like a crashed replica: listener down (in-flight
+            handlers cancelled after shutdown_grace), engine aborted."""
+            asyncio.run_coroutine_threadsafe(
+                self.server.stop(), self._loop
+            ).result(30)
+            self.engine.pause_generation()
+            self.engine.abort_all()
+
+        def stop(self, destroy=True):
+            # stop the server first, THEN the loop: a coroutine that stops
+            # its own loop strands run_coroutine_threadsafe's completion
+            # callback (the future never resolves)
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self.server.stop(), self._loop
+                ).result(30)
+            except Exception:  # noqa: BLE001 — already killed
+                pass
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+            if destroy:
+                self.engine.destroy()
+
+    class _RouterThread:
+        def __init__(self, policy, servers, exp, trial):
+            self.router = DecodeRouter(
+                exp,
+                trial,
+                servers,
+                config=RouterConfig(
+                    schedule_policy=policy,
+                    health_poll_interval=0.25,
+                    dead_after_failures=2,
+                    queue_timeout_s=30.0,
+                ),
+            )
+            self.addr = None
+            self._loop = None
+            self._ready = threading.Event()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+            assert self._ready.wait(30), "fleet router failed to start"
+
+        def _run(self):
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _start():
+                self.addr = await self.router.start("127.0.0.1", 0)
+                self._ready.set()
+
+            self._loop.run_until_complete(_start())
+            self._loop.run_forever()
+
+        def stop(self):
+            # two-step (see _Replica.stop): never loop.stop() from inside
+            # the awaited coroutine
+            asyncio.run_coroutine_threadsafe(
+                self.router.stop(), self._loop
+            ).result(30)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+
+    def _client(exp, trial):
+        c = RemoteInfEngine(
+            InferenceEngineConfig(
+                experiment_name=exp,
+                trial_name=trial,
+                request_timeout=600,
+                request_retries=1,
+                fleet_failover_retries=3,
+            )
+        )
+        return c
+
+    def run_policy(policy):
+        exp, trial = "benchfleet", f"{policy}-{_uuid.uuid4().hex[:6]}"
+        replicas = [_Replica(min(plens)) for _ in range(n_replicas)]
+        addrs = [r.addr for r in replicas]
+        rt = _RouterThread(policy, addrs, exp, trial)
+        client = _client(exp, trial)
+        client.addresses = list(addrs)
+        ttfts, itls, stats = [], [], {}
+        try:
+            time.sleep(0.6)  # one poll round: pressure snapshots exist
+            # hit-rate baseline AFTER prewarm: its warmup prefills must not
+            # dilute the trace's prefix_cache_hit_rate
+            m0s = [r.engine.get_metrics() for r in replicas]
+
+            async def member(g, m):
+                rid = f"g{g}-m{m}-{_uuid.uuid4().hex[:6]}"
+                ids = list(group_prompts[g])
+                for _t in range(turns):
+                    r = await client.agenerate(
+                        ModelRequest(rid=rid, input_ids=ids, gconfig=gcfg)
+                    )
+                    ttfts.append(r.ttft)
+                    if len(r.output_tokens) > 1:
+                        itls.append(
+                            (r.latency - r.ttft) / (len(r.output_tokens) - 1)
+                        )
+                    # next turn extends this turn's context (session reuse)
+                    ids = ids + list(r.output_tokens) + [7, 11, 13, 17]
+
+            async def group(g):
+                # bursty arrival: groups land in waves
+                await asyncio.sleep((g % 3) * 0.15)
+                await asyncio.gather(
+                    *[member(g, m) for m in range(group_size)]
+                )
+
+            async def drive():
+                try:
+                    await asyncio.gather(*[group(g) for g in range(n_groups)])
+                finally:
+                    await close_current_session()
+
+            t0 = time.perf_counter()
+            asyncio.run(drive())
+            wall = time.perf_counter() - t0
+            hits = tot = 0
+            for r, m0 in zip(replicas, m0s):
+                m = r.engine.get_metrics()
+                h = (
+                    m["prefix_forks_total"]
+                    - m0["prefix_forks_total"]
+                    + m["prefix_inplace_total"]
+                    - m0["prefix_inplace_total"]
+                    + m["suffix_prefills_total"]
+                    - m0["suffix_prefills_total"]
+                )
+                hits += h
+                tot += h + m["prefills_total"] - m0["prefills_total"]
+            rm = _http_get(rt.addr, "/metrics")
+            tarr = np.asarray(ttfts, dtype=np.float64) * 1e3
+            iarr = np.asarray(itls, dtype=np.float64) * 1e3
+            stats = dict(
+                ttft_p50_ms=float(np.percentile(tarr, 50)),
+                ttft_p99_ms=float(np.percentile(tarr, 99)),
+                itl_p50_ms=float(np.percentile(iarr, 50)) if iarr.size else 0.0,
+                itl_p99_ms=float(np.percentile(iarr, 99)) if iarr.size else 0.0,
+                prefix_hit_rate=hits / tot if tot else 0.0,
+                router_affinity_hit_rate=rm.get("affinity_hit_rate", 0.0),
+                wall_s=wall,
+                n_requests=len(ttfts),
+            )
+        finally:
+            rt.stop()
+            for r in replicas:
+                r.stop()
+        return stats
+
+    def run_failover():
+        exp, trial = "benchfleet", f"failover-{_uuid.uuid4().hex[:6]}"
+        replicas = [_Replica(min(plens)) for _ in range(2)]
+        addrs = [r.addr for r in replicas]
+        rt = _RouterThread("prefix_affinity", addrs, exp, trial)
+        client = _client(exp, trial)
+        client.addresses = list(addrs)
+        n_reqs = n_groups * group_size
+        done_t: dict[str, float] = {}
+        results: dict[str, object] = {}
+        try:
+            time.sleep(0.6)
+
+            async def one(g, m):
+                rid = f"fo-g{g}-m{m}"
+                r = await client.agenerate(
+                    ModelRequest(
+                        rid=rid, input_ids=group_prompts[g], gconfig=gcfg
+                    )
+                )
+                results[rid] = r
+                done_t[rid] = time.perf_counter()
+
+            kill_box = {}
+
+            async def killer():
+                # kill mid-trace: once the fleet has emitted ~20% of the
+                # expected tokens (but before everything finishes)
+                target = 0.2 * n_reqs * new_tokens
+                deadline = time.perf_counter() + 120
+                while time.perf_counter() < deadline:
+                    emitted = sum(
+                        r.engine.get_metrics()["generated_tokens_total"]
+                        for r in replicas
+                    )
+                    # fire mid-trace: enough tokens out, but never wait
+                    # past half the wave completing
+                    if emitted >= target or len(done_t) >= max(1, n_reqs // 2):
+                        break
+                    await asyncio.sleep(0.02)
+                kill_box["t"] = time.perf_counter()
+                await asyncio.get_running_loop().run_in_executor(
+                    None, replicas[0].kill
+                )
+
+            async def drive():
+                try:
+                    tasks = [
+                        asyncio.create_task(one(g, m))
+                        for g in range(n_groups)
+                        for m in range(group_size)
+                    ]
+                    k = asyncio.create_task(killer())
+                    await asyncio.gather(*tasks)
+                    await k
+                finally:
+                    await close_current_session()
+
+            asyncio.run(drive())
+            lost = sum(
+                1
+                for r in results.values()
+                if len(r.output_tokens) != new_tokens
+            ) + (n_reqs - len(results))
+            recovery = (
+                max(
+                    (t for t in done_t.values() if t > kill_box["t"]),
+                    default=kill_box["t"],
+                )
+                - kill_box["t"]
+            )
+            rm = _http_get(rt.addr, "/metrics")
+
+            # direct rid-dedup probe on the survivor: two concurrent
+            # /generate with one xid must produce ONE generation
+            sm0 = replicas[1].engine.get_metrics()
+            xid = f"dedup-{_uuid.uuid4().hex[:6]}"
+            payload = dict(
+                rid=xid,
+                input_ids=group_prompts[0][:32],
+                gconfig=dict(max_new_tokens=4, temperature=1.0),
+                xid=xid,
+            )
+
+            async def probe():
+                try:
+                    return await asyncio.gather(
+                        *[
+                            arequest_with_retry(
+                                replicas[1].addr, "/generate",
+                                payload=payload, max_retries=1, timeout=120,
+                            )
+                            for _ in range(2)
+                        ]
+                    )
+                finally:
+                    await close_current_session()
+
+            p1, p2 = asyncio.run(probe())
+            sm1 = replicas[1].engine.get_metrics()
+            dedup_ok = int(
+                p1["output_tokens"] == p2["output_tokens"]
+                and _http_get(replicas[1].addr, "/metrics")["idem_hits_total"]
+                >= 1
+            )
+            return dict(
+                recovery_s=recovery,
+                requests=n_reqs,
+                completed=len(results),
+                lost=lost,
+                router_requeues=rm.get("requeues_total", 0),
+                router_failovers=rm.get("failovers_total", 0),
+                dedup_probe_ok=dedup_ok,
+                survivor_prefills=sm1["prefills_total"] - sm0["prefills_total"],
+            )
+        finally:
+            rt.stop()
+            replicas[0].stop(destroy=True)
+            replicas[1].stop()
+
+    aff = run_policy("prefix_affinity")
+    lr = run_policy("least_requests")
+    fo = run_failover()
+    return dict(
+        fleet_replicas=n_replicas,
+        fleet_groups=n_groups,
+        fleet_group_size=group_size,
+        fleet_turns=turns,
+        fleet_affinity_ttft_p50_ms=aff["ttft_p50_ms"],
+        fleet_affinity_ttft_p99_ms=aff["ttft_p99_ms"],
+        fleet_affinity_itl_p50_ms=aff["itl_p50_ms"],
+        fleet_affinity_itl_p99_ms=aff["itl_p99_ms"],
+        fleet_affinity_prefix_hit_rate=aff["prefix_hit_rate"],
+        fleet_affinity_router_hit_rate=aff["router_affinity_hit_rate"],
+        fleet_affinity_wall_s=aff["wall_s"],
+        fleet_leastreq_ttft_p50_ms=lr["ttft_p50_ms"],
+        fleet_leastreq_ttft_p99_ms=lr["ttft_p99_ms"],
+        fleet_leastreq_itl_p50_ms=lr["itl_p50_ms"],
+        fleet_leastreq_itl_p99_ms=lr["itl_p99_ms"],
+        fleet_leastreq_prefix_hit_rate=lr["prefix_hit_rate"],
+        fleet_leastreq_wall_s=lr["wall_s"],
+        fleet_affinity_ttft_p50_speedup=(
+            lr["ttft_p50_ms"] / aff["ttft_p50_ms"]
+            if aff["ttft_p50_ms"] > 0
+            else 0.0
+        ),
+        fleet_requests_per_policy=aff["n_requests"],
+        fleet_failover_recovery_s=fo["recovery_s"],
+        fleet_failover_requests=fo["requests"],
+        fleet_failover_completed=fo["completed"],
+        fleet_failover_lost=fo["lost"],
+        fleet_failover_router_requeues=fo["router_requeues"],
+        fleet_failover_router_failovers=fo["router_failovers"],
+        fleet_dedup_probe_ok=fo["dedup_probe_ok"],
+    )
+
+
 def bench_weightsync(model, n_pushes, chunk_mb, prompt_len, new_tokens):
     """Staged weight-sync bench: transfer time vs commit-pause time.
 
@@ -1244,6 +1661,7 @@ BENCH_MODE_FNS = {
     "weightsync": bench_weightsync,
     "specdecode": bench_spec_compare,
     "kvoffload": bench_kvoffload,
+    "fleet": bench_fleet,
 }
 BENCH_MODES = ("all", *BENCH_MODE_FNS)
 # headline metric per dev mode (modes that skip the trainer MFU line)
@@ -1256,6 +1674,7 @@ MODE_HEADLINES = {
     "weightsync": ("weightsync_commit_pause_s", "s"),
     "specdecode": ("spec_over_off_speedup", "x"),
     "kvoffload": ("kvoffload_resume_ttft_speedup", "x"),
+    "fleet": ("fleet_affinity_ttft_p50_speedup", "x"),
 }
 
 
@@ -1588,6 +2007,18 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("fleet"):
+            decode.update(
+                _retry_transport(
+                    lambda: bench_fleet(
+                        model, n_replicas=3, n_groups=8, group_size=8,
+                        prompt_len=512, new_tokens=128, max_running=32,
+                    ),
+                    what="bench_fleet",
+                    attempts=2,
+                    base_delay=15.0,
+                )
+            )
         if want("grpo"):
             # GRPO co-locates trainer (fwd+bwd+opt) and decode engine on
             # one chip: run the actor with remat on to leave HBM headroom
@@ -1720,6 +2151,17 @@ def main() -> None:
                 bench_kvoffload(
                     model, n_sessions=8, prompt_len=256, new_tokens=64,
                     max_running=4, host_mb=64.0, chunk=8,
+                )
+            )
+        if want("fleet"):
+            # prompts long enough (>= 64-token affinity block AND the
+            # engine's 64-token min shared prefix) that affinity routing
+            # can turn group members into dup-prompt forks / session
+            # turns into suffix prefills on the affine replica
+            decode.update(
+                bench_fleet(
+                    model, n_replicas=2, n_groups=4, group_size=4,
+                    prompt_len=128, new_tokens=16, max_running=4, chunk=8,
                 )
             )
         if want("grpo"):

@@ -49,6 +49,18 @@ def test_kvoffload_mode_is_pinned():
     )
 
 
+def test_fleet_mode_is_pinned():
+    """ISSUE 8 satellite: the fleet-router bench must stay reachable as
+    `--mode fleet` with its prefix-affinity-vs-least_requests headline —
+    a rename/removal of the dispatch entry is a breaking CLI change."""
+    bench = _load_bench()
+    assert "fleet" in bench.BENCH_MODE_FNS
+    assert bench.BENCH_MODE_FNS["fleet"] is bench.bench_fleet
+    assert bench.MODE_HEADLINES["fleet"] == (
+        "fleet_affinity_ttft_p50_speedup", "x",
+    )
+
+
 def test_every_dev_mode_has_a_headline_metric():
     bench = _load_bench()
     # dev modes = everything but "all" and "train" (those emit the trainer

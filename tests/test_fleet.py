@@ -1,0 +1,358 @@
+"""Fleet exactly-once surface (ISSUE 8): the decode server's idempotency
+table (xid dedup), the client's least-token-load local fallback, and
+router-aware failover — a replica dying mid-request must cost latency,
+never a duplicated or lost rollout."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from areal_tpu.api.cli_args import (
+    GenerationHyperparameters,
+    InferenceEngineConfig,
+    JaxDecodeConfig,
+    RouterConfig,
+)
+from areal_tpu.api.io_struct import ModelRequest, ModelResponse
+from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+from areal_tpu.launcher.decode_server import DecodeServer
+from areal_tpu.launcher.router import DecodeRouter
+from areal_tpu.utils import name_resolve, names
+from areal_tpu.utils.http import arequest_with_retry, close_current_session
+
+
+class StubEngine:
+    """Counts generations; no jax. `delay` holds each call in flight long
+    enough for duplicates/kills to race it."""
+
+    def __init__(self, delay=0.05, n_tokens=3, metrics=None):
+        self.calls = 0
+        self.delay = delay
+        self.n_tokens = n_tokens
+        self.metrics = metrics if metrics is not None else {"active_tokens": 0}
+        self._version = 0
+
+    def get_version(self):
+        return self._version
+
+    def get_metrics(self):
+        return dict(self.metrics)
+
+    async def agenerate(self, req):
+        self.calls += 1
+        call = self.calls
+        await asyncio.sleep(self.delay)
+        return ModelResponse(
+            input_tokens=list(req.input_ids),
+            # tokens encode the call ordinal: two requests sharing an xid
+            # must observe the SAME generation, not merely equal-length ones
+            output_tokens=[call] * self.n_tokens,
+            output_logprobs=[0.0] * self.n_tokens,
+            output_versions=[0] * self.n_tokens,
+            stop_reason="stop",
+            latency=self.delay,
+            ttft=self.delay,
+        )
+
+
+def _run_async(coro, timeout=60):
+    result = {}
+
+    def go():
+        result["v"] = asyncio.run(coro)
+
+    t = threading.Thread(target=go, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), "async scenario timed out"
+    return result.get("v")
+
+
+async def _start_stub_server(engine, **cfg_kw):
+    srv = DecodeServer(
+        JaxDecodeConfig(**cfg_kw), engine=engine, shutdown_grace=0.2
+    )
+    addr = await srv.start(host="127.0.0.1", port=0)
+    return srv, addr
+
+
+def _gen_payload(xid=None, rid="r", n=3):
+    p = dict(
+        rid=rid,
+        input_ids=[1, 2, 3],
+        gconfig=dict(max_new_tokens=n),
+    )
+    if xid is not None:
+        p["xid"] = xid
+    return p
+
+
+# -- server-side idempotency table ------------------------------------------
+
+
+async def _scenario_idempotency():
+    eng = StubEngine(delay=0.2)
+    srv, addr = await _start_stub_server(eng)
+    try:
+        # concurrent duplicates of one xid: ONE generation, same tokens
+        r1, r2 = await asyncio.gather(
+            arequest_with_retry(addr, "/generate", payload=_gen_payload("x1")),
+            arequest_with_retry(addr, "/generate", payload=_gen_payload("x1")),
+        )
+        assert eng.calls == 1
+        assert r1["output_tokens"] == r2["output_tokens"]
+        assert {r1.get("dedup"), r2.get("dedup")} == {None, "in_progress"}
+
+        # replay after completion: cached response, still one generation
+        r3 = await arequest_with_retry(
+            addr, "/generate", payload=_gen_payload("x1")
+        )
+        assert eng.calls == 1
+        assert r3["dedup"] == "completed"
+        assert r3["output_tokens"] == r1["output_tokens"]
+
+        # a different xid (and no xid at all) generate fresh
+        await arequest_with_retry(addr, "/generate", payload=_gen_payload("x2"))
+        await arequest_with_retry(addr, "/generate", payload=_gen_payload())
+        assert eng.calls == 3
+
+        # dedup observability rides on /metrics
+        m = await arequest_with_retry(addr, "/metrics", method="GET")
+        assert m["idem_hits_total"] == 2
+        assert m["idem_entries"] == 2  # x1 + x2 (xid-less never recorded)
+        return True
+    finally:
+        await close_current_session()
+        await srv.stop()
+
+
+def test_decode_server_idempotency():
+    assert _run_async(_scenario_idempotency())
+
+
+async def _scenario_idem_bounds():
+    eng = StubEngine(delay=0.0)
+    srv, addr = await _start_stub_server(
+        eng, idempotency_entries=2, idempotency_ttl_s=1e9
+    )
+    try:
+        for i in range(4):
+            await arequest_with_retry(
+                addr, "/generate", payload=_gen_payload(f"b{i}")
+            )
+        assert eng.calls == 4
+        assert len(srv._idem) == 2  # LRU-bounded
+        # evicted xids regenerate (bounded table = bounded memory, the
+        # dedup window is recent deliveries, which is what retries need)
+        await arequest_with_retry(addr, "/generate", payload=_gen_payload("b0"))
+        assert eng.calls == 5
+        # surviving xid replays without regenerating
+        await arequest_with_retry(addr, "/generate", payload=_gen_payload("b3"))
+        assert eng.calls == 5
+
+        # TTL expiry of completed entries
+        srv.config.idempotency_ttl_s = 0.01
+        await asyncio.sleep(0.05)
+        await arequest_with_retry(addr, "/generate", payload=_gen_payload("c0"))
+        assert set(srv._idem) == {"c0"}
+        return True
+    finally:
+        await close_current_session()
+        await srv.stop()
+
+
+def test_decode_server_idempotency_bounds():
+    assert _run_async(_scenario_idem_bounds())
+
+
+async def _scenario_idem_error_path():
+    class FailingEngine(StubEngine):
+        async def agenerate(self, req):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("boom")
+            return await super().agenerate(req)
+
+    eng = FailingEngine(delay=0.0)
+    srv, addr = await _start_stub_server(eng)
+    try:
+        with pytest.raises(Exception):
+            await arequest_with_retry(
+                addr, "/generate", payload=_gen_payload("e1"), max_retries=1
+            )
+        # a failed submission must NOT poison the xid: the retry generates
+        # (calls: 1 boom + 2 from the wrapper AND super on the success)
+        out = await arequest_with_retry(
+            addr, "/generate", payload=_gen_payload("e1")
+        )
+        assert eng.calls == 3
+        assert out["output_tokens"] == [3, 3, 3]
+        assert "dedup" not in out
+        return True
+    finally:
+        await close_current_session()
+        await srv.stop()
+
+
+def test_decode_server_idempotency_error_path():
+    assert _run_async(_scenario_idem_error_path())
+
+
+# -- client: least-token-load local fallback (ISSUE 8 satellite) ------------
+
+
+def test_choose_server_least_token_load():
+    c = RemoteInfEngine(InferenceEngineConfig())
+    c.addresses = ["a:1", "b:1"]
+    a1 = c.choose_server("r1", cost=100.0)
+    a2 = c.choose_server("r2", cost=1.0)
+    assert a2 != a1  # second pick avoids the loaded server
+    # the lightly-loaded server keeps winning until loads cross
+    a3 = c.choose_server("r3", cost=1.0)
+    assert a3 == a2
+    # affinity still caches per rid
+    assert c.choose_server("r1") == a1
+    # releasing r1's cost rebalances back
+    c._release_local("r1")
+    assert c.choose_server("r4", cost=1.0) == a1
+    # exclude skips a failed address even with cached affinity
+    assert c.choose_server("r2", exclude=a2) == a1
+
+
+def test_choose_server_round_robin_tiebreak():
+    c = RemoteInfEngine(InferenceEngineConfig())
+    c.addresses = ["a:1", "b:1", "c:1"]
+    picks = [c.choose_server() for _ in range(6)]
+    # zero-cost picks must still rotate (no dogpiling one server)
+    assert set(picks[:3]) == set(c.addresses)
+    assert picks[:3] == picks[3:]
+
+
+# -- client failover through the router (exactly-once e2e) ------------------
+
+
+async def _scenario_failover_exactly_once():
+    name_resolve.reconfigure(name_resolve.NameResolveConfig(type="memory"))
+    # both wedge until the victim is known (address sort order decides
+    # which replica the router picks first)
+    eng_a = StubEngine(delay=30.0)
+    eng_b = StubEngine(delay=30.0)
+    srv_a, addr_a = await _start_stub_server(eng_a)
+    srv_b, addr_b = await _start_stub_server(eng_b)
+    router = DecodeRouter(
+        "fexp",
+        "ft",
+        [addr_a, addr_b],
+        config=RouterConfig(
+            schedule_policy="round_robin",
+            health_poll_interval=0.15,
+            dead_after_failures=2,
+        ),
+    )
+    r_addr = await router.start("127.0.0.1", 0)
+    try:
+        await asyncio.sleep(0.4)
+        client = RemoteInfEngine(
+            InferenceEngineConfig(
+                experiment_name="fexp",
+                trial_name="ft",
+                request_timeout=60,
+                request_retries=1,
+                fleet_failover_retries=3,
+            )
+        )
+        client.addresses = [addr_a, addr_b]
+        task = asyncio.create_task(
+            client.agenerate(
+                ModelRequest(rid="fo-1", input_ids=[1, 2, 3],
+                             gconfig=GenerationHyperparameters(max_new_tokens=3))
+            )
+        )
+        await asyncio.sleep(0.3)
+        assert eng_a.calls + eng_b.calls == 1, "request not in flight yet"
+        if eng_a.calls:
+            victim, victim_eng, live, live_eng = srv_a, eng_a, srv_b, eng_b
+        else:
+            victim, victim_eng, live, live_eng = srv_b, eng_b, srv_a, eng_a
+        live_eng.delay = 0.05  # survivor answers fast
+        # the victim dies mid-request: its handler is cancelled, the
+        # client's retry re-schedules (requeue) and lands on the survivor
+        await victim.stop()
+        resp = await asyncio.wait_for(task, timeout=30)
+        assert resp.stop_reason == "stop"
+        assert len(resp.output_tokens) == 3
+        assert live_eng.calls == 1  # exactly one completion, zero lost
+        m = await arequest_with_retry(r_addr, "/metrics", method="GET")
+        assert m["client_requeues_total"] >= 1
+        return True
+    finally:
+        await close_current_session()
+        await router.stop()
+        await srv_a.stop()
+        await srv_b.stop()
+
+
+def test_client_failover_exactly_once():
+    assert _run_async(_scenario_failover_exactly_once())
+
+
+async def _scenario_router_429_fallback():
+    """A router that sheds (429) must not wedge the client forever: past
+    the request deadline the client degrades to local least-load policy."""
+    name_resolve.reconfigure(name_resolve.NameResolveConfig(type="memory"))
+    eng = StubEngine(
+        delay=0.01,
+        # a reported kv pool + kv_pressure_high=0.0 below makes NOTHING
+        # admissible — every schedule sheds
+        metrics={
+            "active_tokens": 0,
+            "kv_blocks_total": 10,
+            "kv_block_size": 16,
+            "kv_tokens_allocated": 0,
+        },
+    )
+    srv, addr = await _start_stub_server(eng)
+    router = DecodeRouter(
+        "qexp",
+        "qt",
+        [addr],
+        config=RouterConfig(
+            health_poll_interval=0.15,
+            queue_max=0,  # every unschedulable request sheds immediately
+            retry_after_s=0.2,
+            # a saturated "pool": nothing is admissible
+            kv_pressure_high=0.0,
+        ),
+    )
+    await router.start("127.0.0.1", 0)
+    try:
+        await asyncio.sleep(0.4)
+        client = RemoteInfEngine(
+            InferenceEngineConfig(
+                experiment_name="qexp",
+                trial_name="qt",
+                request_timeout=1.0,  # bounded 429-honor window
+                request_retries=1,
+            )
+        )
+        client.addresses = [addr]
+        t0 = time.monotonic()
+        resp = await client.agenerate(
+            ModelRequest(rid="q-1", input_ids=[1, 2, 3],
+                         gconfig=GenerationHyperparameters(max_new_tokens=3))
+        )
+        assert resp.stop_reason == "stop"
+        # it honored Retry-After at least once before degrading
+        assert time.monotonic() - t0 >= 0.2
+        assert eng.calls == 1
+        return True
+    finally:
+        await close_current_session()
+        await router.stop()
+        await srv.stop()
+
+
+def test_client_honors_429_then_falls_back():
+    assert _run_async(_scenario_router_429_fallback())

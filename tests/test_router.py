@@ -1,24 +1,33 @@
 """Decode-fleet router (parity: realhf/tests/system/test_gserver_manager.py —
-routing policies, qid affinity, staleness gate, rollout accounting)."""
+routing policies, qid/prefix affinity, pressure admission + bounded queue,
+failover requeue, staleness gate, rollout accounting, state expiry)."""
 
 import asyncio
 import threading
+import time
 
 import pytest
 from aiohttp import web
 
-from areal_tpu.launcher.router import DecodeRouter
+from areal_tpu.api.cli_args import RouterConfig
+from areal_tpu.launcher.router import _METRICS_FAIL_LIMIT, DecodeRouter
 from areal_tpu.utils import name_resolve, names
-from areal_tpu.utils.http import arequest_with_retry, close_current_session
+from areal_tpu.utils.http import (
+    HttpRequestError,
+    arequest_with_retry,
+    close_current_session,
+)
 
 
 class FakeServer:
     """Minimal decode-server stand-in: /health with a version, plus an
-    optional /metrics active-token gauge (None = no metrics endpoint)."""
+    optional /metrics active-token gauge (None = no metrics endpoint).
+    `metrics_extra` merges additional gauges (kv-pool pressure etc.)."""
 
-    def __init__(self, version=0, active_tokens=None):
+    def __init__(self, version=0, active_tokens=None, metrics_extra=None):
         self.version = version
         self.active_tokens = active_tokens
+        self.metrics_extra = metrics_extra or {}
         self._runner = None
         self.addr = None
 
@@ -28,7 +37,9 @@ class FakeServer:
     async def _metrics(self, request):
         if self.active_tokens is None:
             raise web.HTTPNotFound()
-        return web.json_response({"active_tokens": self.active_tokens})
+        return web.json_response(
+            {"active_tokens": self.active_tokens, **self.metrics_extra}
+        )
 
     async def start(self):
         app = web.Application()
@@ -231,3 +242,438 @@ async def _scenario_token_load_rebalance():
 
 def test_router_token_load_rebalance():
     assert _run_async(_scenario_token_load_rebalance())
+
+
+# -- satellite: unit coverage for previously untested router internals ------
+
+
+def test_release_qid_multi_pending_accounting():
+    """ISSUE 8 satellite: one qid carrying several in-flight requests (a
+    GRPO group) must release accounting one unit per finish, and fully
+    clear its maps on the last release."""
+    r = DecodeRouter(servers=["a:1"])
+    r._request_counts["a:1"] = 2
+    r._token_usage["a:1"] = 10.0
+    r._est_since_poll["a:1"] = 10.0
+    r._qid_to_server["q"] = "a:1"
+    r._qid_cost["q"] = 10.0
+    r._qid_pending["q"] = 2
+    r._qid_touched["q"] = time.monotonic()
+
+    r._release_qid("q")
+    assert r._qid_pending["q"] == 1
+    assert r._qid_cost["q"] == pytest.approx(5.0)
+    assert r._request_counts["a:1"] == 1
+    assert r._token_usage["a:1"] == pytest.approx(5.0)
+    assert r._est_since_poll["a:1"] == pytest.approx(5.0)
+
+    r._release_qid("q")
+    assert "q" not in r._qid_to_server
+    assert "q" not in r._qid_cost
+    assert "q" not in r._qid_pending
+    assert "q" not in r._qid_touched
+    assert r._request_counts["a:1"] == 0
+    assert r._token_usage["a:1"] == pytest.approx(0.0)
+
+    # releasing an unknown qid is a no-op, not a crash
+    r._release_qid("nope")
+    r._release_qid(None)
+
+
+def test_metrics_stale_fallback_after_fail_limit():
+    """ISSUE 8 satellite: after _METRICS_FAIL_LIMIT consecutive failed
+    /metrics polls the measured token load is dropped and _token_load
+    degrades to the router's own estimate."""
+    r = DecodeRouter(servers=["a:1"])
+    r._token_usage["a:1"] = 77.0  # router's own estimate
+    # healthy probe with a measurement
+    r._apply_probes_locked(["a:1"], [("a:1", 1, 1000.0, 0.0, None)])
+    assert r._token_load("a:1") == pytest.approx(1000.0)
+    # metrics fail (health ok) — the stale measurement survives until the
+    # fail limit, then is dropped
+    for i in range(_METRICS_FAIL_LIMIT):
+        assert ("a:1" in r._measured_tokens) == (i < _METRICS_FAIL_LIMIT)
+        r._apply_probes_locked(["a:1"], [("a:1", 1, None, 0.0, None)])
+    assert "a:1" not in r._measured_tokens
+    assert r._token_load("a:1") == pytest.approx(77.0)
+    # a successful poll re-establishes the measured base
+    r._apply_probes_locked(["a:1"], [("a:1", 1, 5.0, 0.0, None)])
+    assert r._token_load("a:1") == pytest.approx(5.0)
+
+
+def test_est_since_poll_snapshot_subtraction():
+    """Requests routed AFTER the probe snapshot must keep their estimated
+    cost through the subtraction (the probe could not have seen them)."""
+    r = DecodeRouter(servers=["a:1"])
+    r._est_since_poll["a:1"] = 100.0
+    # probe snapshotted 60.0 (40.0 was routed after the snapshot)
+    r._apply_probes_locked(["a:1"], [("a:1", 1, 500.0, 60.0, None)])
+    assert r._est_since_poll["a:1"] == pytest.approx(40.0)
+    assert r._token_load("a:1") == pytest.approx(540.0)
+
+
+def test_staleness_gate_arithmetic(monkeypatch):
+    """ISSUE 8 satellite: expected_version = (consumed + running) //
+    train_batch_size must exceed fleet_version + offpolicyness to close
+    the gate — boundary-exact."""
+    r = DecodeRouter(max_head_offpolicyness=1, train_batch_size=4)
+    r._versions = {"s": 0}
+    monkeypatch.setattr(r, "_training_sample_cnt", lambda: 12)
+    # (12 + 0) // 4 = 3 > 1 + 0 -> staled
+    assert r._is_staled()
+    # version catches up: 3 > 1 + 2 is False
+    r._versions = {"s": 2}
+    assert not r._is_staled()
+    # running rollouts count toward expected version
+    r._versions = {"s": 2}
+    r._running = 4  # (12 + 4) // 4 = 4 > 3
+    assert r._is_staled()
+    # fleet version = min across servers (conservative mid-push)
+    r._running = 0
+    r._versions = {"s": 9, "t": 2}
+    assert not r._is_staled()
+    r._versions = {"s": 9, "t": 0}
+    assert r._is_staled()
+
+
+def test_kv_headroom_and_admission():
+    """Pressure admission: kv capacity (minus fragmentation, scaled by
+    kv_pressure_high) must cover allocated + routed-since-poll + the new
+    request; host-tier replicas admit to the full pool."""
+    r = DecodeRouter(servers=["a:1"], config=RouterConfig(kv_pressure_high=0.9))
+    # no pressure report -> unknown -> admissible
+    assert r._admissible("a:1", 1000.0)
+    r._pressure["a:1"] = dict(
+        kv_blocks_total=10, kv_block_size=16, kv_pool_fragmentation=1,
+        kv_tokens_allocated=100, kv_host_pool_enabled=False,
+    )
+    # cap = 160*0.9 = 144; frag 16; used 100 -> headroom 28 before need
+    assert r._admissible("a:1", 28.0)
+    assert not r._admissible("a:1", 29.0)
+    # routed-but-unmeasured estimates count as used
+    r._est_since_poll["a:1"] = 20.0
+    assert not r._admissible("a:1", 10.0)
+    r._est_since_poll["a:1"] = 0.0
+    # host tier enabled: admit to the full pool (overflow offloads)
+    r._pressure["a:1"]["kv_host_pool_enabled"] = True
+    assert r._admissible("a:1", 44.0)
+    assert not r._admissible("a:1", 45.0)
+
+
+def test_expire_locked_ttl_and_server_pruning():
+    """ISSUE 8 satellite: qid/prefix maps expire by TTL (releasing load
+    accounting) and per-server counters for servers gone from discovery
+    AND the seed list are pruned."""
+    r = DecodeRouter(servers=["a:1"], config=RouterConfig(route_ttl_s=10.0))
+    now = time.monotonic()
+    r._qid_to_server["old"] = "a:1"
+    r._qid_cost["old"] = 4.0
+    r._qid_pending["old"] = 1
+    r._qid_touched["old"] = now - 100.0
+    r._qid_to_server["fresh"] = "a:1"
+    r._qid_cost["fresh"] = 4.0
+    r._qid_pending["fresh"] = 1
+    r._qid_touched["fresh"] = now
+    r._request_counts["a:1"] = 2
+    r._token_usage["a:1"] = 8.0
+    r._prefix_map[123] = ("a:1", now - 100.0)
+    r._prefix_map[456] = ("a:1", now)
+    # counters for a server no longer discovered anywhere
+    r._request_counts["gone:1"] = 5
+    r._measured_tokens["gone:1"] = 1.0
+    r._metrics_fail["gone:1"] = 1
+
+    r._expire_locked(now, ["a:1"])
+    assert "old" not in r._qid_to_server and "fresh" in r._qid_to_server
+    assert r._counters["expired_qids_total"] == 1
+    assert r._request_counts["a:1"] == 1  # old's unit released
+    assert r._token_usage["a:1"] == pytest.approx(4.0)
+    assert 123 not in r._prefix_map and 456 in r._prefix_map
+    assert "gone:1" not in r._request_counts
+    assert "gone:1" not in r._measured_tokens
+    assert "gone:1" not in r._metrics_fail
+    # LRU bound on the prefix map
+    r2 = DecodeRouter(servers=["a:1"], config=RouterConfig(route_max_entries=2))
+    for h in range(5):
+        r2._prefix_map[h] = ("a:1", time.monotonic())
+    r2._expire_locked(time.monotonic(), ["a:1"])
+    assert len(r2._prefix_map) == 2
+    assert list(r2._prefix_map) == [3, 4]  # oldest evicted first
+
+
+def test_failover_requeues_and_drains_affinity():
+    """Declaring a replica dead must move its qids (with their load
+    accounting) onto the least-loaded survivor and drop its prefix
+    affinity entries."""
+    r = DecodeRouter(servers=["dead:1", "s1:1", "s2:1"])
+    r.servers = ["dead:1", "s1:1", "s2:1"]
+    r._qid_to_server.update(q1="dead:1", q2="dead:1", q3="s1:1")
+    r._qid_cost.update(q1=10.0, q2=6.0, q3=1.0)
+    r._qid_pending.update(q1=2, q2=1, q3=1)
+    now = time.monotonic()
+    r._qid_touched.update(q1=now, q2=now, q3=now)
+    r._request_counts.update({"dead:1": 3, "s1:1": 1, "s2:1": 0})
+    r._token_usage.update({"dead:1": 16.0, "s1:1": 1.0, "s2:1": 0.0})
+    r._token_usage["s2:1"] = 0.0
+    r._prefix_map[99] = ("dead:1", now)
+    r._prefix_map[77] = ("s1:1", now)
+
+    r._failover_locked("dead:1")
+    assert r._qid_to_server["q1"] in ("s1:1", "s2:1")
+    assert r._qid_to_server["q2"] in ("s1:1", "s2:1")
+    assert r._qid_to_server["q3"] == "s1:1"
+    assert r._counters["requeues_total"] == 2
+    assert r._counters["failovers_total"] == 1
+    assert 99 not in r._prefix_map and 77 in r._prefix_map
+    assert r._request_counts["dead:1"] == 0
+    assert r._token_usage["dead:1"] == pytest.approx(0.0)
+    # moved load landed on the survivors
+    assert (
+        r._request_counts["s1:1"] + r._request_counts["s2:1"] == 4
+    )
+    assert r._token_usage["s1:1"] + r._token_usage["s2:1"] == pytest.approx(
+        17.0
+    )
+
+
+# -- e2e: prefix affinity, bounded queue, failover, /metrics ----------------
+
+
+async def _scenario_prefix_affinity():
+    s1, s2 = FakeServer(version=1), FakeServer(version=1)
+    a1, a2 = await s1.start(), await s2.start()
+    router = DecodeRouter(
+        servers=[a1, a2],
+        config=RouterConfig(
+            schedule_policy="prefix_affinity", health_poll_interval=0.2
+        ),
+    )
+    addr = await router.start("127.0.0.1", 0)
+    try:
+        await asyncio.sleep(0.5)
+        prefix = list(range(1, 129))  # two 64-token affinity blocks
+
+        async def sched(qid, pl=128, prefix_toks=prefix):
+            return await arequest_with_retry(
+                addr, "/schedule_request",
+                payload=dict(qid=qid, prompt_len=pl, group_size=1,
+                             new_token_budget=16, input_prefix=prefix_toks),
+            )
+
+        # a GRPO-style group (same prompt, distinct qids) co-locates
+        urls = [(await sched(f"g-{i}"))["url"] for i in range(4)]
+        assert len(set(urls)) == 1, f"group split across {set(urls)}"
+        affine = urls[0]
+
+        # a different prefix is NOT glued to the same server by affinity
+        # (it records its own entry wherever load steers it)
+        other = await sched("h-0", prefix_toks=list(range(500, 600)))
+        assert other["url"] in (a1, a2)
+
+        m = await arequest_with_retry(addr, "/metrics", method="GET")
+        assert m["affinity_hits_total"] >= 3
+        assert m["tracked_prefixes"] >= 1
+
+        # hot override: pile synthetic load onto the affine server — the
+        # next same-prefix request must be steered away and counted
+        router._token_usage[affine] = 1e9
+        over = await sched("g-override")
+        assert over["url"] != affine
+        m = await arequest_with_retry(addr, "/metrics", method="GET")
+        assert m["affinity_overrides_total"] >= 1
+        return True
+    finally:
+        await close_current_session()
+        await router.stop()
+        await s1.stop()
+        await s2.stop()
+
+
+def test_router_prefix_affinity():
+    assert _run_async(_scenario_prefix_affinity())
+
+
+async def _scenario_pressure_queue():
+    """Saturated fleet: requests queue (bounded FIFO), drain when pressure
+    drops, and shed with 429 + Retry-After past the deadline."""
+    full = dict(
+        kv_blocks_total=10, kv_block_size=16, kv_pool_fragmentation=0,
+        kv_tokens_allocated=160, kv_host_pool_enabled=False,
+        running_requests=1, queued_requests=0,
+    )
+    s1 = FakeServer(version=1, active_tokens=10, metrics_extra=dict(full))
+    a1 = await s1.start()
+    router = DecodeRouter(
+        servers=[a1],
+        config=RouterConfig(
+            schedule_policy="least_requests",
+            health_poll_interval=0.15,
+            queue_max=4,
+            queue_timeout_s=1.0,
+            retry_after_s=2.0,
+        ),
+    )
+    addr = await router.start("127.0.0.1", 0)
+    try:
+        await asyncio.sleep(0.4)  # poll sees the saturated pool
+
+        # 1) queue-then-drain: the request parks; relieving pressure
+        # lets the next poll admit it
+        t_req = asyncio.create_task(
+            arequest_with_retry(
+                addr, "/schedule_request",
+                payload=dict(qid="parked", prompt_len=50, group_size=1,
+                             new_token_budget=8),
+            )
+        )
+        await asyncio.sleep(0.3)
+        assert not t_req.done(), "request admitted against a full pool"
+        m = await arequest_with_retry(addr, "/metrics", method="GET")
+        assert m["queue_depth"] == 1
+        s1.metrics_extra["kv_tokens_allocated"] = 0  # pool drained
+        out = await asyncio.wait_for(t_req, timeout=5)
+        assert out["url"] == a1
+        m = await arequest_with_retry(addr, "/metrics", method="GET")
+        assert m["queue_admits_total"] == 1
+
+        # 2) deadline shed: saturate again; a queued request past
+        # queue_timeout_s is shed with 429 + Retry-After
+        s1.metrics_extra["kv_tokens_allocated"] = 160
+        await asyncio.sleep(0.4)
+        with pytest.raises(HttpRequestError) as ei:
+            await arequest_with_retry(
+                addr, "/schedule_request",
+                payload=dict(qid="late", prompt_len=50, group_size=1,
+                             new_token_budget=8),
+                max_retries=1,
+            )
+        assert ei.value.status == 429
+        assert '"retry_after": 2.0' in str(ei.value)
+        m = await arequest_with_retry(addr, "/metrics", method="GET")
+        assert m["queue_timeouts_total"] == 1
+
+        # 3) bounded FIFO: past queue_max the shed is immediate
+        waiters = [
+            asyncio.create_task(
+                arequest_with_retry(
+                    addr, "/schedule_request",
+                    payload=dict(qid=f"w{i}", prompt_len=50, group_size=1,
+                                 new_token_budget=8),
+                    max_retries=1,
+                )
+            )
+            for i in range(4)
+        ]
+        await asyncio.sleep(0.2)
+        with pytest.raises(HttpRequestError) as ei:
+            await arequest_with_retry(
+                addr, "/schedule_request",
+                payload=dict(qid="overflow", prompt_len=50, group_size=1,
+                             new_token_budget=8),
+                max_retries=1,
+            )
+        assert ei.value.status == 429
+        m = await arequest_with_retry(addr, "/metrics", method="GET")
+        assert m["queue_sheds_total"] >= 1
+        for w in waiters:
+            w.cancel()
+        return True
+    finally:
+        await close_current_session()
+        await router.stop()
+        await s1.stop()
+
+
+def test_router_pressure_queue_and_shed():
+    assert _run_async(_scenario_pressure_queue())
+
+
+async def _scenario_failover_e2e():
+    s1, s2 = FakeServer(version=1), FakeServer(version=1)
+    a1, a2 = await s1.start(), await s2.start()
+    router = DecodeRouter(
+        servers=[a1, a2],
+        config=RouterConfig(
+            schedule_policy="least_requests",
+            health_poll_interval=0.15,
+            dead_after_failures=2,
+        ),
+    )
+    addr = await router.start("127.0.0.1", 0)
+    try:
+        await asyncio.sleep(0.4)
+        urls = {}
+        for i in range(4):
+            out = await arequest_with_retry(
+                addr, "/schedule_request",
+                payload=dict(qid=f"q{i}", prompt_len=10, group_size=1,
+                             new_token_budget=8),
+            )
+            urls[f"q{i}"] = out["url"]
+        assert set(urls.values()) == {a1, a2}
+        victims = [q for q, u in urls.items() if u == a1]
+        await s1.stop()  # the replica dies with qids in flight
+        await asyncio.sleep(1.2)  # > dead_after_failures polls
+        m = await arequest_with_retry(addr, "/metrics", method="GET")
+        assert m["failovers_total"] >= 1
+        assert m["requeues_total"] >= len(victims)
+        # the corpse's qids were re-pointed: a retry re-schedule (requeue
+        # semantics) lands on the survivor
+        for q in victims:
+            out = await arequest_with_retry(
+                addr, "/schedule_request",
+                payload=dict(qid=q, prompt_len=10, group_size=1,
+                             new_token_budget=8, requeue=True),
+            )
+            assert out["url"] == a2
+        health = await arequest_with_retry(addr, "/health", method="GET")
+        assert health["servers"] == [a2]
+        return True
+    finally:
+        await close_current_session()
+        await router.stop()
+        await s2.stop()
+
+
+def test_router_failover_e2e():
+    assert _run_async(_scenario_failover_e2e())
+
+
+async def _scenario_metrics_endpoint():
+    s1 = FakeServer(
+        version=3, active_tokens=42,
+        metrics_extra=dict(kv_blocks_total=8, kv_block_size=16,
+                           kv_tokens_allocated=10, running_requests=1,
+                           queued_requests=0, prefix_cache_hit_rate=0.5),
+    )
+    a1 = await s1.start()
+    router = DecodeRouter(
+        servers=[a1], config=RouterConfig(health_poll_interval=0.15)
+    )
+    addr = await router.start("127.0.0.1", 0)
+    try:
+        await asyncio.sleep(0.4)
+        await arequest_with_retry(
+            addr, "/schedule_request",
+            payload=dict(qid="m1", prompt_len=64, group_size=1,
+                         new_token_budget=8,
+                         input_prefix=list(range(70))),
+        )
+        m = await arequest_with_retry(addr, "/metrics", method="GET")
+        assert m["schedule_policy"] == "prefix_affinity"
+        assert m["schedules_total"] == 1
+        assert m["tracked_qids"] == 1
+        assert m["queue_depth"] == 0
+        # the per-server pressure snapshot the admission decisions used
+        assert m["pressure"][a1]["kv_blocks_total"] == 8
+        assert m["pressure"][a1]["prefix_cache_hit_rate"] == 0.5
+        assert a1 in m["token_loads"]
+        return True
+    finally:
+        await close_current_session()
+        await router.stop()
+        await s1.stop()
+
+
+def test_router_metrics_endpoint():
+    assert _run_async(_scenario_metrics_endpoint())
