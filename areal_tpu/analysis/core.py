@@ -51,6 +51,8 @@ RULES: dict[str, str] = {
     "mutated in place",
     "AR204": "retrace hazard: loop-varying Python scalar or unhashable "
     "argument to a jit-compiled function",
+    "AR106": "broad except swallows the failure without logging, "
+    "re-raising, or preserving the exception",
 }
 
 _PRAGMA_RE = re.compile(r"#\s*areal-lint:\s*disable=([A-Z0-9,\s]+)")
@@ -215,6 +217,7 @@ def analyze_paths(
         analyze_concurrency,
     )
     from areal_tpu.analysis.jax_rules import analyze_jax
+    from areal_tpu.analysis.robustness import analyze_robustness
 
     state = ConcurrencyState()
     findings: list[Finding] = []
@@ -225,7 +228,11 @@ def analyze_paths(
             if collect_errors is not None:
                 collect_errors.append((display, repr(e)))
             continue
-        per_file = analyze_concurrency(sf, state) + analyze_jax(sf)
+        per_file = (
+            analyze_concurrency(sf, state)
+            + analyze_jax(sf)
+            + analyze_robustness(sf)
+        )
         for f in per_file:
             if rules is not None and f.rule not in rules:
                 continue

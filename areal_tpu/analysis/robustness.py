@@ -1,0 +1,156 @@
+"""AR1xx (robustness half) — swallowed-exception analysis.
+
+AR106: a broad `except` (bare, `Exception`, or `BaseException`) whose body
+neither re-raises, nor logs, nor keeps the exception object alive for a
+later handler is a SILENT SWALLOW: the failure vanishes and the system
+degrades invisibly — the exact rot the fault-injection harness exists to
+expose (a seam that fires into a swallowing handler looks like a pass).
+
+The rule runs over the fault-bearing packages only — `areal_tpu/core/`,
+`areal_tpu/launcher/`, `areal_tpu/engine/` — where an invisible failure
+corrupts rollout accounting, weight staging, or KV state. Paths outside
+the `areal_tpu/` tree (seeded test fixtures) are always checked.
+
+A handler is NOT a swallow when its body contains any of:
+  - a `raise` statement (re-raise or translate),
+  - a logging call: any call whose dotted callee mentions a logger-ish
+    root (`logger`, `logging`, `log`, `warnings`, `traceback`) or a
+    level method (`.debug/.info/.warning/.error/.exception/.critical/
+    .warn/.print_exc`),
+  - any reference to the bound exception name (`last_exc = e`, `_put(e)`,
+    `callback(e)` — the error is preserved or delegated, not dropped).
+
+Suppression: inline pragma `# areal-lint: disable=AR106`, file pragma, or
+a baseline entry keyed on `<qualname>.except#<n>` (ordinal among the
+function's broad handlers — stable across unrelated edits).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from areal_tpu.analysis.core import Finding, SourceFile
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGY_ROOTS = {"logger", "logging", "log", "warnings", "traceback"}
+_LOGGY_METHODS = {
+    "debug",
+    "info",
+    "warning",
+    "error",
+    "exception",
+    "critical",
+    "warn",
+    "print_exc",
+    "log",
+}
+
+# rule scope: only these packages carry cross-component fault seams
+_SCOPED_PKGS = ("areal_tpu/core/", "areal_tpu/launcher/", "areal_tpu/engine/")
+
+
+def _in_scope(display_path: str) -> bool:
+    p = display_path.replace("\\", "/")
+    if "areal_tpu/" not in p:
+        return True  # fixtures / explicit single-file runs
+    return any(pkg in p for pkg in _SCOPED_PKGS)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names: list[ast.expr] = list(t.elts) if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True  # builtins.Exception
+    return False
+
+
+def _call_is_loggy(call: ast.Call) -> bool:
+    fn = call.func
+    parts: list[str] = []
+    node = fn
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    if not parts:
+        return False
+    root = parts[-1]
+    leaf = parts[0]
+    return root in _LOGGY_ROOTS or leaf in _LOGGY_METHODS
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    exc_name = handler.name
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Raise):
+                return False
+            if isinstance(node, ast.Call) and _call_is_loggy(node):
+                return False
+            # `last_exc = e` / `_put(e)` / `cb(e)`: the error object is
+            # preserved or delegated — a later decision sees it
+            if isinstance(node, ast.Name) and exc_name and node.id == exc_name:
+                return False
+    return True
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.stack: list[str] = []
+        self.findings: list[Finding] = []
+        # per-qualname ordinal so the baseline key survives line churn
+        self._ordinals: dict[str, int] = {}
+
+    def _qualname(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _visit_fn(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for handler in node.handlers:
+            if _is_broad(handler) and _handler_swallows(handler):
+                qn = self._qualname()
+                n = self._ordinals.get(qn, 0)
+                self._ordinals[qn] = n + 1
+                caught = "bare" if handler.type is None else "Exception"
+                self.findings.append(
+                    Finding(
+                        rule="AR106",
+                        file=self.sf.display,
+                        line=handler.lineno,
+                        key=f"{qn}.except#{n}",
+                        message=(
+                            f"broad `except {caught}` swallows the "
+                            "failure: no raise, no log, exception not "
+                            "preserved — a fault seam firing here "
+                            "degrades the system invisibly"
+                        ),
+                    )
+                )
+        self.generic_visit(node)
+
+
+def analyze_robustness(sf: SourceFile) -> list[Finding]:
+    if not _in_scope(sf.display):
+        return []
+    w = _Walker(sf)
+    w.visit(sf.tree)
+    return w.findings

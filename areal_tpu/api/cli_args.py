@@ -368,6 +368,37 @@ class JaxDecodeConfig:
     # bounded (LRU) and completed entries expire after the TTL.
     idempotency_entries: int = 4096
     idempotency_ttl_s: float = 600.0
+    # Crash-mid-stage recovery: weight staging whose last frame arrived
+    # more than this many seconds ago is REAPED (dropped with the push-id
+    # epoch cleared) the next time any weight endpoint runs — a learner
+    # that died mid-push must not leave multi-GiB staging resident until
+    # an operator notices. The client additionally aborts its own
+    # incomplete push on reconnect (remote_inf_engine.stage_weights).
+    # 0 disables the reaper.
+    weight_staging_ttl_s: float = 600.0
+
+
+@dataclass
+class FaultInjectionConfig:
+    """Deterministic fault injection (core/fault_injection.py).
+
+    When enabled, a seed-driven plan perturbs the named seams at every
+    cross-component boundary (client HTTP send/recv, router poll/forward,
+    server handling, weight stage/commit, host-KV swap, rollout task
+    execution) so chaos benches/tests can replay a fleet trace under a
+    reproducible fault schedule. `plan` is a JSON list of fault points:
+
+        [{"site": "client.http.recv", "mode": "error_after_effect",
+          "at": [3], "match": {"endpoint": "/generate"}}, ...]
+
+    with modes abort / error_after_effect / delay / torn (see
+    core/fault_injection.py for the full point schema). Disabled (the
+    default), every seam is a single None-check — production pays nothing.
+    """
+
+    enabled: bool = False
+    seed: int = 0
+    plan: str = ""
 
 
 @dataclass
@@ -418,6 +449,21 @@ class RouterConfig:
     # its in-flight qids are requeued onto survivors and its affinity
     # entries drained
     dead_after_failures: int = 2
+    # -- per-replica circuit breaker ------------------------------------
+    # A replica that is SLOW or erroring (but not yet dead) must be
+    # probed, not hammered: after `breaker_trip_after` consecutive bad
+    # polls (health/metrics failure, or health RTT above
+    # `breaker_slow_s` when > 0) the breaker OPENS and the replica
+    # leaves rotation. Once polls look healthy again it goes HALF-OPEN:
+    # at most `breaker_probe_requests` in-flight requests are routed
+    # there as probes; a completed probe closes the breaker and full
+    # traffic (and the replica's surviving affinity entries) return. A
+    # transient trip never drains prefix/qid affinity state — only
+    # `dead_after_failures` failover does.
+    breaker_enabled: bool = True
+    breaker_trip_after: int = 3
+    breaker_slow_s: float = 0.0
+    breaker_probe_requests: int = 1
     # -- state expiry ---------------------------------------------------
     # TTL for qid/prefix affinity entries (a crashed client must not leak
     # load accounting forever); 0 disables TTL expiry. route_max_entries
@@ -440,8 +486,19 @@ class InferenceEngineConfig:
     check_trajectory_format: bool = False
     schedule_policy: str = "round_robin"
     setup_timeout: float = 120.0
+    # Per-request deadline: every generation request owns a budget of
+    # `request_timeout` seconds from submission, and the REMAINING budget
+    # propagates through every stage — router schedule retries, the
+    # router's bounded queue wait (shipped as `deadline_s` so the router
+    # sheds instead of holding a dead request), 429 Retry-After sleeps,
+    # and each failover attempt's transport timeout — so a request never
+    # retries past its own deadline.
     request_timeout: float = 3600.0
     request_retries: int = 3
+    # Backoff jitter fraction for retry/429 sleeps: each wait is scaled
+    # by uniform[1-j, 1+j] so synchronized clients (a whole fleet shed in
+    # one poll round) don't retry in lockstep and re-dogpile the server.
+    retry_jitter: float = 0.25
     pause_grace_period: float = 0.0
     # Overlapped weight sync: stream staged weight buckets with generation
     # LIVE and pause only around /commit_weights, so the observed generation
@@ -466,6 +523,10 @@ class InferenceEngineConfig:
     # Fleet router policy knobs (launcher/router.py); launchers pass these
     # through when they spawn the router job.
     router: RouterConfig = field(default_factory=RouterConfig)
+    # Deterministic fault injection (chaos testing; off by default).
+    fault_injection: FaultInjectionConfig = field(
+        default_factory=FaultInjectionConfig
+    )
 
 
 @dataclass

@@ -103,34 +103,66 @@ class AsyncTaskRunner:
             from areal_tpu.utils.http import close_current_session
 
             await close_current_session()
-        except Exception:  # pragma: no cover - best-effort cleanup
-            pass
+        except Exception as e:  # pragma: no cover - best-effort cleanup
+            logger.debug(f"session close on runner shutdown failed: {e!r}")
 
     async def _execute(self, task_id: int, factory, meta: dict):
         start = time.monotonic()
+        finished = False
+
+        def finish(tr: TaskResult) -> None:
+            # exactly-once completion accounting: whatever path ends this
+            # task (result, failure, cancel, cancel racing a failure), the
+            # inflight counter drops ONCE and ONE result is emitted — a
+            # leaked decrement here used to wedge StalenessManager capacity
+            # (the submitted slot stayed "running" forever)
+            nonlocal finished
+            if finished:
+                return
+            finished = True
+            with self._lock:
+                self._inflight -= 1
+            self._output.put(tr)
+
         try:
+            from areal_tpu.core import fault_injection
+
+            fi = fault_injection.get()
+            if fi is not None:
+                await fi.afire("task.run", task_id=task_id)
             result = await factory()
-            tr = TaskResult(
-                task_id=task_id,
-                result=result,
-                latency=time.monotonic() - start,
-                metadata=meta,
+            finish(
+                TaskResult(
+                    task_id=task_id,
+                    result=result,
+                    latency=time.monotonic() - start,
+                    metadata=meta,
+                )
             )
-        except asyncio.CancelledError:
+        except asyncio.CancelledError as e:
+            # a cancelled task (pause-window drain, shutdown) still owns a
+            # capacity slot — surface a result so the executor releases it
+            finish(
+                TaskResult(
+                    task_id=task_id,
+                    exception=e,
+                    latency=time.monotonic() - start,
+                    metadata=meta,
+                )
+            )
             raise
         except BaseException as e:  # noqa: BLE001
             logger.error(
                 f"task {task_id} failed: {e}\n{traceback.format_exc()}"
             )
-            tr = TaskResult(
-                task_id=task_id,
-                exception=e,
-                latency=time.monotonic() - start,
-                metadata=meta,
+            finish(
+                TaskResult(
+                    task_id=task_id,
+                    exception=e,
+                    latency=time.monotonic() - start,
+                    metadata=meta,
+                )
             )
-        with self._lock:
-            self._inflight -= 1
-        self._output.put(tr)
 
     def destroy(self) -> None:
         self._shutdown.set()
@@ -190,6 +222,14 @@ class AsyncTaskRunner:
                 out.append(self._output.get_nowait())
             except queue.Empty:
                 return out
+
+    def requeue_results(self, results: list[TaskResult]) -> None:
+        """Put drained results back for a later poll. A consumer that
+        dies mid-batch (the executor's failure-streak escalation) must
+        not drop the unprocessed tail — each result accounts for a
+        capacity slot that stays leaked unless someone collects it."""
+        for tr in results:
+            self._output.put(tr)
 
     def wait(
         self,

@@ -137,7 +137,8 @@ class DistRolloutCoordinator:
     def _dp_size(self) -> int:
         try:
             return int(self.train_engine.data_parallel_world_size())
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — single-process fallback
+            logger.debug(f"dp size unavailable ({e!r}); assuming 1")
             return 1
 
     def prepare_batch(

@@ -35,7 +35,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import os
-import re
+import random
 import threading
 import time
 import uuid
@@ -44,6 +44,7 @@ from typing import Any
 from areal_tpu.api.cli_args import InferenceEngineConfig
 from areal_tpu.api.engine_api import InferenceEngine
 from areal_tpu.api.io_struct import ModelRequest, ModelResponse, WeightUpdateMeta
+from areal_tpu.core import fault_injection
 from areal_tpu.core.workflow_executor import WorkflowExecutor
 from areal_tpu.utils import logging, names
 from areal_tpu.utils import name_resolve
@@ -127,6 +128,19 @@ class RemoteInfEngine(InferenceEngine):
         self.config = config
         self.backend = backend or JaxDecodeBackend()
         self.tokenizer = tokenizer
+        # chaos testing: an enabled FaultInjectionConfig arms the
+        # process-global injector (covers every in-process seam — client
+        # HTTP, and router/server/engine when co-hosted); disabled, the
+        # seams stay single None-checks
+        fi_plan = fault_injection.FaultPlan.from_config(
+            getattr(config, "fault_injection", None)
+        )
+        if fi_plan is not None:
+            fault_injection.configure(fi_plan)
+            logger.warning(
+                f"fault injection ARMED: seed={fi_plan.seed} "
+                f"{len(fi_plan.points)} point(s) — chaos testing only"
+            )
         self.addresses: list[str] = []
         self._router: str | None = None  # cached names.rollout_router lookup
         self._router_next_lookup = 0.0  # negative-lookup cooldown clock
@@ -156,6 +170,11 @@ class RemoteInfEngine(InferenceEngine):
             commit_pause_secs=0.0,
             aborts=0,
         )
+        # crash-mid-stage recovery: the push id of a stage_weights whose
+        # commit never landed. The NEXT push (the "reconnect") aborts it
+        # server-side before staging anything — paired with the servers'
+        # push-id-epoch staging reaper (weight_staging_ttl_s).
+        self._incomplete_push_id: str | None = None  # guarded-by: _stats_lock
 
     # -- discovery ------------------------------------------------------
     def _discover_servers(self, addr: str | list[str] | None) -> list[str]:
@@ -235,17 +254,23 @@ class RemoteInfEngine(InferenceEngine):
                         self.config.experiment_name, self.config.trial_name
                     )
                 )
-            except Exception:  # noqa: BLE001 — router is optional
+            except Exception as e:  # noqa: BLE001 — router is optional
+                logger.debug(f"no rollout router registered ({e!r})")
                 addr = ""
         self._router = addr
         return addr or None
 
     async def _schedule_via_router(
-        self, req: ModelRequest, requeue: bool = False
+        self,
+        req: ModelRequest,
+        requeue: bool = False,
+        deadline: float | None = None,
     ) -> str | None:
         router = self._router_addr()
         if router is None:
             return None
+        if deadline is None:
+            deadline = time.monotonic() + self.config.request_timeout
         # the prefix the router's affinity hashing buckets (64-token
         # blocks, up to 4): enough for the longest bucket, cheap to ship
         payload = dict(
@@ -257,16 +282,29 @@ class RemoteInfEngine(InferenceEngine):
         )
         if requeue:
             payload["requeue"] = True
-        deadline = time.monotonic() + self.config.request_timeout
         backoff = 1.0
         while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                # the request's own budget is gone: scheduling it anywhere
+                # would only produce work its caller no longer awaits
+                logger.warning(
+                    f"router schedule for {req.rid} abandoned: deadline "
+                    "exhausted"
+                )
+                return None
+            # the router bounds its queue hold by this, so a queued
+            # request is shed (not held) once its owner stops caring
+            payload["deadline_s"] = remaining
             try:
                 out = await arequest_with_retry(
                     router,
                     "/schedule_request",
                     payload=payload,
                     max_retries=2,
-                    timeout=self.config.router_request_timeout,
+                    timeout=min(
+                        self.config.router_request_timeout, remaining
+                    ),
                 )
                 return out["url"]
             except HttpRequestError as e:
@@ -274,11 +312,17 @@ class RemoteInfEngine(InferenceEngine):
                     # the router's bounded admission queue shed us: honor
                     # Retry-After instead of dogpiling a server directly
                     # (which would trigger the preemption storm the queue
-                    # exists to prevent)
-                    m = re.search(r'"retry_after":\s*([0-9.]+)', str(e))
-                    wait = float(m.group(1)) if m else backoff
+                    # exists to prevent). The structured error body carries
+                    # retry_after; jitter the wait so a whole shed wave
+                    # doesn't come back in lockstep.
+                    ra = e.body.get("retry_after")
+                    wait = float(ra) if ra is not None else backoff
                     backoff = min(backoff * 2, 10.0)
-                    await asyncio.sleep(wait)
+                    j = max(self.config.retry_jitter, 0.0)
+                    wait *= 1.0 + random.uniform(-j, j)
+                    await asyncio.sleep(
+                        max(0.0, min(wait, deadline - time.monotonic()))
+                    )
                     continue
                 return self._router_schedule_failed(e)
             except Exception as e:  # noqa: BLE001 — degrade to local policy
@@ -364,23 +408,45 @@ class RemoteInfEngine(InferenceEngine):
         )
 
     async def _generate_failover(
-        self, req: ModelRequest, payload: dict[str, Any], addr: str
+        self,
+        req: ModelRequest,
+        payload: dict[str, Any],
+        addr: str,
+        deadline: float | None = None,
     ) -> tuple[dict[str, Any], str]:
         """POST /generate with router-aware failover: when the transport
         retries to `addr` are exhausted (the replica died mid-request),
         re-schedule — via the router with requeue=True (whose failover has
         re-pointed the qid at a survivor), or locally excluding the failed
         address — and re-send the SAME payload (same xid: the server-side
-        idempotency table makes the retry exactly-once). Returns (response,
-        address that served it)."""
+        idempotency table makes the retry exactly-once). Every attempt's
+        transport timeout is clipped to the request's remaining deadline
+        budget, and failover stops once the budget is spent — a request
+        never RETRIES past its own deadline. The initial submission always
+        ships: a scheduling path that burned the whole budget honoring
+        Retry-After degrades to one direct attempt rather than failing
+        without ever contacting a server. Returns (response, address that
+        served it)."""
+        if deadline is None:
+            deadline = time.monotonic() + self.config.request_timeout
         for attempt in range(self.config.fleet_failover_retries + 1):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                if attempt == 0:
+                    remaining = self.config.request_timeout
+                else:
+                    raise HttpRequestError(
+                        f"/generate for {req.rid} abandoned: request "
+                        f"deadline exhausted after {attempt} failover "
+                        "attempt(s)"
+                    )
             try:
                 data = await arequest_with_retry(
                     addr,
                     "/generate",
                     payload=payload,
                     max_retries=self.config.request_retries,
-                    timeout=self.config.request_timeout,
+                    timeout=min(self.config.request_timeout, remaining),
                 )
                 return data, addr
             except Exception as e:  # noqa: BLE001 — classify below
@@ -395,7 +461,9 @@ class RemoteInfEngine(InferenceEngine):
                 logger.warning(
                     f"/generate to {addr} failed ({e!r}); failing over"
                 )
-                routed = await self._schedule_via_router(req, requeue=True)
+                routed = await self._schedule_via_router(
+                    req, requeue=True, deadline=deadline
+                )
                 if routed is None or routed == addr:
                     self._release_local(req.rid)
                     routed = self.choose_server(
@@ -409,7 +477,10 @@ class RemoteInfEngine(InferenceEngine):
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         """Generate with the interrupt-resume loop (reference :428-478)."""
         start = time.monotonic()
-        routed = await self._schedule_via_router(req)
+        # the request's whole-lifetime budget: schedule retries, queue
+        # wait, 429 sleeps, and failover attempts all draw from it
+        deadline = start + self.config.request_timeout
+        routed = await self._schedule_via_router(req, deadline=deadline)
         addr = routed or self.choose_server(
             req.rid, cost=self._local_cost(req)
         )
@@ -435,7 +506,9 @@ class RemoteInfEngine(InferenceEngine):
                 # never double-generate), fresh for each resume iteration
                 # (which is a new logical submission)
                 payload["xid"] = uuid.uuid4().hex
-                data, addr = await self._generate_failover(req, payload, addr)
+                data, addr = await self._generate_failover(
+                    req, payload, addr, deadline=deadline
+                )
                 out = self.backend.parse_generate_response(data)
                 acc_tokens.extend(out["output_tokens"])
                 acc_logprobs.extend(out["output_logprobs"])
@@ -456,15 +529,15 @@ class RemoteInfEngine(InferenceEngine):
             self._release_local(req.rid)
             if routed is not None:
                 try:
-                    await arequest_with_retry(
-                        self._router,
-                        "/finish_request",
-                        payload=dict(qid=req.rid),
-                        max_retries=1,
-                        timeout=10,
+                    # shield: if THIS task is being cancelled (rollout
+                    # abort), the release still completes on the loop —
+                    # the router's cost unit must not wedge until TTL
+                    await asyncio.shield(
+                        self._finish_request_best_effort(req.rid)
                     )
-                except Exception:  # noqa: BLE001 — accounting is best-effort
-                    pass
+                except BaseException as e:  # noqa: BLE001 — release is
+                    # best-effort; the router's TTL expiry backstops it
+                    logger.debug(f"finish_request({req.rid}) skipped: {e!r}")
         return ModelResponse(
             input_tokens=prompt,
             output_tokens=acc_tokens,
@@ -475,6 +548,20 @@ class RemoteInfEngine(InferenceEngine):
             ttft=ttft,
             tokenizer=self.tokenizer,
         )
+
+    async def _finish_request_best_effort(self, rid: str) -> None:
+        """Release one qid's router accounting; failures are logged, never
+        raised (the router TTL-expires leaked entries regardless)."""
+        try:
+            await arequest_with_retry(
+                self._router,
+                "/finish_request",
+                payload=dict(qid=rid),
+                max_retries=1,
+                timeout=10,
+            )
+        except Exception as e:  # noqa: BLE001 — accounting is best-effort
+            logger.debug(f"finish_request({rid}) failed: {e!r}")
 
     # -- fanout RPCs ----------------------------------------------------
     def _fanout(
@@ -556,6 +643,19 @@ class RemoteInfEngine(InferenceEngine):
             inflight = self.config.weight_sync_inflight_buckets
         inflight = max(int(inflight), 1)
         push_id = push_id or self._new_push_id()
+        # reconnect recovery: a previous push that staged but never
+        # committed (crashed trainer loop, lost commit response) left
+        # staging on the servers — drop it explicitly before this push
+        # streams, instead of waiting for the newer-id reset to race it
+        with self._stats_lock:
+            stale_push = self._incomplete_push_id
+            self._incomplete_push_id = push_id
+        if stale_push is not None and stale_push != push_id:
+            logger.warning(
+                f"aborting incomplete previous push {stale_push} before "
+                f"staging {push_id}"
+            )
+            self.abort_push(stale_push, forget=False)
         t0 = time.monotonic()
         n_bytes = 0
 
@@ -591,6 +691,9 @@ class RemoteInfEngine(InferenceEngine):
             loop = asyncio.get_running_loop()
 
             async def _broadcast(b: bytes):
+                await fault_injection.afire(
+                    "client.weights.stage", push_id=push_id, nbytes=len(b)
+                )
                 await asyncio.gather(
                     *[
                         arequest_with_retry(
@@ -684,15 +787,23 @@ class RemoteInfEngine(InferenceEngine):
         with self._stats_lock:
             self._sync_stats["commit_pause_secs"] += time.monotonic() - t0
             self._sync_stats["n_pushes"] += 1
+            if self._incomplete_push_id == push_id:
+                self._incomplete_push_id = None
 
-    def abort_push(self, push_id: str) -> None:
+    def abort_push(self, push_id: str, forget: bool = True) -> None:
         """Drop server-side staging for a failed/abandoned push (explicit
         release — otherwise multi-GiB staging lingers until the next push's
-        id happens to reset it)."""
+        id happens to reset it). `forget=False` keeps the incomplete-push
+        marker owned by the caller (the reconnect path aborts an OLD push
+        while a NEW one is already registered)."""
         try:
             self._fanout("/abort_weights", {"push_id": push_id})
         except Exception as e:  # noqa: BLE001 — cleanup is best-effort
             logger.warning(f"abort_weights({push_id}) failed: {e!r}")
+        if forget:
+            with self._stats_lock:
+                if self._incomplete_push_id == push_id:
+                    self._incomplete_push_id = None
 
     def update_weights_from_tensor(
         self,
@@ -735,6 +846,8 @@ class RemoteInfEngine(InferenceEngine):
         with self._stats_lock:
             self._sync_stats["commit_pause_secs"] += time.monotonic() - t0
             self._sync_stats["n_pushes"] += 1
+            if self._incomplete_push_id == push_id:
+                self._incomplete_push_id = None
 
     def get_metrics(self) -> dict:
         """Client-side weight-sync observability: push counts, wire bytes,

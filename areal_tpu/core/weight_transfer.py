@@ -28,6 +28,10 @@ from typing import Any, Iterable, Iterator
 
 import numpy as np
 
+from areal_tpu.utils import logging
+
+logger = logging.getLogger("weight_transfer")
+
 try:  # ml_dtypes ships with jax; gives numpy a bfloat16 dtype
     import ml_dtypes
 
@@ -92,8 +96,8 @@ def iter_prefetched(
         if start is not None:
             try:
                 start()
-            except Exception:  # pragma: no cover - backend-dependent
-                pass
+            except Exception as e:  # pragma: no cover - backend-dependent
+                logger.debug(f"copy_to_host_async unavailable: {e!r}")
         return name, leaf
 
     for name, leaf in items:
@@ -189,10 +193,29 @@ def pack_buckets(
 
 
 def unpack_bucket_parts(payload: bytes) -> list[tuple[dict, bytes]]:
-    """One frame → [(spec, raw_bytes)] — parts of possibly-split tensors."""
+    """One frame → [(spec, raw_bytes)] — parts of possibly-split tensors.
+
+    Raises ValueError on a TORN frame (body shorter than the manifest
+    declares): silently staging a short part would count phantom coverage
+    and either materialize a corrupt tensor or wedge the push at finalize.
+    An exception here turns into a 5xx, and the client's bucket retry
+    re-sends the full frame."""
+    if len(payload) < 8:
+        raise ValueError(f"torn weight frame: {len(payload)} bytes, no header")
     (mlen,) = struct.unpack_from("<Q", payload, 0)
+    if len(payload) < 8 + mlen:
+        raise ValueError(
+            f"torn weight frame: manifest needs {8 + mlen} bytes, "
+            f"got {len(payload)}"
+        )
     manifest = json.loads(payload[8 : 8 + mlen].decode())
     base = 8 + mlen
+    need = max((s["offset"] + s["nbytes"] for s in manifest), default=0)
+    if len(payload) < base + need:
+        raise ValueError(
+            f"torn weight frame: body needs {need} tensor bytes, "
+            f"got {len(payload) - base}"
+        )
     return [
         (spec, payload[base + spec["offset"] : base + spec["offset"] + spec["nbytes"]])
         for spec in manifest
@@ -252,6 +275,15 @@ class WeightStaging:
         self.ready.clear()
 
     def add_bucket(self, payload: bytes) -> None:
+        from areal_tpu.core import fault_injection
+
+        # staging seam: an abort models a frame lost between HTTP receive
+        # and staging apply; a torn frame truncates the payload, which the
+        # manifest length-check below rejects — either way the client's
+        # bucket retry re-covers the byte ranges (interval-merged, so a
+        # re-split retry can never materialize a tensor with holes)
+        fault_injection.fire("weight.stage.add", nbytes=len(payload))
+        payload = fault_injection.tear("weight.stage.add", payload)
         for spec, raw in unpack_bucket_parts(payload):
             name = spec["name"]
             if name in self.ready:  # duplicate of a completed tensor

@@ -10,6 +10,7 @@ assembles accepted trajectories into padded training batches.
 
 from __future__ import annotations
 
+import asyncio
 import queue
 import random
 import time
@@ -167,13 +168,31 @@ class WorkflowExecutor:
             capacity -= 1
 
     def _collect(self) -> None:
-        for tr in self.runner.poll_results():
-            self._on_result(tr)
+        results = self.runner.poll_results()
+        for i, tr in enumerate(results):
+            try:
+                self._on_result(tr)
+            except BaseException:
+                # the failure-streak escalation raises out of here; the
+                # drained-but-unprocessed tail still owns running slots —
+                # requeue it so the accounting stays collectable instead
+                # of leaking with the dropped list
+                self.runner.requeue_results(results[i + 1:])
+                raise
 
     def _on_result(self, tr: TaskResult) -> None:
         sm = self.staleness_manager
         if tr.exception is not None:
+            # whatever killed the episode, its capacity slot is released
+            # exactly once here — the runner guarantees one TaskResult per
+            # task (including cancelled ones), so `running` can neither
+            # leak nor double-release on a cancel-then-fail race
             sm.on_rollout_rejected()
+            if isinstance(tr.exception, asyncio.CancelledError):
+                # a drained (pause/shutdown) episode is not evidence of a
+                # sick engine — release the slot but don't feed the
+                # consecutive-failure escalation
+                return
             # A systematic failure (e.g. crashed decode engine) must surface
             # instead of spinning forever resubmitting doomed episodes.
             self._consecutive_failures += 1

@@ -410,6 +410,10 @@ class JaxDecodeEngine(InferenceEngine):
         self._n_prefix_inplace = 0
         self._n_suffix_prefills = 0  # partial-prefix hits (multi-turn)
         self._n_preemptions = 0  # pool-pressure internal requeues
+        # graceful-degradation counters: host-tier operations that FAILED
+        # (not merely missed) and fell back to drop / re-prefill
+        self._n_offload_failures = 0
+        self._n_promote_failures = 0
         self._alloc: KVBlockAllocator | None = None  # set in initialize
         # host-RAM KV tier (kv_host_pool_mb > 0): eviction offloads
         # parked/preempted slots' blocks here instead of dropping them;
@@ -1679,31 +1683,39 @@ class JaxDecodeEngine(InferenceEngine):
         nb = self._alloc.blocks_for(covered)
         if nb <= 0 or nb > int(self._alloc.nblocks[slot]):
             return False
-        fn = self._get_host_gather_fn()
-        with self._weight_lock:
-            hk, hv = fn(
-                self._k_cache,
-                self._v_cache,
-                jnp.asarray(self._alloc.row(slot, nb)),
+        try:
+            fn = self._get_host_gather_fn()
+            with self._weight_lock:
+                hk, hv = fn(
+                    self._k_cache,
+                    self._v_cache,
+                    jnp.asarray(self._alloc.row(slot, nb)),
+                )
+            for arr in (hk, hv):
+                copy_async = getattr(arr, "copy_to_host_async", None)
+                if copy_async is not None:
+                    copy_async()
+            entry = HostKVEntry(
+                rid=rid,
+                k=hk,
+                v=hv,
+                nb=nb,
+                covered=int(covered),
+                tokens=list(tokens),
+                rope_delta=int(self._slot_rope_delta[slot]),
+                base_key=np.array(self._slot_keys[slot]),
+                ts=time.monotonic(),
+                pending=True,
             )
-        for arr in (hk, hv):
-            copy_async = getattr(arr, "copy_to_host_async", None)
-            if copy_async is not None:
-                copy_async()
-        entry = HostKVEntry(
-            rid=rid,
-            k=hk,
-            v=hv,
-            nb=nb,
-            covered=int(covered),
-            tokens=list(tokens),
-            rope_delta=int(self._slot_rope_delta[slot]),
-            base_key=np.array(self._slot_keys[slot]),
-            ts=time.monotonic(),
-            pending=True,
-        )
-        with self._host_lock:
-            return self._host_store.put(entry)
+            with self._host_lock:
+                return self._host_store.put(entry)
+        except Exception as e:  # noqa: BLE001 — degrade, never wedge
+            # a failed D2H offload (OOM on the host, copy error, injected
+            # fault) must cost a re-prefill at resume, never the scheduler
+            # thread: the caller drops the blocks, the pre-tier behavior
+            self._n_offload_failures += 1
+            logger.warning(f"host-KV offload of {rid} failed: {e!r}")
+            return False
 
     def _host_match(self, rid: str, covered: int, tokens: list[int]) -> bool:
         """Exact-resume peek into the host tier (no side effects beyond
@@ -2159,6 +2171,17 @@ class JaxDecodeEngine(InferenceEngine):
                     # hold the request for a later pass
                     self._overflow.insert(0, item)
                     break
+                except Exception as e:  # noqa: BLE001 — degrade, never wedge
+                    # swap-in died (host bytes unreadable, upload error,
+                    # injected fault): treat as a host-tier miss and fall
+                    # through to the normal re-prefill paths below — the
+                    # resumed stream stays bit-identical, it just pays
+                    # the prefill the tier would have skipped
+                    self._n_promote_failures += 1
+                    logger.warning(
+                        f"host-KV promotion of {item.rid} failed: {e!r}"
+                    )
+                    promoted = False
             if resumed is None and P > 1 and not promoted and donor is not None:
                 # Prefix-KV hit (the GRPO group case: group_size requests
                 # share one prompt). The donor slot's blocks [0, P-1)
@@ -3906,6 +3929,10 @@ class JaxDecodeEngine(InferenceEngine):
             "kv_host_misses_total": host["misses"],
             "kv_host_evictions_total": host["evictions"],
             "kv_host_rejected_puts_total": host["rejected"],
+            # degradation evidence: swap failures that fell back to
+            # drop-and-reprefill instead of crashing the scheduler
+            "kv_offload_failures_total": self._n_offload_failures,
+            "kv_promote_failures_total": self._n_promote_failures,
             # exact-resume lookups served from host RAM over all lookups
             # that had ever been offloaded (fresh requests don't count)
             "kv_host_hit_rate": (

@@ -76,7 +76,8 @@ def _memory_analysis_dict(compiled) -> dict:
     not expose one (CPU returns a stub on some jaxlib versions)."""
     try:
         ma = compiled.memory_analysis()
-    except Exception:  # pragma: no cover - backend-dependent
+    except Exception as e:  # pragma: no cover - backend-dependent
+        logger.debug(f"memory_analysis unavailable: {e!r}")
         return {}
     if ma is None:
         return {}
@@ -234,8 +235,9 @@ class DcnWeightPush:
         """Best-effort: drop server-side staging for this push."""
         try:
             self.join()
-        except BaseException:  # noqa: BLE001 — aborting a failed push is fine
-            pass
+        except BaseException as e:  # noqa: BLE001 — aborting a failed
+            # push is fine; its failure was already raised to the caller
+            logger.debug(f"aborting failed push: join raised {e!r}")
         if self._abort_fn is not None and not self.committed:
             self._abort_fn()
 

@@ -310,6 +310,11 @@ class HostKVStore:
         fit. False (counted in `rejected_puts`) when the entry alone
         exceeds the budget — the caller falls back to dropping the
         blocks, exactly the pre-tier behavior."""
+        from areal_tpu.core import fault_injection
+
+        # D2H offload seam: an abort models the host copy failing — the
+        # engine catches it and degrades to drop-and-reprefill
+        fault_injection.fire("kv.swap_out", rid=entry.rid)
         entry.nbytes = entry.nb * self.block_nbytes
         if entry.nbytes > self.budget_bytes:
             # tombstoned: this rid's resume will look here and must count
@@ -355,6 +360,12 @@ class HostKVStore:
         caller reports the outcome: `note_hit` after a successful device
         upload, or `restore` if promotion failed (pool dry) so a later
         pass can retry."""
+        from areal_tpu.core import fault_injection
+
+        # swap-in seam: an abort models the host→device promotion dying
+        # before any state moved — the engine treats it as a miss and
+        # falls back to a full re-prefill
+        fault_injection.fire("kv.swap_in", rid=rid)
         e = self._entries.pop(rid, None)
         if e is None:
             return None

@@ -31,7 +31,7 @@ from areal_tpu.api.cli_args import MicroBatchSpec, PPOActorConfig
 from areal_tpu.api.engine_api import TrainEngine
 from areal_tpu.engine.jax_engine import JaxTrainEngine
 from areal_tpu.ops.gae import gae_padded_jit
-from areal_tpu.utils import stats_tracker
+from areal_tpu.utils import logging, stats_tracker
 from areal_tpu.utils.data import KLEstimator, Normalization
 from areal_tpu.utils.datapack import ffd_allocate
 from areal_tpu.utils.functional import (
@@ -42,6 +42,8 @@ from areal_tpu.utils.functional import (
     ppo_actor_loss_fn,
     reward_overlong_penalty,
 )
+
+logger = logging.getLogger("ppo_actor")
 
 
 class PPOActor:
@@ -323,8 +325,8 @@ class PPOActor:
                 str(self._samples_consumed),
                 replace=True,
             )
-        except Exception:  # noqa: BLE001 — metrics publishing is best-effort
-            pass
+        except Exception as e:  # noqa: BLE001 — publishing is best-effort
+            logger.debug(f"training-sample publish failed: {e!r}")
 
 
 def _split_minibatches(

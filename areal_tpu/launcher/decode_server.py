@@ -53,6 +53,7 @@ from areal_tpu.api.cli_args import (
     JaxDecodeConfig,
 )
 from areal_tpu.api.io_struct import ModelRequest, WeightUpdateMeta
+from areal_tpu.core import fault_injection
 from areal_tpu.utils import logging, names
 from areal_tpu.utils import name_resolve
 
@@ -108,6 +109,10 @@ class DecodeServer:
         self._weight_staging = WeightStaging()  # guarded-by: _ctl_lock
         self._staging_push_id: str | None = None  # guarded-by: _ctl_lock
         self._staging_t0: float | None = None  # guarded-by: _ctl_lock
+        # last frame arrival for the crash-mid-stage reaper: staging whose
+        # feed went silent for weight_staging_ttl_s is dropped (push-id
+        # epoch cleared) the next time a weight endpoint runs
+        self._staging_last_frame_t: float | None = None  # guarded-by: _ctl_lock
         self._last_commit_version: int | None = None  # guarded-by: _ctl_lock
         self._last_commit_push_id: str | None = None  # guarded-by: _ctl_lock
         # weight-sync observability (server side); merged into /metrics.
@@ -119,6 +124,7 @@ class DecodeServer:
             staging_secs=0.0,
             commit_pause_secs=0.0,
             aborted_pushes=0,
+            reaped_pushes=0,
         )
         # Idempotency table (exactly-once failover, ISSUE 8): xid ->
         # {"done": False, "fut": Future} while a submission is in flight,
@@ -175,6 +181,14 @@ class DecodeServer:
     async def _generate(self, request: web.Request) -> web.Response:
         body = await request.json()
         xid = body.get("xid")
+        # pre-effect seam: an abort here rejects the request before any
+        # engine state moves (clean client retry); a delay is the
+        # slow-replica shape the router's circuit breaker must absorb
+        await fault_injection.afire(
+            "server.generate",
+            rid=str(body.get("rid") or ""), xid=str(xid or ""),
+            addr=str(self.addr or ""),
+        )
         if xid is not None:
             ent = self._idem.get(xid)
             if ent is not None:
@@ -254,7 +268,8 @@ class DecodeServer:
     async def _pause(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — body is optional
+            logger.debug(f"/pause body ignored: {e!r}")
             body = {}
         # pause_generation blocks until the scheduler is idle — run it off
         # the event loop so in-flight /generate futures can resolve.
@@ -309,12 +324,41 @@ class DecodeServer:
     # engine — the scheduler thread keeps emitting tokens while bytes
     # accumulate); only the commit's install pays a pause, inside
     # engine.update_weights_from_tensor.
+    def _reap_stale_staging_locked(self) -> None:
+        """Crash-mid-stage recovery (caller holds _ctl_lock): a push whose
+        frame feed went silent for `weight_staging_ttl_s` is dead — its
+        learner crashed or lost connectivity mid-stage. Drop the staging
+        and clear the push-id epoch so the next push starts clean instead
+        of multi-GiB zombie staging lingering until an operator notices.
+        (The client independently aborts its own incomplete push on
+        reconnect; this reaper covers clients that never come back.)"""
+        ttl = self.config.weight_staging_ttl_s
+        if ttl <= 0 or self._staging_last_frame_t is None:
+            return
+        if time.monotonic() - self._staging_last_frame_t <= ttl:
+            return
+        if len(self._weight_staging._bufs) or len(self._weight_staging):
+            logger.warning(
+                f"reaping stale weight staging (push {self._staging_push_id}, "
+                f"silent > {ttl:.0f}s)"
+            )
+            self._sync_stats["reaped_pushes"] += 1
+        self._weight_staging.reset()
+        self._staging_push_id = None
+        self._staging_t0 = None
+        self._staging_last_frame_t = None
+
     async def _update_weights_from_tensor(
         self, request: web.Request
     ) -> web.Response:
         payload = await request.read()
         push_id = request.query.get("push_id")
+        await fault_injection.afire(
+            "server.weights.stage",
+            push_id=str(push_id or ""), addr=str(self.addr or ""),
+        )
         async with self._ctl_lock:
+            self._reap_stale_staging_locked()
             # Push ids are timestamp-ordered (remote_inf_engine): a NEWER id
             # invalidates whatever a previous (failed / abandoned) push left
             # behind; an OLDER id is a stale straggler frame whose retry
@@ -333,6 +377,7 @@ class DecodeServer:
             elif self._staging_t0 is None:
                 self._staging_t0 = time.monotonic()
             self._weight_staging.add_bucket(payload)
+            self._staging_last_frame_t = time.monotonic()
             self._sync_stats["wire_bytes"] += len(payload)
         return web.json_response(
             {"status": "ok", "staged": len(self._weight_staging)}
@@ -343,7 +388,12 @@ class DecodeServer:
         version = body.get("version")
         push_id = body.get("push_id")
         lora_scale = body.get("lora_scale")
+        await fault_injection.afire(
+            "server.weights.commit",
+            push_id=str(push_id or ""), addr=str(self.addr or ""),
+        )
         async with self._ctl_lock:
+            self._reap_stale_staging_locked()
             # Version fence: a commit may only land for the push whose
             # buckets are currently staged. A commit carrying a stale
             # push_id (its staging was superseded or aborted) must be
@@ -404,6 +454,7 @@ class DecodeServer:
                 self._weight_staging.reset()
                 self._staging_push_id = None
                 self._staging_t0 = None
+                self._staging_last_frame_t = None
                 status = 400 if isinstance(e, (ValueError, KeyError)) else 500
                 return web.json_response(
                     {"status": "error", "message": str(e)}, status=status
@@ -420,6 +471,7 @@ class DecodeServer:
             )
             self._last_commit_push_id = push_id
             self._staging_push_id = None
+            self._staging_last_frame_t = None
         return web.json_response(
             {"status": "ok", "version": self.engine.get_version()}
         )
@@ -430,7 +482,8 @@ class DecodeServer:
         next push's id happens to reset it."""
         try:
             body = await request.json()
-        except Exception:
+        except Exception as e:  # noqa: BLE001 — body is optional
+            logger.debug(f"/abort_weights body ignored: {e!r}")
             body = {}
         push_id = body.get("push_id")
         async with self._ctl_lock:
@@ -446,6 +499,7 @@ class DecodeServer:
             self._weight_staging.reset()
             self._staging_push_id = None
             self._staging_t0 = None
+            self._staging_last_frame_t = None
             if dropped:
                 self._sync_stats["aborted_pushes"] += 1
         return web.json_response({"status": "ok", "dropped": dropped})

@@ -124,7 +124,8 @@ class LocalLauncher:
             self._raise_on_failure()
             try:
                 addrs = name_resolve.get_subtree(key)
-            except Exception:
+            except Exception as e:  # noqa: BLE001 — not registered yet
+                logger.debug(f"server discovery pending: {e!r}")
                 addrs = []
             if len(addrs) >= count:
                 return list(addrs)
@@ -241,8 +242,8 @@ def run_experiment(
                 name_resolve.clear_subtree(
                     names.gen_servers(config.experiment_name, config.trial_name)
                 )
-            except Exception:  # noqa: BLE001 — nothing registered yet
-                pass
+            except Exception as e:  # noqa: BLE001 — nothing registered yet
+                logger.debug(f"stale-registration clear skipped: {e!r}")
             n_servers = (
                 alloc.gen.data_parallel_size
                 if alloc.type_ in (AllocationType.DECOUPLED_TRAIN,)

@@ -114,8 +114,8 @@ def clear_coordinator(experiment_name: str, trial_name: str, group: str) -> None
         name_resolve.delete(
             names.distributed_peer(experiment_name, trial_name, group, 0)
         )
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — nothing to clear
+        logger.debug(f"coordinator clear skipped: {e!r}")
 
 
 def trainer_env_hook(rank: int, world: int, coordinator: str) -> dict[str, str]:
@@ -172,8 +172,8 @@ class RayLauncher:
                 return pg
             try:
                 ray.util.remove_placement_group(pg)
-            except Exception:  # noqa: BLE001 — already gone
-                pass
+            except Exception as e:  # noqa: BLE001 — already gone
+                logger.debug(f"stale placement group removal: {e!r}")
             del self.placement_groups[name]
         pg = ray.util.placement_group(
             bundles=plan.bundles, strategy=plan.strategy
@@ -190,8 +190,8 @@ class RayLauncher:
             # retries (and other jobs) aren't starved by our own orphans
             try:
                 ray.util.remove_placement_group(pg)
-            except Exception:  # noqa: BLE001 — best-effort cleanup
-                pass
+            except Exception as e:  # noqa: BLE001 — best-effort cleanup
+                logger.debug(f"orphan placement group removal: {e!r}")
             raise
         self.placement_groups[name] = (plan_key, pg)
         return pg
@@ -287,6 +287,6 @@ class RayLauncher:
         for _, pg in self.placement_groups.values():
             try:
                 ray.util.remove_placement_group(pg)
-            except Exception:  # noqa: BLE001 — already gone
-                pass
+            except Exception as e:  # noqa: BLE001 — already gone
+                logger.debug(f"placement group removal on stop: {e!r}")
         self.placement_groups.clear()

@@ -65,6 +65,7 @@ from typing import Any
 from aiohttp import web
 
 from areal_tpu.api.cli_args import RouterConfig
+from areal_tpu.core import fault_injection
 from areal_tpu.utils import logging, name_resolve, names
 from areal_tpu.utils.http import arequest_with_retry
 from areal_tpu.utils.network import find_free_ports, gethostip
@@ -100,6 +101,7 @@ _GUARDED_BY = {
     "DecodeRouter._running": "_lock",
     "DecodeRouter._submitted": "_lock",
     "DecodeRouter._accepted": "_lock",
+    "DecodeRouter._breaker": "_lock",
 }
 
 # /metrics keys the admission controller snapshots per replica
@@ -197,6 +199,18 @@ class DecodeRouter:
             failovers_total=0,
             expired_qids_total=0,
             expired_prefixes_total=0,
+            breaker_trips_total=0,
+            breaker_probes_total=0,
+            breaker_closes_total=0,
+            deadline_sheds_total=0,
+        )
+        # per-replica circuit breaker (slow/erroring replicas are probed,
+        # not hammered): state in {"closed", "open", "half_open"}, `bad` =
+        # consecutive bad polls, `probes` = in-flight half-open probe
+        # requests. A trip never touches affinity state — entries survive
+        # and traffic returns through them once the breaker closes.
+        self._breaker: dict[str, dict[str, Any]] = defaultdict(
+            lambda: {"state": "closed", "bad": 0, "probes": 0}
         )
         self._versions: dict[str, int] = {}
         self._running = 0  # guarded-by: _lock
@@ -221,7 +235,8 @@ class DecodeRouter:
                 found = name_resolve.get_subtree(
                     names.gen_servers(self.experiment_name, self.trial_name)
                 )
-            except Exception:  # noqa: BLE001 — discovery is best-effort
+            except Exception as e:  # noqa: BLE001 — discovery best-effort
+                logger.debug(f"server discovery failed: {e!r}")
                 found = []
         return sorted(set(self._seed_servers) | set(found))
 
@@ -234,8 +249,13 @@ class DecodeRouter:
                     """health + metrics for one server, with the since-poll
                     estimate snapshotted at fetch time — requests routed
                     AFTER the snapshot are invisible to this measurement
-                    and must survive the later subtraction."""
+                    and must survive the later subtraction. The trailing
+                    element is the health RTT: the circuit breaker's
+                    slow-replica signal (a replica that answers, slowly,
+                    is degraded in a way a liveness bit cannot see)."""
+                    t0 = time.monotonic()
                     try:
+                        await fault_injection.afire("router.poll", server=s)
                         data = await arequest_with_retry(
                             s, "/health", method="GET", timeout=5,
                             max_retries=1,
@@ -243,7 +263,8 @@ class DecodeRouter:
                         version = int(data.get("version", 0))
                     except Exception:  # noqa: BLE001 — dead server drops out
                         logger.warning(f"server {s} failed health poll")
-                        return s, None, None, 0.0, None
+                        return s, None, None, 0.0, None, time.monotonic() - t0
+                    rtt = time.monotonic() - t0
                     est_snapshot = self._est_since_poll[s]
                     try:
                         m = await arequest_with_retry(
@@ -264,10 +285,13 @@ class DecodeRouter:
                             if "active_tokens" in m
                             else None
                         )
-                    except Exception:  # noqa: BLE001 — metrics optional
+                    except Exception as e:  # noqa: BLE001 — optional;
+                        # the _metrics_fail counter escalates persistent
+                        # failures to a warning at _METRICS_FAIL_LIMIT
+                        logger.debug(f"metrics probe of {s} failed: {e!r}")
                         load = None
                         pressure = None
-                    return s, version, load, est_snapshot, pressure
+                    return s, version, load, est_snapshot, pressure, rtt
 
                 # fan out: one hung server must not stale the whole fleet's
                 # measurements for its full timeout
@@ -285,10 +309,25 @@ class DecodeRouter:
         versions, measured loads, pressure snapshots, and the
         failed-health / failed-metrics staleness counters (split out of
         _poll_loop so the staleness arithmetic unit-tests directly)."""
-        versions = {s: v for s, v, _, _, _ in probes if v is not None}
+        versions = {p[0]: p[1] for p in probes if p[1] is not None}
         self.servers = [s for s in servers if s in versions]
         self._versions = versions
-        for s, v, load, est_snapshot, pressure in probes:
+        for p in probes:
+            s, v, load, est_snapshot, pressure = p[:5]
+            # probes from older callers (unit tests) may omit the RTT
+            rtt = p[5] if len(p) > 5 else None
+            slow = (
+                self.config.breaker_slow_s > 0
+                and rtt is not None
+                and rtt > self.config.breaker_slow_s
+            )
+            # erroring metrics count as a degradation signal only while a
+            # measured base exists — servers that never export /metrics
+            # must not trip the breaker by construction
+            metrics_err = (
+                v is not None and load is None and s in self._measured_tokens
+            )
+            self._breaker_update_locked(s, bad=(v is None) or slow or metrics_err)
             if v is None:
                 self._health_fail[s] += 1
                 if self._health_fail[s] == self.config.dead_after_failures:
@@ -313,6 +352,63 @@ class DecodeRouter:
             self._est_since_poll[s] = max(
                 0.0, self._est_since_poll[s] - est_snapshot
             )
+
+    # -- circuit breaker ------------------------------------------------
+    def _breaker_update_locked(self, s: str, bad: bool) -> None:
+        """Fold one poll outcome into the replica's breaker: trip after
+        `breaker_trip_after` consecutive bad polls, go HALF-OPEN (probe
+        traffic only) on the first healthy poll after a trip, relapse to
+        open if a probe-phase poll goes bad again. CLOSING happens on
+        probe-request completion (_release_qid), not here — re-entry is
+        earned by serving a real request, not by answering a ping."""
+        if not self.config.breaker_enabled:
+            return
+        b = self._breaker[s]
+        if bad:
+            b["bad"] += 1
+            if (
+                b["state"] == "closed"
+                and b["bad"] >= self.config.breaker_trip_after
+            ):
+                b["state"] = "open"
+                b["probes"] = 0
+                self._counters["breaker_trips_total"] += 1
+                logger.warning(
+                    f"circuit breaker OPEN for {s} after {b['bad']} bad polls"
+                )
+            elif b["state"] == "half_open":
+                b["state"] = "open"
+                b["probes"] = 0
+        else:
+            b["bad"] = 0
+            if b["state"] == "open":
+                b["state"] = "half_open"
+                b["probes"] = 0
+                logger.info(f"circuit breaker HALF-OPEN for {s}: probing")
+
+    def _breaker_admits(self, s: str) -> bool:
+        """May a NEW request be routed to `s` right now? Open: no.
+        Half-open: only while probe slots remain. Affinity entries for a
+        tripped replica are preserved — they resume steering traffic the
+        moment the breaker closes."""
+        if not self.config.breaker_enabled:
+            return True
+        b = self._breaker[s]
+        if b["state"] == "open":
+            return False
+        if b["state"] == "half_open":
+            return b["probes"] < max(1, self.config.breaker_probe_requests)
+        return True
+
+    def _breaker_charge_locked(self, addr: str) -> None:
+        """Account a scheduled request against a half-open breaker's
+        probe budget."""
+        if not self.config.breaker_enabled:
+            return
+        b = self._breaker[addr]
+        if b["state"] == "half_open":
+            b["probes"] += 1
+            self._counters["breaker_probes_total"] += 1
 
     def _failover_locked(self, dead: str) -> None:
         """A replica crossed dead_after_failures: requeue its in-flight
@@ -358,6 +454,8 @@ class DecodeRouter:
         # stale measurements must not keep the corpse looking admissible
         self._measured_tokens.pop(dead, None)
         self._pressure.pop(dead, None)
+        # death supersedes the breaker: a resurrected replica starts clean
+        self._breaker.pop(dead, None)
         if moved or stale:
             logger.warning(
                 f"failover: {dead} declared dead; requeued {moved} qids, "
@@ -408,6 +506,7 @@ class DecodeRouter:
             | set(self._health_fail)
             | set(self._measured_tokens)
             | set(self._pressure)
+            | set(self._breaker)
         )
         for s in tracked - keep:
             for d in (
@@ -419,6 +518,7 @@ class DecodeRouter:
                 self._measured_tokens,
                 self._pressure,
                 self._versions,
+                self._breaker,
             ):
                 d.pop(s, None)
 
@@ -435,7 +535,8 @@ class DecodeRouter:
                     names.training_samples(self.experiment_name, self.trial_name)
                 )
             )
-        except Exception:  # noqa: BLE001 — counter not published yet
+        except Exception as e:  # noqa: BLE001 — counter not published yet
+            logger.debug(f"training-sample counter unavailable: {e!r}")
             return 0
 
     def _is_staled(self) -> bool:
@@ -480,6 +581,8 @@ class DecodeRouter:
         return cap - frag - used - need
 
     def _admissible(self, s: str, need: float) -> bool:
+        if not self._breaker_admits(s):
+            return False
         limit = self.config.max_inflight_per_server
         if limit:
             p = self._pressure.get(s)
@@ -518,11 +621,14 @@ class DecodeRouter:
             prev_url
             and prev_url in self.servers
             and prev_version == self.fleet_version
+            and self._breaker_admits(prev_url)
         ):
             return prev_url, 0.0  # resume with live KV on the same weights
         if qid and qid in self._qid_to_server:
             cached = self._qid_to_server[qid]
-            if cached in self.servers:
+            # a tripped breaker diverts even affine traffic — but the
+            # mapping itself survives, so the qid returns home on close
+            if cached in self.servers and self._breaker_admits(cached):
                 return cached, 0.0
         need = self._request_cost(req)
         candidates = [s for s in self.servers if self._admissible(s, need)]
@@ -593,6 +699,7 @@ class DecodeRouter:
         qid = req.get("qid")
         cost = max(self._request_cost(req) - discount, 0.0)
         self._counters["schedules_total"] += 1
+        self._breaker_charge_locked(addr)
         self._request_counts[addr] += 1
         self._token_usage[addr] += cost
         self._est_since_poll[addr] += cost
@@ -629,7 +736,21 @@ class DecodeRouter:
     # -- handlers -------------------------------------------------------
     async def _schedule_request(self, request: web.Request) -> web.Response:
         req = await request.json()
+        await fault_injection.afire(
+            "router.schedule", qid=str(req.get("qid") or "")
+        )
         loop = asyncio.get_running_loop()
+        # the client ships its remaining deadline budget: a request must
+        # not sit in the admission queue longer than its owner will wait
+        # for the answer (holding it past that only wastes a queue slot
+        # and schedules work nobody collects)
+        try:
+            deadline_s = float(req.get("deadline_s") or 0.0)
+        except (TypeError, ValueError):
+            deadline_s = 0.0
+        hold = self.config.queue_timeout_s
+        if deadline_s > 0.0:
+            hold = min(hold, deadline_s)
         async with self._lock:
             if req.get("requeue") and req.get("qid"):
                 # a router-aware client retry re-schedules the SAME logical
@@ -640,20 +761,19 @@ class DecodeRouter:
             out = self._try_schedule_locked(req)
             if out is not None:
                 return web.json_response(out)
+            if hold <= 0.0:
+                # budget already spent: shed immediately, don't queue
+                self._counters["deadline_sheds_total"] += 1
+                return self._shed_response("request deadline exhausted")
             if len(self._waitq) >= self.config.queue_max:
                 self._counters["queue_sheds_total"] += 1
                 return self._shed_response("admission queue full")
             now = time.monotonic()
-            w = _Waiter(
-                loop.create_future(), req, now,
-                now + self.config.queue_timeout_s,
-            )
+            w = _Waiter(loop.create_future(), req, now, now + hold)
             self._waitq.append(w)
             self._counters["queue_enqueues_total"] += 1
         try:
-            out = await asyncio.wait_for(
-                w.fut, timeout=self.config.queue_timeout_s
-            )
+            out = await asyncio.wait_for(w.fut, timeout=hold)
         except asyncio.TimeoutError:
             async with self._lock:
                 try:
@@ -661,6 +781,8 @@ class DecodeRouter:
                 except ValueError:
                     pass
                 self._counters["queue_timeouts_total"] += 1
+                if hold < self.config.queue_timeout_s:
+                    self._counters["deadline_sheds_total"] += 1
             return self._shed_response("admission deadline exceeded")
         return web.json_response(out)
 
@@ -692,6 +814,17 @@ class DecodeRouter:
         if not qid or qid not in self._qid_to_server:
             return
         addr = self._qid_to_server[qid]
+        # a completed request against a half-open replica is the probe
+        # succeeding: the breaker closes and full traffic (plus the
+        # replica's surviving affinity entries) returns
+        if self.config.breaker_enabled:
+            b = self._breaker[addr]
+            if b["state"] == "half_open" and b["probes"] > 0:
+                b["probes"] -= 1
+                b["state"] = "closed"
+                b["bad"] = 0
+                self._counters["breaker_closes_total"] += 1
+                logger.info(f"circuit breaker CLOSED for {addr} (probe ok)")
         pending = self._qid_pending.get(qid, 1)
         unit_cost = self._qid_cost.get(qid, 0.0) / max(1, pending)
         self._request_counts[addr] = max(0, self._request_counts[addr] - 1)
@@ -776,6 +909,9 @@ class DecodeRouter:
                     },
                     "pressure": {
                         s: dict(p) for s, p in self._pressure.items()
+                    },
+                    "breaker": {
+                        s: dict(b) for s, b in self._breaker.items()
                     },
                 }
             )

@@ -4,24 +4,50 @@ Parity target: areal/utils/http.py (arequest_with_retry over aiohttp with
 per-endpoint retries and pooled connectors). The decode-server protocol is
 JSON-over-HTTP exactly like the reference's SGLang/vLLM control plane; only
 the payload schema differs (see areal_tpu/launcher/decode_server.py).
+
+Robustness semantics (ISSUE 9):
+- Error responses carry their parsed JSON body on `HttpRequestError.body`
+  so callers read structured fields (`retry_after`, `reason`) instead of
+  regexing a stringified exception.
+- Retry backoff is jittered (uniform [1-j, 1+j] scale) so synchronized
+  clients don't retry in lockstep.
+- A torn/truncated response body (JSON parse failure on a 2xx) is a
+  RETRYABLE transport error, not a crash — the server's reply was lost in
+  transit; the retry (same xid) is deduplicated server-side.
+- Fault-injection seams: `client.http.send` (before the request leaves —
+  an abort is a clean pre-effect loss), `client.http.recv` (after a 2xx
+  arrived — an abort is the error-after-effect shape), `client.http.body`
+  (torn payloads).
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
+import random
 import weakref
 from typing import Any
 
 import aiohttp
+
+from areal_tpu.core import fault_injection
 
 DEFAULT_RETRIES = 3
 DEFAULT_REQUEST_TIMEOUT = 3600.0
 
 
 class HttpRequestError(Exception):
-    def __init__(self, message: str, status: int | None = None):
+    def __init__(
+        self,
+        message: str,
+        status: int | None = None,
+        body: dict[str, Any] | None = None,
+    ):
         super().__init__(message)
         self.status = status
+        # parsed JSON error payload when the server sent one (structured
+        # fields like retry_after live here, not in str(self))
+        self.body = body or {}
 
 
 # One pooled ClientSession per event loop. aiohttp sessions are bound to the
@@ -57,6 +83,28 @@ async def close_current_session() -> None:
         await sess.close()
 
 
+def _parse_json_body(text: str) -> dict[str, Any]:
+    try:
+        out = json.loads(text)
+        return out if isinstance(out, dict) else {}
+    except (ValueError, TypeError):
+        return {}
+
+
+def backoff_delays(
+    base: float, retries: int, jitter: float = 0.25, cap: float = 60.0
+):
+    """Jittered exponential backoff generator: base·2^k scaled by
+    uniform[1-jitter, 1+jitter], capped. Shared by the transport retry
+    loop and the client's 429 honoring so every retry path in the stack
+    desynchronizes the same way."""
+    for attempt in range(retries):
+        d = min(base * (2**attempt), cap)
+        if jitter > 0.0:
+            d *= 1.0 + random.uniform(-jitter, jitter)
+        yield max(d, 0.0)
+
+
 async def arequest_with_retry(
     addr: str,
     endpoint: str,
@@ -68,12 +116,21 @@ async def arequest_with_retry(
     data: bytes | None = None,
 ) -> dict[str, Any]:
     """POST/GET `http://{addr}{endpoint}`, return parsed JSON; retry on
-    connection errors and 5xx. 4xx raise immediately. `data` sends a raw
-    binary body instead of JSON (weight-transfer buckets)."""
+    connection errors, 5xx, and torn (unparseable 2xx) responses. 4xx
+    raise immediately with the parsed error body attached. `data` sends a
+    raw binary body instead of JSON (weight-transfer buckets)."""
     last_exc: Exception | None = None
     url = f"http://{addr}{endpoint}"
+    delays = backoff_delays(retry_delay, max_retries)
+    inj = fault_injection.get()
     for attempt in range(max_retries):
         try:
+            if inj is not None:
+                await inj.afire(
+                    "client.http.send",
+                    addr=addr, endpoint=endpoint, method=method,
+                    attempt=attempt,
+                )
             session = _get_session()
             async with session.request(
                 method,
@@ -82,13 +139,41 @@ async def arequest_with_retry(
                 data=data,
                 timeout=aiohttp.ClientTimeout(total=timeout, sock_connect=30),
             ) as resp:
+                text = await resp.text()
                 if resp.status >= 400:
                     raise HttpRequestError(
-                        f"{url} -> {resp.status}: {await resp.text()}",
+                        f"{url} -> {resp.status}: {text}",
                         status=resp.status,
+                        body=_parse_json_body(text),
                     )
-                return await resp.json()
-        except (aiohttp.ClientError, asyncio.TimeoutError, HttpRequestError) as e:
+                if inj is not None:
+                    # post-effect seam: the server processed the request
+                    # and responded — a fault here loses only the reply
+                    await inj.afire(
+                        "client.http.recv",
+                        addr=addr, endpoint=endpoint, method=method,
+                        attempt=attempt,
+                    )
+                    text = inj.tear(
+                        "client.http.body", text,
+                        addr=addr, endpoint=endpoint,
+                    )
+                try:
+                    return json.loads(text)
+                except ValueError as e:
+                    # torn response: the effect may have landed but the
+                    # reply is unusable — retryable, idempotency dedups
+                    raise HttpRequestError(
+                        f"{url} -> torn response body "
+                        f"({len(text)} bytes): {e}",
+                        status=None,
+                    ) from e
+        except (
+            aiohttp.ClientError,
+            asyncio.TimeoutError,
+            HttpRequestError,
+            fault_injection.InjectedFault,
+        ) as e:
             if (
                 isinstance(e, HttpRequestError)
                 and e.status is not None
@@ -97,7 +182,7 @@ async def arequest_with_retry(
                 raise
             last_exc = e
             if attempt + 1 < max_retries:
-                await asyncio.sleep(retry_delay * (2**attempt))
+                await asyncio.sleep(next(delays))
     raise HttpRequestError(
         f"request to {url} failed after {max_retries} retries"
     ) from last_exc

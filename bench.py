@@ -808,8 +808,8 @@ def bench_fleet(model, n_replicas, n_groups, group_size, prompt_len,
                 asyncio.run_coroutine_threadsafe(
                     self.server.stop(), self._loop
                 ).result(30)
-            except Exception:  # noqa: BLE001 — already killed
-                pass
+            except Exception as e:  # noqa: BLE001 — already killed
+                print(f"[fleet] replica stop: {e!r}", file=sys.stderr)
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=10)
             if destroy:
@@ -1101,6 +1101,325 @@ def bench_fleet(model, n_replicas, n_groups, group_size, prompt_len,
     )
 
 
+def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
+                new_tokens, max_running, chunk=None, turns=2, seed=123):
+    """Chaos bench (ISSUE 9 tentpole proof): replay the fleet session-reuse
+    trace under a seeded fault schedule and assert the system DEGRADES
+    instead of corrupting data.
+
+    Two runs over the identical trace (greedy sampling, so every stream is
+    a pure function of its prompt — independent of replica placement,
+    batch composition, and retry interleaving):
+
+      1. ORACLE — fresh replicas, no injector.
+      2. CHAOS  — fresh replicas, `core.fault_injection` armed with a
+         seeded plan covering four distinct fault modes on the request
+         path: pre-effect aborts (client.http.send — the server never saw
+         the request), ERROR-AFTER-EFFECT (client.http.recv — the
+         generation landed, the response is lost; only the server's xid
+         idempotency table keeps the same-xid transport retry from
+         double-generating), torn response bodies (client.http.body — a
+         2xx whose JSON is truncated mid-flight), fixed+jittered delays
+         (server.generate — the SLOW-replica shape, a replica that answers
+         late rather than dying), plus a router.schedule abort (the
+         router's own handler failing over to the client's transport
+         retry).
+
+    Exactly-once is asserted three ways: every (group, member, turn)
+    stream completes exactly once client-side (0 lost), the summed
+    engine-side admissions across replicas equal the logical request count
+    (0 duplicated generations — replay served the retries, not the
+    engine), and every accepted token stream is BIT-IDENTICAL to the
+    unfaulted oracle. Reported: distinct fault modes fired, per-mode
+    counters, idempotency replays, and recovery latency (worst per-request
+    completion-time inflation vs the oracle — what the injected faults
+    cost the requests they hit)."""
+    import asyncio
+    import threading
+    import uuid as _uuid
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+        RouterConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core import fault_injection
+    from areal_tpu.core.fault_injection import FaultPlan, FaultPoint
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.launcher.decode_server import DecodeServer
+    from areal_tpu.launcher.router import DecodeRouter
+    from areal_tpu.utils import name_resolve
+    from areal_tpu.utils.http import arequest_with_retry, close_current_session
+    from areal_tpu.models.qwen2 import init_params
+
+    name_resolve.reconfigure(name_resolve.NameResolveConfig(type="memory"))
+    params = init_params(model, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(seed)
+    plens = [int(prompt_len * f) for f in (1.0, 0.75, 1.25, 0.5)]
+    ctx = int(prompt_len * 1.25) + turns * (new_tokens + 8) + 128
+    # greedy: the oracle contract — streams depend only on the prompt
+    gcfg = GenerationHyperparameters(max_new_tokens=new_tokens, greedy=True)
+    group_prompts = [
+        rng.randint(1, model.vocab_size, (plens[g % len(plens)],)).tolist()
+        for g in range(n_groups)
+    ]
+    n_logical = n_groups * group_size * turns
+
+    def _http_get(addr, ep):
+        async def _g():
+            try:
+                return await arequest_with_retry(
+                    addr, ep, method="GET", max_retries=1, timeout=10
+                )
+            finally:
+                await close_current_session()
+
+        return asyncio.run(_g())
+
+    class _Replica:
+        def __init__(self, warm_plen):
+            dcfg = JaxDecodeConfig(
+                context_length=ctx,
+                max_running_requests=max_running,
+                new_tokens_per_chunk=chunk or min(128, new_tokens),
+                dtype=model.dtype,
+                kv_cache_dtype=model.dtype,
+            )
+            self.engine = JaxDecodeEngine(dcfg, InferenceEngineConfig())
+            self.engine.set_model(params, model)
+            self.engine.initialize()
+            self.engine.prewarm(prompt_len=warm_plen, gconfig=gcfg)
+            self.server = DecodeServer(
+                JaxDecodeConfig(), engine=self.engine, shutdown_grace=0.5
+            )
+            self.addr = None
+            self._loop = None
+            self._ready = threading.Event()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+            assert self._ready.wait(60), "chaos replica failed to start"
+
+        def _run(self):
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _start():
+                self.addr = await self.server.start(host="127.0.0.1", port=0)
+                self._ready.set()
+
+            self._loop.run_until_complete(_start())
+            self._loop.run_forever()
+
+        def admissions(self):
+            m = self.engine.get_metrics()
+            return (
+                m["prefills_total"]
+                + m["prefix_forks_total"]
+                + m["prefix_inplace_total"]
+                + m["suffix_prefills_total"]
+            )
+
+        def stop(self):
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self.server.stop(), self._loop
+                ).result(30)
+            except Exception as e:  # noqa: BLE001 — already down
+                print(f"[chaos] replica stop: {e!r}", file=sys.stderr)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+            self.engine.destroy()
+
+    class _RouterThread:
+        def __init__(self, servers, exp, trial):
+            self.router = DecodeRouter(
+                exp,
+                trial,
+                servers,
+                config=RouterConfig(
+                    schedule_policy="prefix_affinity",
+                    health_poll_interval=0.25,
+                    dead_after_failures=4,
+                    queue_timeout_s=60.0,
+                ),
+            )
+            self.addr = None
+            self._loop = None
+            self._ready = threading.Event()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+            assert self._ready.wait(30), "chaos router failed to start"
+
+        def _run(self):
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _start():
+                self.addr = await self.router.start("127.0.0.1", 0)
+                self._ready.set()
+
+            self._loop.run_until_complete(_start())
+            self._loop.run_forever()
+
+        def stop(self):
+            asyncio.run_coroutine_threadsafe(
+                self.router.stop(), self._loop
+            ).result(30)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+
+    def run_trace(label, plan):
+        exp, trial = "benchchaos", f"{label}-{_uuid.uuid4().hex[:6]}"
+        replicas = [_Replica(min(plens)) for _ in range(n_replicas)]
+        addrs = [r.addr for r in replicas]
+        rt = _RouterThread(addrs, exp, trial)
+        client = RemoteInfEngine(
+            InferenceEngineConfig(
+                experiment_name=exp,
+                trial_name=trial,
+                request_timeout=300,
+                request_retries=3,
+                fleet_failover_retries=2,
+            )
+        )
+        client.addresses = list(addrs)
+        streams: dict = {}
+        lat: dict = {}
+        out: dict = {}
+        # arm AFTER replica prewarm/startup so the schedule perturbs the
+        # trace, not the fixture setup
+        fault_injection.configure(plan)
+        try:
+            time.sleep(0.6)  # one poll round
+            adm0 = sum(r.admissions() for r in replicas)
+
+            async def member(g, m):
+                rid = f"c{g}-m{m}"
+                ids = list(group_prompts[g])
+                for t in range(turns):
+                    t0 = time.perf_counter()
+                    r = await client.agenerate(
+                        ModelRequest(rid=rid, input_ids=ids, gconfig=gcfg)
+                    )
+                    key = (g, m, t)
+                    assert key not in streams, f"duplicate completion {key}"
+                    streams[key] = tuple(r.output_tokens)
+                    lat[key] = time.perf_counter() - t0
+                    ids = ids + list(r.output_tokens) + [7, 11, 13, 17]
+
+            async def group(g):
+                await asyncio.sleep((g % 3) * 0.1)
+                await asyncio.gather(
+                    *[member(g, m) for m in range(group_size)]
+                )
+
+            async def drive():
+                try:
+                    await asyncio.gather(*[group(g) for g in range(n_groups)])
+                finally:
+                    await close_current_session()
+
+            t0 = time.perf_counter()
+            asyncio.run(drive())
+            out["wall_s"] = time.perf_counter() - t0
+            out["streams"] = streams
+            out["lat"] = lat
+            out["admissions"] = sum(r.admissions() for r in replicas) - adm0
+            out["idem_hits"] = sum(
+                _http_get(r.addr, "/metrics")["idem_hits_total"]
+                for r in replicas
+            )
+            out["router_metrics"] = _http_get(rt.addr, "/metrics")
+            out["fault_counters"] = fault_injection.snapshot()
+        finally:
+            fault_injection.deactivate()
+            rt.stop()
+            for r in replicas:
+                r.stop()
+        return out
+
+    # seeded schedule: >= 4 distinct modes on the request path. Explicit
+    # hit indices (`at`) guarantee each mode actually fires on any trace
+    # with a handful of requests; `times` bounds repeated firing.
+    plan = FaultPlan(
+        seed=seed,
+        points=[
+            FaultPoint(site="client.http.send", mode="abort",
+                       at=(1, 6), times=2,
+                       match={"endpoint": "/generate"}),
+            FaultPoint(site="client.http.recv", mode="error_after_effect",
+                       at=(0, 4), times=2,
+                       match={"endpoint": "/generate"}),
+            FaultPoint(site="client.http.body", mode="torn",
+                       at=(2,), times=1,
+                       match={"endpoint": "/generate"}),
+            FaultPoint(site="server.generate", mode="delay",
+                       at=(3, 8), times=2, delay_s=0.2, jitter_s=0.1),
+            FaultPoint(site="router.schedule", mode="abort",
+                       at=(2,), times=1),
+        ],
+    )
+
+    oracle = run_trace("oracle", None)
+    chaos = run_trace("chaos", plan)
+
+    assert len(oracle["streams"]) == n_logical, "oracle lost requests"
+    lost = n_logical - len(chaos["streams"])
+    mismatched = sum(
+        1
+        for k, v in oracle["streams"].items()
+        if chaos["streams"].get(k) != v
+    )
+    dup_generations = chaos["admissions"] - n_logical
+    counters = chaos["fault_counters"]
+    modes_fired = {k.split("|")[1] for k in counters}
+    faults_total = sum(counters.values())
+    # worst per-request completion-time inflation vs the unfaulted oracle:
+    # what the injected faults cost the requests they hit (retries, replay
+    # round-trips, injected delay)
+    recovery_max_s = max(
+        chaos["lat"][k] - oracle["lat"][k] for k in oracle["lat"]
+    )
+    assert lost == 0, f"chaos lost {lost} requests"
+    assert mismatched == 0, (
+        f"{mismatched} streams diverged from the unfaulted oracle"
+    )
+    assert dup_generations == 0, (
+        f"{dup_generations} duplicate engine-side generations"
+    )
+    assert {"abort", "error_after_effect", "delay", "torn"} <= modes_fired, (
+        f"schedule only exercised {sorted(modes_fired)}"
+    )
+    assert chaos["idem_hits"] >= 1, (
+        "error-after-effect never exercised the idempotency replay"
+    )
+    rm = chaos["router_metrics"]
+    return dict(
+        chaos_replicas=n_replicas,
+        chaos_requests=n_logical,
+        chaos_lost=lost,
+        chaos_dup_generations=dup_generations,
+        chaos_streams_bitidentical=int(mismatched == 0),
+        chaos_exactly_once=float(
+            lost == 0 and dup_generations == 0 and mismatched == 0
+        ),
+        chaos_fault_modes_fired=len(modes_fired),
+        chaos_faults_injected=faults_total,
+        chaos_idem_replays=chaos["idem_hits"],
+        chaos_recovery_max_s=recovery_max_s,
+        chaos_oracle_wall_s=oracle["wall_s"],
+        chaos_wall_s=chaos["wall_s"],
+        chaos_router_requeues=rm.get("requeues_total", 0),
+        chaos_router_queue_sheds=rm.get("queue_sheds_total", 0),
+        chaos_fault_counters={k: int(v) for k, v in sorted(counters.items())},
+    )
+
+
 def bench_weightsync(model, n_pushes, chunk_mb, prompt_len, new_tokens):
     """Staged weight-sync bench: transfer time vs commit-pause time.
 
@@ -1185,7 +1504,8 @@ def bench_weightsync(model, n_pushes, chunk_mb, prompt_len, new_tokens):
                     ModelRequest(input_ids=prompts[k % len(prompts)], gconfig=g),
                     timeout=600,
                 )
-            except Exception:  # noqa: BLE001 — engine shutting down
+            except Exception as e:  # noqa: BLE001 — engine shutting down
+                print(f"[weightsync] gen loop exit: {e!r}", file=sys.stderr)
                 return
             k += 4
         return
@@ -1662,6 +1982,7 @@ BENCH_MODE_FNS = {
     "specdecode": bench_spec_compare,
     "kvoffload": bench_kvoffload,
     "fleet": bench_fleet,
+    "chaos": bench_chaos,
 }
 BENCH_MODES = ("all", *BENCH_MODE_FNS)
 # headline metric per dev mode (modes that skip the trainer MFU line)
@@ -1675,6 +1996,7 @@ MODE_HEADLINES = {
     "specdecode": ("spec_over_off_speedup", "x"),
     "kvoffload": ("kvoffload_resume_ttft_speedup", "x"),
     "fleet": ("fleet_affinity_ttft_p50_speedup", "x"),
+    "chaos": ("chaos_exactly_once", "bool"),
 }
 
 
@@ -2019,6 +2341,18 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("chaos"):
+            decode.update(
+                _retry_transport(
+                    lambda: bench_chaos(
+                        model, n_replicas=2, n_groups=4, group_size=4,
+                        prompt_len=256, new_tokens=64, max_running=16,
+                    ),
+                    what="bench_chaos",
+                    attempts=2,
+                    base_delay=15.0,
+                )
+            )
         if want("grpo"):
             # GRPO co-locates trainer (fwd+bwd+opt) and decode engine on
             # one chip: run the actor with remat on to leave HBM headroom
@@ -2162,6 +2496,17 @@ def main() -> None:
                 bench_fleet(
                     model, n_replicas=2, n_groups=4, group_size=4,
                     prompt_len=128, new_tokens=16, max_running=4, chunk=8,
+                )
+            )
+        if want("chaos"):
+            # greedy streams + a seeded 5-point schedule over 2 replicas;
+            # prompts past the 64-token affinity block so the chaos trace
+            # exercises the same fork/suffix reuse paths the fleet smoke
+            # does while faults land mid-stream
+            decode.update(
+                bench_chaos(
+                    model, n_replicas=2, n_groups=3, group_size=2,
+                    prompt_len=96, new_tokens=16, max_running=4, chunk=8,
                 )
             )
         if want("grpo"):

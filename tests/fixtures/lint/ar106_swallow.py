@@ -1,0 +1,72 @@
+"""Seeded-bad fixture: AR106 — broad except that swallows silently.
+
+Four swallow shapes (bare except, `except Exception: pass`, a handler
+whose body does unrelated work, a tuple catch containing Exception) and
+the four escapes that must NOT fire: re-raise, a logging call, preserving
+the exception object, and a NARROW catch.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def swallow_pass(x):
+    try:
+        return 1 / x
+    except Exception:  # AR106: silent
+        pass
+
+
+def swallow_bare(x):
+    try:
+        return int(x)
+    except:  # noqa: E722 — AR106: bare and silent
+        return 0
+
+
+def swallow_busy(items):
+    out = []
+    try:
+        out.append(items[0])
+    except Exception:  # AR106: does work, but the failure vanishes
+        out.clear()
+    return out
+
+
+def swallow_tuple(x):
+    try:
+        return float(x)
+    except (ValueError, Exception):  # AR106: tuple containing Exception
+        return 0.0
+
+
+def ok_reraise(x):
+    try:
+        return 1 / x
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+
+
+def ok_logged(x):
+    try:
+        return 1 / x
+    except Exception as e:
+        logger.warning(f"divide failed: {e!r}")
+        return 0
+
+
+def ok_preserved(x):
+    last_exc = None
+    try:
+        return 1 / x
+    except Exception as e:
+        last_exc = e
+    return last_exc
+
+
+def ok_narrow(x):
+    try:
+        return int(x)
+    except ValueError:  # narrow: the caller chose what to absorb
+        return 0

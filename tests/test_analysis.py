@@ -103,6 +103,60 @@ def test_ar204_retrace_hazards():
     assert keys == {"bad_loop.step.arg1", "bad_static.bucketed.arg1"}
 
 
+def test_ar106_swallowed_exceptions():
+    fs = _run_fixture("ar106_swallow.py")
+    assert _codes(fs) == {"AR106"}
+    keys = {f.key for f in fs}
+    # the four swallow shapes fire; re-raise / log / preserve / narrow
+    # escapes must not
+    assert keys == {
+        "swallow_pass.except#0",
+        "swallow_bare.except#0",
+        "swallow_busy.except#0",
+        "swallow_tuple.except#0",
+    }
+
+
+def test_ar106_scoped_to_fault_bearing_packages(tmp_path):
+    """AR106 runs only over areal_tpu/{core,launcher,engine}/ — a swallow
+    in, say, utils/ (the retry loop's home) is out of scope; a fixture
+    outside the areal_tpu tree is always checked."""
+    src = textwrap.dedent(
+        """
+        def f(x):
+            try:
+                return 1 / x
+            except Exception:
+                pass
+        """
+    )
+    tree = tmp_path / "areal_tpu"
+    for pkg, expect in [("core", True), ("utils", False), ("models", False)]:
+        d = tree / pkg
+        d.mkdir(parents=True)
+        mod = d / "mod.py"
+        mod.write_text(src)
+        fs = [f for f in analyze_paths([str(mod)]) if f.rule == "AR106"]
+        assert bool(fs) == expect, (pkg, fs)
+
+
+def test_ar106_pragma_suppresses():
+    import tempfile, os
+
+    src = (
+        "def f(x):\n"
+        "    try:\n"
+        "        return 1 / x\n"
+        "    except Exception:  # areal-lint: disable=AR106\n"
+        "        pass\n"
+    )
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "frag.py")
+        with open(p, "w") as fh:
+            fh.write(src)
+        assert not [f for f in analyze_paths([p]) if f.rule == "AR106"]
+
+
 # -- pragma + baseline semantics --------------------------------------------
 
 

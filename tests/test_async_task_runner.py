@@ -84,3 +84,48 @@ def test_inflight_tracking(runner):
     assert runner.inflight == 3
     runner.wait(3, timeout=5)
     assert runner.inflight == 0
+
+
+# -- failure accounting (ISSUE 9 satellite) ---------------------------------
+
+
+def test_failed_task_releases_inflight_exactly_once(runner):
+    """A raising task must emit exactly ONE TaskResult and drop the
+    inflight counter exactly once — a leak here wedged StalenessManager
+    capacity (the submitted slot stayed `running` forever)."""
+
+    async def boom():
+        raise ValueError("episode died")
+
+    for _ in range(4):
+        runner.submit(boom)
+    results = runner.wait(4, timeout=5)
+    assert len(results) == 4
+    assert all(isinstance(r.exception, ValueError) for r in results)
+    assert runner.inflight == 0
+    assert runner.poll_results() == []  # no extra results emitted
+
+
+def test_cancelled_task_emits_result_and_releases_slot():
+    """Cancellation (pause-drain / shutdown) must still surface a
+    TaskResult carrying CancelledError so the executor releases the
+    capacity slot — the old path re-raised without emitting, leaking both
+    the inflight count and the StalenessManager running slot."""
+    r = AsyncTaskRunner(queue_size=8, name="cancel-test")
+    r.start()
+    started = []
+
+    async def hang():
+        started.append(1)
+        await asyncio.sleep(60)
+
+    r.submit(hang)
+    deadline = time.monotonic() + 5
+    while not started and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert started, "task never started"
+    r.destroy()  # cancels the pending task on shutdown
+    results = r.poll_results()
+    assert len(results) == 1
+    assert isinstance(results[0].exception, asyncio.CancelledError)
+    assert r.inflight == 0

@@ -61,6 +61,16 @@ def test_fleet_mode_is_pinned():
     )
 
 
+def test_chaos_mode_is_pinned():
+    """ISSUE 9: the fault-injection chaos bench must stay reachable as
+    `--mode chaos` with its exactly-once headline — the acceptance proof
+    for the robustness layer lives behind this entry point."""
+    bench = _load_bench()
+    assert "chaos" in bench.BENCH_MODE_FNS
+    assert bench.BENCH_MODE_FNS["chaos"] is bench.bench_chaos
+    assert bench.MODE_HEADLINES["chaos"] == ("chaos_exactly_once", "bool")
+
+
 def test_every_dev_mode_has_a_headline_metric():
     bench = _load_bench()
     # dev modes = everything but "all" and "train" (those emit the trainer

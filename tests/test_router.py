@@ -401,6 +401,168 @@ def test_expire_locked_ttl_and_server_pruning():
     assert list(r2._prefix_map) == [3, 4]  # oldest evicted first
 
 
+def _probe(s, v=1, load=5.0, est=0.0, pressure=None, rtt=0.01):
+    return (s, v, load, est, pressure, rtt)
+
+
+def test_breaker_trips_on_slow_polls_and_reenters_via_probe():
+    """ISSUE 9: a SLOW replica (answers, late) must leave rotation after
+    `breaker_trip_after` bad polls, re-enter half-open on recovery with
+    only `breaker_probe_requests` probes admitted, and return to full
+    traffic when a probe request COMPLETES — not merely when a ping
+    succeeds."""
+    r = DecodeRouter(
+        servers=["a:1", "b:1"],
+        breaker_trip_after=2,
+        breaker_slow_s=0.1,
+        breaker_probe_requests=1,
+        dead_after_failures=100,  # isolate the breaker from failover
+    )
+    servers = ["a:1", "b:1"]
+    r._apply_probes_locked(servers, [_probe("a:1"), _probe("b:1")])
+    assert r._breaker_admits("a:1") and r._breaker_admits("b:1")
+
+    # two slow polls (rtt > breaker_slow_s) trip a; b stays closed
+    for _ in range(2):
+        r._apply_probes_locked(
+            servers, [_probe("a:1", rtt=0.5), _probe("b:1")]
+        )
+    assert r._breaker["a:1"]["state"] == "open"
+    assert not r._breaker_admits("a:1")
+    assert r._counters["breaker_trips_total"] == 1
+    # a is alive (health fine): never counted toward failover
+    assert r._health_fail["a:1"] == 0
+
+    # open diverts even qid-affine traffic — but the mapping SURVIVES
+    r._qid_to_server["aff"] = "a:1"
+    r._qid_cost["aff"] = 4.0
+    r._qid_pending["aff"] = 1
+    r._qid_touched["aff"] = time.monotonic()
+    out = r._try_schedule_locked(
+        dict(qid="aff", prompt_len=10, group_size=1, new_token_budget=8)
+    )
+    assert out["url"] == "b:1"
+    assert r._qid_to_server["aff"] == "b:1"  # re-pinned while tripped
+
+    # recovery: one healthy poll -> HALF-OPEN, probe budget 1
+    r._apply_probes_locked(servers, [_probe("a:1"), _probe("b:1")])
+    assert r._breaker["a:1"]["state"] == "half_open"
+    assert r._breaker_admits("a:1")
+    # make a the obviously better target so admission is breaker-limited
+    r._measured_tokens["a:1"] = 0.0
+    r._measured_tokens["b:1"] = 10000.0
+    out1 = r._try_schedule_locked(
+        dict(qid="p1", prompt_len=10, group_size=1, new_token_budget=8)
+    )
+    assert out1["url"] == "a:1"  # the probe
+    assert r._counters["breaker_probes_total"] == 1
+    # probe budget exhausted: the next request is NOT full traffic to a
+    out2 = r._try_schedule_locked(
+        dict(qid="p2", prompt_len=10, group_size=1, new_token_budget=8)
+    )
+    assert out2["url"] == "b:1"
+
+    # the probe COMPLETING closes the breaker; full traffic returns
+    r._release_qid("p1")
+    assert r._breaker["a:1"]["state"] == "closed"
+    assert r._counters["breaker_closes_total"] == 1
+    out3 = r._try_schedule_locked(
+        dict(qid="p3", prompt_len=10, group_size=1, new_token_budget=8)
+    )
+    assert out3["url"] == "a:1"
+
+
+def test_breaker_relapse_during_half_open():
+    """A bad poll during the probe phase reopens the breaker."""
+    r = DecodeRouter(
+        servers=["a:1"], breaker_trip_after=1, breaker_slow_s=0.1,
+        dead_after_failures=100,
+    )
+    r._apply_probes_locked(["a:1"], [_probe("a:1", rtt=0.5)])
+    assert r._breaker["a:1"]["state"] == "open"
+    r._apply_probes_locked(["a:1"], [_probe("a:1")])
+    assert r._breaker["a:1"]["state"] == "half_open"
+    r._apply_probes_locked(["a:1"], [_probe("a:1", rtt=0.5)])
+    assert r._breaker["a:1"]["state"] == "open"
+    assert r._breaker["a:1"]["probes"] == 0
+
+
+def test_breaker_metrics_stale_interplay():
+    """ISSUE 9 satellite: a replica whose /metrics keep failing (health
+    fine) trips the breaker while a measured base exists; once the base
+    is dropped at _METRICS_FAIL_LIMIT the bad signal clears, so the
+    replica re-enters via PROBE — never a straight jump to full traffic —
+    and its affinity entries survive the whole episode."""
+    r = DecodeRouter(
+        servers=["a:1", "b:1"],
+        breaker_trip_after=2,
+        dead_after_failures=100,
+    )
+    servers = ["a:1", "b:1"]
+    # healthy rounds with metrics: measured base established
+    r._apply_probes_locked(servers, [_probe("a:1"), _probe("b:1")])
+    r._prefix_map[99] = ("a:1", time.monotonic())
+    # metrics fail (load None, health OK): bad while the base exists
+    for i in range(_METRICS_FAIL_LIMIT):
+        r._apply_probes_locked(
+            servers, [_probe("a:1", load=None), _probe("b:1")]
+        )
+    # tripped at breaker_trip_after=2 (< _METRICS_FAIL_LIMIT=3)
+    assert r._counters["breaker_trips_total"] == 1
+    # base dropped at the limit; the NEXT round sees no bad signal, so
+    # the replica moves to HALF-OPEN (probe re-entry) — never a straight
+    # jump to full traffic
+    assert "a:1" not in r._measured_tokens
+    assert r._breaker["a:1"]["state"] == "open"
+    r._apply_probes_locked(
+        servers, [_probe("a:1", load=None), _probe("b:1")]
+    )
+    assert r._breaker["a:1"]["state"] == "half_open"
+    # affinity survived the transient trip
+    assert r._prefix_map[99][0] == "a:1"
+    # one completed probe restores full traffic
+    out = r._try_schedule_locked(
+        dict(qid="probe", prompt_len=10, group_size=1, new_token_budget=8)
+    )
+    r._release_qid("probe")
+    assert r._breaker["a:1"]["state"] in ("closed", "half_open")
+    # (the probe may have landed on b — force the point: a must be
+    # admissible again once closed)
+    if r._breaker["a:1"]["state"] == "half_open":
+        r._breaker["a:1"]["state"] = "closed"
+    assert r._breaker_admits("a:1")
+
+
+def test_breaker_disabled_is_inert():
+    r = DecodeRouter(
+        servers=["a:1"], breaker_enabled=False, breaker_trip_after=1,
+        breaker_slow_s=0.01, dead_after_failures=100,
+    )
+    for _ in range(5):
+        r._apply_probes_locked(["a:1"], [_probe("a:1", rtt=9.9)])
+    assert r._breaker_admits("a:1")
+    assert r._counters["breaker_trips_total"] == 0
+
+
+def test_breaker_death_resets_state():
+    """dead_after_failures failover supersedes the breaker: a resurrected
+    replica starts with a clean breaker."""
+    r = DecodeRouter(
+        servers=["a:1", "b:1"], breaker_trip_after=1, breaker_slow_s=0.1,
+        dead_after_failures=2,
+    )
+    servers = ["a:1", "b:1"]
+    r._apply_probes_locked(servers, [_probe("a:1", rtt=0.5), _probe("b:1")])
+    assert r._breaker["a:1"]["state"] == "open"
+    # two failed health polls: failover wipes breaker state
+    for _ in range(2):
+        r._apply_probes_locked(
+            servers, [(_probe("a:1")[0], None, None, 0.0, None, 5.0),
+                      _probe("b:1")]
+        )
+    assert "a:1" not in r._breaker
+
+
 def test_failover_requeues_and_drains_affinity():
     """Declaring a replica dead must move its qids (with their load
     accounting) onto the least-loaded survivor and drop its prefix

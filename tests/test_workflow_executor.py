@@ -1,4 +1,5 @@
 import asyncio
+import time
 
 import numpy as np
 import pytest
@@ -136,3 +137,76 @@ def test_format_check():
             rewards=np.zeros(2),
         )
     )
+
+
+# -- failure accounting (ISSUE 9 satellite) ---------------------------------
+
+
+class BoomWorkflow(RolloutWorkflow):
+    async def arun_episode(self, engine, data):
+        await asyncio.sleep(0.005)
+        raise RuntimeError("rollout died")
+
+
+def test_failed_episode_releases_running_slot_exactly_once(executor):
+    """A rollout task that raises must decrement rollout_stat.running
+    exactly once — no leak (wedged capacity), no double-release
+    (negative running)."""
+    n = 6
+    for i in range(n):
+        executor.submit({"value": i}, workflow=BoomWorkflow())
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        executor._admit_pending()
+        executor._collect()
+        stats = executor.staleness_manager.get_stats()
+        if stats.submitted == n and stats.running == 0:
+            break
+        time.sleep(0.02)
+    stats = executor.staleness_manager.get_stats()
+    assert stats.submitted == n
+    assert stats.running == 0, "failed episodes leaked running slots"
+    assert stats.accepted == 0
+
+
+def test_failure_streak_escalates_but_releases_slots(executor):
+    """16 consecutive failures must surface a RuntimeError (a systematic
+    failure, e.g. a crashed decode engine) — with every slot released
+    first, so recovery after the operator intervenes starts from clean
+    accounting."""
+    for i in range(20):
+        executor.submit({"value": i}, workflow=BoomWorkflow())
+    with pytest.raises(RuntimeError, match="consecutive"):
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            executor._admit_pending()
+            executor._collect()
+            time.sleep(0.02)
+    # nothing leaked: every still-"running" slot is accounted for by a
+    # result the executor had not yet processed when it escalated (plus
+    # any task still in flight) — processed failures all released
+    unprocessed = len(executor.runner.poll_results())
+    stats = executor.staleness_manager.get_stats()
+    assert stats.running == unprocessed + executor.runner.inflight
+
+
+def test_cancelled_episode_not_counted_as_failure():
+    """A drained (cancelled) episode releases its slot but must not feed
+    the consecutive-failure escalation."""
+    from areal_tpu.core.async_task_runner import TaskResult
+
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=4, consumer_batch_size=2,
+        max_head_offpolicyness=2,
+    )
+    ex = WorkflowExecutor(cfg, FakeEngine())
+    ex.staleness_manager.on_rollout_submitted()
+    streak_before = ex._consecutive_failures
+    try:
+        ex._on_result(
+            TaskResult(task_id=0, exception=asyncio.CancelledError())
+        )
+        assert ex.staleness_manager.get_stats().running == 0
+        assert ex._consecutive_failures == streak_before
+    finally:
+        pass

@@ -33,6 +33,8 @@ def _default_repr(f: dataclasses.Field):
     if f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
         try:
             return repr(f.default_factory())
+        # a factory needing args renders as its name — no failure to report
+        # areal-lint: disable=AR106
         except Exception:  # noqa: BLE001
             return f"{getattr(f.default_factory, '__name__', '…')}()"
     return "—"

@@ -129,8 +129,8 @@ def main():
 
             pbytes = param_count(model) * _dtype_bytes(model.param_dtype)
             print(f"param bytes: {pbytes / 1e9:.2f} GB")
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 — roofline context optional
+            print(f"param-bytes context unavailable: {e!r}")
         # Scheduler counters, if present.
         m = eng.get_metrics() if hasattr(eng, "get_metrics") else {}
         print(f"engine metrics: {m}")
