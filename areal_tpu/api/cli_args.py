@@ -278,6 +278,28 @@ class JaxDecodeConfig:
     random_seed: int = 1
     dtype: str = "bfloat16"
     kv_cache_dtype: str = "bfloat16"
+    # Replica role in a disaggregated fleet (launcher/decode_server.py):
+    #   "unified" (default): one replica does both prefill and decode.
+    #   "prefill": compute-bound role — runs prompt prefills only (via
+    #     /prefill), parks the resulting KV, and streams it to a decode
+    #     replica over the KV wire format (core/weight_transfer.py
+    #     pack_kv_session) so long prefills never stall resident decode
+    #     slots on the decode replicas.
+    #   "decode": memory-bound role — imports migrated KV sessions into
+    #     its host tier and resumes them through the host-tier promotion
+    #     path (zero re-prefill). Any role still serves every endpoint
+    #     (a prefill replica CAN decode) — the role steers the router and
+    #     sizes defaults, it does not forbid traffic, so a degraded fleet
+    #     keeps working.
+    role: str = "unified"  # "unified" | "prefill" | "decode"
+    # Frame size for migrated KV sessions (MiB per HTTP body on the
+    # /kv_recv wire — same bounded-bucket rule as weight_chunked_mem_mb).
+    kv_migrate_chunk_mb: float = 64.0
+    # Host-tier budget a decode-role replica creates LAZILY (MiB) when it
+    # receives a KV migration while kv_host_pool_mb == 0 — imported
+    # sessions need a host tier to land in; this bounds it. Ignored when
+    # kv_host_pool_mb already enabled the tier.
+    kv_import_pool_mb: float = 256.0
     # Gen-side tensor parallelism: params + KV cache are sharded over a
     # [1,1,1,tp] decode mesh (parity: the server-side d/t/p dims of the
     # reference's allocation grammar, areal/api/alloc_mode.py:277-280 — dp
@@ -635,6 +657,12 @@ class LauncherConfig:
     trainer_mem_per_accelerator: int = 32 * 1024
     inference_server_env_vars: str = ""
     trainer_env_vars: str = ""
+    # Disaggregated role fleet: of the gen data-parallel replicas, launch
+    # this many with --role prefill (compute-bound: prompt prefills only,
+    # KV streamed to the decode replicas) and the REST with --role decode.
+    # 0 (default) launches every replica unified. Must leave at least one
+    # decode replica (prefill_replicas < gen dp size).
+    prefill_replicas: int = 0
     slurm: SlurmLauncherConfig = field(default_factory=SlurmLauncherConfig)
 
 

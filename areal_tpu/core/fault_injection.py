@@ -25,11 +25,27 @@ Seams (grep for `fault_injection.fire(` / `.afire(` / `.tear(`):
   router.schedule       launcher/router.py   /schedule_request handling
   router.poll           launcher/router.py   per-replica health/metrics probe
   server.generate       launcher/decode_server.py  before the engine runs
+  server.prefill        launcher/decode_server.py  before a prefill-only
+                                             admission (disaggregated role)
   server.weights.stage  launcher/decode_server.py  per received bucket
   server.weights.commit launcher/decode_server.py  before the install
   weight.stage.add      core/weight_transfer.py    WeightStaging.add_bucket
-  kv.swap_out           engine/kv_pool.py    HostKVStore.put (D2H offload)
+                                             (fires for KV-session frames
+                                             too — they ride the same
+                                             staging)
+  kv.swap_out           engine/kv_pool.py    HostKVStore.put (D2H offload;
+                                             also migration imports)
   kv.swap_in            engine/kv_pool.py    HostKVStore.take (promotion)
+  kv.migrate.send       launcher/decode_server.py  per outbound KV-session
+                                             frame (handoff/drain sender);
+                                             an abort is the sender dying
+                                             mid-stream — the same-xid
+                                             full replay must land the
+                                             session exactly once
+  kv.migrate.recv       launcher/decode_server.py  per inbound KV frame;
+                                             torn honored here (manifest
+                                             length-check rejects before
+                                             a byte stages)
   task.run              core/async_task_runner.py  rollout task execution
 
 Fault modes:

@@ -265,7 +265,13 @@ class RemoteInfEngine(InferenceEngine):
         req: ModelRequest,
         requeue: bool = False,
         deadline: float | None = None,
-    ) -> str | None:
+    ) -> dict[str, Any] | None:
+        """Ask the fleet router for a placement. Returns the router's
+        schedule dict — {"url": decode_addr, "prefill_url"?: addr, ...} —
+        or None when no router is configured/reachable (local fallback).
+        A disaggregated fleet returns BOTH addresses: the client runs
+        /prefill on prefill_url (which streams the KV to url server-side)
+        and then /generate on url resumes with zero re-prefill."""
         router = self._router_addr()
         if router is None:
             return None
@@ -306,7 +312,7 @@ class RemoteInfEngine(InferenceEngine):
                         self.config.router_request_timeout, remaining
                     ),
                 )
-                return out["url"]
+                return out if out.get("url") else None
             except HttpRequestError as e:
                 if e.status == 429 and time.monotonic() < deadline:
                     # the router's bounded admission queue shed us: honor
@@ -461,9 +467,13 @@ class RemoteInfEngine(InferenceEngine):
                 logger.warning(
                     f"/generate to {addr} failed ({e!r}); failing over"
                 )
-                routed = await self._schedule_via_router(
+                sched = await self._schedule_via_router(
                     req, requeue=True, deadline=deadline
                 )
+                # no prefill handoff on failover: the replacement replica
+                # either promotes migrated/parked KV or re-prefills —
+                # correctness is identical, only TTFT differs
+                routed = sched["url"] if sched else None
                 if routed is None or routed == addr:
                     self._release_local(req.rid)
                     routed = self.choose_server(
@@ -474,16 +484,60 @@ class RemoteInfEngine(InferenceEngine):
                 addr = routed
         raise AssertionError("unreachable")
 
+    async def _prefill_handoff(
+        self,
+        rid: str,
+        payload: dict[str, Any],
+        prefill_addr: str,
+        decode_addr: str,
+        deadline: float,
+    ) -> bool:
+        """Disaggregated handoff: run the prompt on the prefill replica,
+        which streams the resulting KV server→server to the decode
+        replica (the client never carries KV bytes); the /generate that
+        follows resumes it with zero re-prefill. Best-effort by design —
+        any failure here degrades to the decode replica prefilling
+        itself. One client retry with the SAME xid: the prefill side is
+        idempotent and the receiver's staging/commit dedup, so a
+        mid-transfer death replays the handoff exactly once."""
+        p = dict(payload)
+        p["target"] = decode_addr
+        p["xid"] = f"pf-{uuid.uuid4().hex}"
+        last: Exception | None = None
+        for attempt in range(2):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            try:
+                out = await arequest_with_retry(
+                    prefill_addr,
+                    "/prefill",
+                    payload=p,
+                    max_retries=1,
+                    timeout=min(60.0, remaining),
+                )
+                return bool(out.get("migrated"))
+            except Exception as e:  # noqa: BLE001 — degrade to self-prefill
+                last = e
+        logger.warning(
+            f"prefill handoff for {rid} via {prefill_addr} failed "
+            f"({last!r}); {decode_addr} will prefill itself"
+        )
+        return False
+
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
         """Generate with the interrupt-resume loop (reference :428-478)."""
         start = time.monotonic()
         # the request's whole-lifetime budget: schedule retries, queue
         # wait, 429 sleeps, and failover attempts all draw from it
         deadline = start + self.config.request_timeout
-        routed = await self._schedule_via_router(req, deadline=deadline)
+        sched = await self._schedule_via_router(req, deadline=deadline)
+        routed = sched["url"] if sched else None
         addr = routed or self.choose_server(
             req.rid, cost=self._local_cost(req)
         )
+        # disaggregated fleet: the router named a prefill replica too
+        prefill_url = sched.get("prefill_url") if sched else None
         prompt = list(req.input_ids)
         acc_tokens: list[int] = []
         acc_logprobs: list[float] = []
@@ -501,6 +555,13 @@ class RemoteInfEngine(InferenceEngine):
                     ),
                 )
                 payload = self.backend.build_generate_payload(work)
+                if prefill_url and prefill_url != addr:
+                    # first submission only: later resume iterations
+                    # continue from KV the decode replica already parks
+                    await self._prefill_handoff(
+                        req.rid, payload, prefill_url, addr, deadline
+                    )
+                    prefill_url = None
                 # delivery id: stable across transport retries AND the
                 # failover re-send of THIS submission (so a duplicate can
                 # never double-generate), fresh for each resume iteration

@@ -322,3 +322,82 @@ def unpack_bucket(payload: bytes) -> dict[str, np.ndarray]:
     st = WeightStaging()
     st.add_bucket(payload)
     return st.finalize()
+
+
+# -- KV-session wire format (disaggregated prefill/decode, ISSUE 10) --------
+#
+# A migrated session rides the SAME framed-bucket plumbing as a weight push:
+# interval-merged staging absorbs duplicate/re-split retry frames, the
+# manifest length-checks reject torn frames before a byte is staged, and
+# multi-frame splitting bounds every HTTP body. The "tensors" of a session
+# are its gathered pool blocks (K and V, [L, nb, block_size, nKV, hd]) plus
+# one JSON metadata blob carried as a uint8 tensor — exactly the
+# `HostKVEntry` resume contract (rid, covered token list, rope_delta,
+# sampling base key, weight version), so an imported session promotes
+# through the host-tier swap-in seam bit-identically to a local offload.
+
+KV_META_PREFIX = "kvmeta/"
+KV_DATA_PREFIX = "kvdata/"
+
+# HostKVEntry fields the wire metadata must carry for an exact resume
+_KV_META_REQUIRED = (
+    "rid", "covered", "tokens", "rope_delta", "base_key", "weight_version",
+    "nb",
+)
+
+
+def pack_kv_session(
+    meta: dict, k: np.ndarray, v: np.ndarray, chunk_mb: float = 64
+) -> Iterable[bytes]:
+    """Frame one session's KV blocks + resume metadata as wire buckets.
+
+    `meta` must carry the HostKVEntry resume contract (see
+    _KV_META_REQUIRED); `k`/`v` are the session's gathered pool blocks.
+    The metadata travels first so a receiver that streams frames in order
+    can validate the session before most of the bytes arrive (staging
+    itself is order-independent)."""
+    missing = [f for f in _KV_META_REQUIRED if f not in meta]
+    if missing:
+        raise ValueError(f"kv session meta missing fields: {missing}")
+    rid = str(meta["rid"])
+    mjson = np.frombuffer(
+        json.dumps(meta, sort_keys=True).encode(), dtype=np.uint8
+    )
+    named = [
+        (f"{KV_META_PREFIX}{rid}", mjson),
+        (f"{KV_DATA_PREFIX}{rid}/k", k),
+        (f"{KV_DATA_PREFIX}{rid}/v", v),
+    ]
+    yield from pack_buckets(named, chunk_mb=chunk_mb)
+
+
+def unpack_kv_sessions(
+    staged: dict[str, np.ndarray],
+) -> list[tuple[dict, np.ndarray, np.ndarray]]:
+    """Finalized staging → [(meta, k, v)] per complete session.
+
+    Raises ValueError when a session is structurally incomplete (metadata
+    without blocks or vice versa) or its metadata is malformed — the
+    commit handler turns that into a client-visible error instead of
+    importing a half-session."""
+    out: list[tuple[dict, np.ndarray, np.ndarray]] = []
+    meta_keys = sorted(n for n in staged if n.startswith(KV_META_PREFIX))
+    data_keys = {n for n in staged if n.startswith(KV_DATA_PREFIX)}
+    for mk in meta_keys:
+        rid = mk[len(KV_META_PREFIX):]
+        kk = f"{KV_DATA_PREFIX}{rid}/k"
+        vk = f"{KV_DATA_PREFIX}{rid}/v"
+        if kk not in staged or vk not in staged:
+            raise ValueError(f"kv session {rid!r} incomplete: missing blocks")
+        meta = json.loads(np.asarray(staged[mk], dtype=np.uint8).tobytes())
+        missing = [f for f in _KV_META_REQUIRED if f not in meta]
+        if missing or str(meta["rid"]) != rid:
+            raise ValueError(f"kv session {rid!r} metadata malformed")
+        out.append((meta, staged[kk], staged[vk]))
+        data_keys.discard(kk)
+        data_keys.discard(vk)
+    if data_keys:
+        raise ValueError(
+            f"kv blocks without session metadata: {sorted(data_keys)[:4]}"
+        )
+    return out

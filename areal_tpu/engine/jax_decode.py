@@ -159,6 +159,20 @@ _GUARDED_BY = {
     # pause-fenced weight-install clear, get_metrics snapshots from the
     # HTTP thread) goes through _host_lock (rank 25)
     "JaxDecodeEngine._host_store": "_host_lock",
+    # cross-replica KV migration + TTFT-split accounting: written by the
+    # scheduler (admission timing) AND the HTTP thread (export_session /
+    # import_session), snapshotted by get_metrics — all under _metrics_lock
+    "JaxDecodeEngine._ttft_queue_ms": "_metrics_lock",
+    "JaxDecodeEngine._ttft_prefill_ms": "_metrics_lock",
+    "JaxDecodeEngine._ttft_transfer_ms": "_metrics_lock",
+    "JaxDecodeEngine._queue_secs_total": "_metrics_lock",
+    "JaxDecodeEngine._prefill_secs_total": "_metrics_lock",
+    "JaxDecodeEngine._transfer_secs_total": "_metrics_lock",
+    "JaxDecodeEngine._n_migrated_in": "_metrics_lock",
+    "JaxDecodeEngine._n_migrated_out": "_metrics_lock",
+    "JaxDecodeEngine._migrated_in_bytes": "_metrics_lock",
+    "JaxDecodeEngine._migrated_out_bytes": "_metrics_lock",
+    "JaxDecodeEngine._n_migrate_version_rejects": "_metrics_lock",
     # device buffers swapped under _weight_lock at every mutation site
     # that can race a dispatched chunk
     "JaxDecodeEngine._k_cache": "_weight_lock",
@@ -300,6 +314,14 @@ class _Slot:
     # to the never-preempted schedule — whether it came back through the
     # host KV tier or through a re-prefill
     base_key: np.ndarray | None = None
+    # Disaggregated prefill role: run ONLY the prompt prefill, then retire
+    # immediately with stop_reason="prefill" and the KV parked — exactly
+    # the state an interrupted request leaves behind, so the session can
+    # be exported to a decode replica (or resumed locally) with zero
+    # re-prefill.
+    prefill_only: bool = False
+    # set at admission; TTFT split: admit_t - start_time is queue wait
+    admit_t: float = 0.0
 
 
 @dataclass
@@ -414,6 +436,30 @@ class JaxDecodeEngine(InferenceEngine):
         # (not merely missed) and fell back to drop / re-prefill
         self._n_offload_failures = 0
         self._n_promote_failures = 0
+        # -- TTFT split + cross-replica migration accounting -----------
+        # (all under _metrics_lock — see the module _GUARDED_BY registry)
+        # Per-admission TTFT decomposition: queue wait (enqueue→admit),
+        # prefill dispatch wall attributed per admitted slot, and
+        # host-tier/migration transfer wall (promotion upload). Recent
+        # windows for percentiles + monotonic totals.
+        self._ttft_queue_ms: deque = deque(maxlen=512)
+        self._ttft_prefill_ms: deque = deque(maxlen=512)
+        self._ttft_transfer_ms: deque = deque(maxlen=512)
+        self._queue_secs_total = 0.0
+        self._prefill_secs_total = 0.0
+        self._transfer_secs_total = 0.0
+        # KV sessions migrated across replicas (disaggregated fleets /
+        # drain): import = sessions landed in this engine's host tier,
+        # export = sessions streamed out; version rejects = imports
+        # refused because the KV was computed under different weights
+        self._n_migrated_in = 0
+        self._n_migrated_out = 0
+        self._migrated_in_bytes = 0
+        self._migrated_out_bytes = 0
+        self._n_migrate_version_rejects = 0
+        # K+V bytes of one pool block (set in initialize; import_session
+        # needs it to size a lazily created host tier)
+        self._block_nbytes = 0
         self._alloc: KVBlockAllocator | None = None  # set in initialize
         # host-RAM KV tier (kv_host_pool_mb > 0): eviction offloads
         # parked/preempted slots' blocks here instead of dropping them;
@@ -474,6 +520,12 @@ class JaxDecodeEngine(InferenceEngine):
         self._dev_idle_s = 0.0
         self._last_ready_t: float | None = None
         self._chunk_itl_ms: deque = deque(maxlen=512)
+        # WALL inter-token latency: ready→ready gap between consecutive
+        # chunks per emitted token — unlike _chunk_itl_ms (device window
+        # only) this INCLUDES the host gap, so a prompt prefill the
+        # scheduler serialized in front of the next decode chunk shows up
+        # here. The head-of-line signal disaggregation exists to remove.
+        self._chunk_wall_itl_ms: deque = deque(maxlen=512)
         self._chunks_dispatched = 0
         self._runahead_discarded = 0  # run-ahead tokens dropped at reconcile
         self._chunk_fns: dict[bool, Callable] = {}
@@ -582,6 +634,13 @@ class JaxDecodeEngine(InferenceEngine):
                 f"kv_layout={self.config.kv_layout!r} not in "
                 "('paged', 'workspace')"
             )
+        if getattr(self.config, "role", "unified") not in (
+            "unified", "prefill", "decode",
+        ):
+            raise ValueError(
+                f"role={self.config.role!r} not in "
+                "('unified', 'prefill', 'decode')"
+            )
         from areal_tpu.ops.paged_attention import resolve_impl
 
         self._paged_impl = resolve_impl(self.config.paged_attn_impl)
@@ -627,6 +686,7 @@ class JaxDecodeEngine(InferenceEngine):
             * cfg.head_dim_
             * jnp.dtype(self.config.kv_cache_dtype).itemsize
         )
+        self._block_nbytes = int(block_nbytes)
         with self._host_lock:
             if float(self.config.kv_host_pool_mb) > 0:
                 self._host_store = HostKVStore(
@@ -676,6 +736,7 @@ class JaxDecodeEngine(InferenceEngine):
             self._dev_idle_s = 0.0
             self._last_ready_t = None
             self._chunk_itl_ms = deque(maxlen=512)
+            self._chunk_wall_itl_ms = deque(maxlen=512)
             self._chunks_dispatched = 0
             self._runahead_discarded = 0
             self._spec_hist = np.zeros(
@@ -685,6 +746,17 @@ class JaxDecodeEngine(InferenceEngine):
             self._spec_drafted = 0
             self._spec_accepted = 0
             self._spec_rejected = 0
+            self._ttft_queue_ms = deque(maxlen=512)
+            self._ttft_prefill_ms = deque(maxlen=512)
+            self._ttft_transfer_ms = deque(maxlen=512)
+            self._queue_secs_total = 0.0
+            self._prefill_secs_total = 0.0
+            self._transfer_secs_total = 0.0
+            self._n_migrated_in = 0
+            self._n_migrated_out = 0
+            self._migrated_in_bytes = 0
+            self._migrated_out_bytes = 0
+            self._n_migrate_version_rejects = 0
 
         from areal_tpu.core.workflow_executor import WorkflowExecutor
 
@@ -1704,6 +1776,7 @@ class JaxDecodeEngine(InferenceEngine):
                 tokens=list(tokens),
                 rope_delta=int(self._slot_rope_delta[slot]),
                 base_key=np.array(self._slot_keys[slot]),
+                weight_version=int(self._version),
                 ts=time.monotonic(),
                 pending=True,
             )
@@ -1723,7 +1796,9 @@ class JaxDecodeEngine(InferenceEngine):
         if self._host_store is None:
             return False
         with self._host_lock:
-            return self._host_store.match(rid, covered, tokens)
+            return self._host_store.match(
+                rid, covered, tokens, weight_version=int(self._version)
+            )
 
     def _host_promote(self, item: _Slot, slot_idx: int, covered: int) -> bool:
         """Promote item's host-tier entry into `slot_idx`: fresh device
@@ -1734,6 +1809,7 @@ class JaxDecodeEngine(InferenceEngine):
         The upload is dispatched, not awaited: the run-ahead `_dispatch`/
         `_consume` split means other slots' chunks keep flowing while the
         transfer drains on the device stream."""
+        t_promote = time.monotonic()
         with self._host_lock:
             entry = self._host_store.take(item.rid)
         if entry is None:
@@ -1763,6 +1839,13 @@ class JaxDecodeEngine(InferenceEngine):
             self._register_prefix(slot_idx, list(entry.tokens))
         with self._host_lock:
             self._host_store.note_hit(entry)
+        # TTFT split: the swap-in (host bytes → device blocks) wall is the
+        # "transfer" share of this request's TTFT — for a migrated session
+        # it replaces the prefill share entirely
+        dt = time.monotonic() - t_promote
+        with self._metrics_lock:
+            self._ttft_transfer_ms.append(dt * 1000.0)
+            self._transfer_secs_total += dt
         return True
 
     def _get_suffix_prefill_fn(self, suffix_bucket: int, prefix_bucket: int,
@@ -2036,6 +2119,10 @@ class JaxDecodeEngine(InferenceEngine):
         wave_primaries: dict[tuple[int, ...], int] = {}
         wave_pending: list[tuple[int, np.ndarray, int, int, tuple]] = []
         wave_forks: list[tuple[int, int, tuple, int]] = []
+        # prefill-only admissions (disaggregated prefill role): retired
+        # right after the wave flush — their KV must be written before the
+        # park, and no decode chunk may ever dispatch for them
+        prefill_done: list[int] = []
         while True:
             item = self._next_request()
             if item is None:
@@ -2248,6 +2335,7 @@ class JaxDecodeEngine(InferenceEngine):
                 bsz = self._alloc.block_size
                 nb = -(-max(pb, plen + sb) // bsz)
                 fn = self._get_suffix_prefill_fn(sb, pb, nb)
+                t_pf = time.monotonic()
                 with self._weight_lock:
                     self._k_cache, self._v_cache = fn(
                         self.params,
@@ -2258,6 +2346,7 @@ class JaxDecodeEngine(InferenceEngine):
                         len(suffix),
                         plen,
                     )
+                self._note_prefill_wall(time.monotonic() - t_pf)
                 self._register_prefix(slot_idx, list(prompt[:-1]))
             elif resumed is None and P > 1 and not promoted:
                 pre = P - 1
@@ -2285,6 +2374,7 @@ class JaxDecodeEngine(InferenceEngine):
                     fn = self._get_embed_prefill_fn(
                         bucket, int(img_embeds.shape[0])
                     )
+                    t_pf = time.monotonic()
                     with self._weight_lock:
                         self._k_cache, self._v_cache = fn(
                             self.params,
@@ -2298,6 +2388,7 @@ class JaxDecodeEngine(InferenceEngine):
                             cos,
                             sin,
                         )
+                    self._note_prefill_wall(time.monotonic() - t_pf)
                 elif is_wave_dup:
                     # duplicate within this admission wave: fork from the
                     # primary once its (deferred) prefill has run
@@ -2318,6 +2409,16 @@ class JaxDecodeEngine(InferenceEngine):
             self._slots[slot_idx] = item
             self._slot_lengths[slot_idx] = P - 1
             self._slot_epoch[slot_idx] += 1
+            # TTFT split: everything between enqueue and this point is
+            # queue wait (scheduler backlog + pool-pressure holds); the
+            # prefill/transfer shares are recorded at their dispatch sites
+            item.admit_t = time.monotonic()
+            with self._metrics_lock:
+                q_s = max(item.admit_t - item.start_time, 0.0)
+                self._ttft_queue_ms.append(q_s * 1000.0)
+                self._queue_secs_total += q_s
+            if item.prefill_only:
+                prefill_done.append(slot_idx)
             # One base key per REQUEST, assigned at its first admission in
             # admission (FIFO) order — the key stream is identical for the
             # sync and run-ahead schedules. Derived on the HOST
@@ -2346,7 +2447,30 @@ class JaxDecodeEngine(InferenceEngine):
             self._mark_slot_dirty(slot_idx)
             admitted = True
         self._flush_wave(wave_pending, wave_forks)
+        # Prefill-only requests (disaggregated prefill role) retire NOW —
+        # after the wave flush wrote their KV, before any chunk could
+        # dispatch for them. stop_reason="prefill" parks the slot exactly
+        # like an interrupt: covered = prompt[:-1], ready for a local
+        # resume or an export_session stream to a decode replica.
+        for slot_idx in prefill_done:
+            item = self._slots[slot_idx]
+            if item is None or not item.prefill_only:
+                # a wave-flush fallback preempted/requeued this slot; the
+                # request re-admits on a later pass and retires then
+                continue
+            item.stop_reason = "prefill"
+            self._retire(slot_idx)
         return admitted
+
+    def _note_prefill_wall(self, dt: float, n: int = 1) -> None:
+        """Record prefill dispatch wall for `n` admitted slots (TTFT
+        split). On CPU this is the compute itself; on TPU it is the
+        dispatch cost — the honest host-side share of TTFT either way."""
+        with self._metrics_lock:
+            per = dt / max(n, 1)
+            for _ in range(max(n, 1)):
+                self._ttft_prefill_ms.append(per * 1000.0)
+            self._prefill_secs_total += dt
 
     def _flush_wave(
         self,
@@ -2367,6 +2491,7 @@ class JaxDecodeEngine(InferenceEngine):
                 B = 8 if rest >= 8 else 4 if rest >= 4 else 2 if rest >= 2 else 1
                 group = entries[i : i + B]
                 i += B
+                t_pf = time.monotonic()
                 if B == 1:
                     slot_idx, ids, pre, _, _ = group[0]
                     fn = self._get_prefill_fn(bucket)
@@ -2400,6 +2525,7 @@ class JaxDecodeEngine(InferenceEngine):
                                 np.array([g[2] for g in group], np.int32)
                             ),
                         )
+                self._note_prefill_wall(time.monotonic() - t_pf, n=B)
                 for slot_idx, _, _, _, covered_t in group:
                     self._register_prefix(slot_idx, list(covered_t))
         for dst, src, covered_t, bucket in forks:
@@ -2510,10 +2636,12 @@ class JaxDecodeEngine(InferenceEngine):
         item = self._slots[slot_idx]
         self._slots[slot_idx] = None
         self._mark_slot_dirty(slot_idx)
-        if item is not None and item.stop_reason == "interrupt":
+        if item is not None and item.stop_reason in ("interrupt", "prefill"):
             # Park the slot's KV: the client will resume this rid with
             # prompt + partial tokens, whose KV (minus the final token) is
             # exactly what the cache already holds — resume prefills nothing.
+            # ("prefill" is the prefill-only shape: zero generated tokens,
+            # the parked coverage IS the prompt's KV, export-ready.)
             covered = int(self._slot_lengths[slot_idx])
             self._parked[item.rid] = (slot_idx, covered, time.monotonic())
             self._parked_tokens[item.rid] = (
@@ -2966,6 +3094,7 @@ class JaxDecodeEngine(InferenceEngine):
         # previous chunk's ready and this dispatch is device idle (the
         # host gap the run-ahead path exists to hide)
         with self._metrics_lock:
+            prev_ready = self._last_ready_t
             if (
                 self._last_ready_t is not None
                 and rec.t_dispatch > self._last_ready_t
@@ -3042,6 +3171,16 @@ class JaxDecodeEngine(InferenceEngine):
                 else float(max(n_chunk, 1))
             )
             self._chunk_itl_ms.append(dev_s / max(mean_e, 1e-9) * 1000.0)
+            # wall ready→ready per token: includes the host gap (prefill
+            # admissions serialized between chunks land HERE) — the
+            # head-of-line number disaggregation improves. Gaps across an
+            # idle engine never count (prev_ready resets to None there).
+            if prev_ready is not None:
+                self._chunk_wall_itl_ms.append(
+                    max(t_ready - prev_ready, 0.0)
+                    / max(mean_e, 1e-9)
+                    * 1000.0
+                )
 
     # -- InferenceEngine surface ---------------------------------------
     async def agenerate(self, req: ModelRequest) -> ModelResponse:
@@ -3078,6 +3217,45 @@ class JaxDecodeEngine(InferenceEngine):
         # so a put that races past the drain is always caught here — without
         # this, such a request would wait forever on a future nobody
         # resolves.
+        if self._thread_exc is not None:
+            raise RuntimeError(
+                "decode scheduler is dead; engine must be re-initialized"
+            ) from self._thread_exc
+        return await future
+
+    async def aprefill(self, req: ModelRequest) -> ModelResponse:
+        """Run ONLY the prompt prefill for `req`, park the resulting KV,
+        and return (stop_reason="prefill", zero output tokens).
+
+        The disaggregated prefill role's entry point: the parked session
+        is byte-for-byte what an interrupted request leaves behind —
+        covered = prompt[:-1], sampling base key assigned in admission
+        order — so a later /generate with the same rid + prompt resumes
+        from it with zero re-prefill (locally via _take_parked, or on a
+        decode replica after export_session/import_session streams it
+        over). Prefix sharing still applies: a GRPO group's duplicate
+        prompts fork the first member's prefill instead of re-running it.
+        """
+        if self._thread_exc is not None:
+            raise RuntimeError("decode engine crashed") from self._thread_exc
+        if req.image_data and self._vision_params is None:
+            raise NotImplementedError(
+                "JaxDecodeEngine has no vision tower installed; call "
+                "set_vision_model() (models/qwen2_vl.py) to serve image "
+                "inputs"
+            )
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        item = _Slot(
+            rid=req.rid,
+            prompt=list(req.input_ids),
+            gconfig=req.gconfig,
+            future=future,
+            loop=loop,
+            image_data=req.image_data,
+            prefill_only=True,
+        )
+        self._request_q.put(item)
         if self._thread_exc is not None:
             raise RuntimeError(
                 "decode scheduler is dead; engine must be re-initialized"
@@ -3550,6 +3728,194 @@ class JaxDecodeEngine(InferenceEngine):
                 n += 1
         return n
 
+    # -- cross-replica KV migration (disaggregated fleets, ISSUE 10) ----
+    def list_exportable_sessions(self) -> list[str]:
+        """rids whose complete resumable KV this engine currently holds:
+        parked slots (interrupted / prefill-only) plus host-tier entries.
+        Drain streams exactly this set to survivors."""
+        with self._sched_lock:
+            rids = list(self._parked)
+            seen = set(rids)
+            with self._host_lock:
+                if self._host_store is not None:
+                    rids.extend(
+                        r for r in self._host_store.rids() if r not in seen
+                    )
+        return rids
+
+    def export_session(self, rid: str) -> dict | None:
+        """MOVE one session's resumable KV out of this engine: returns
+        {"meta": <HostKVEntry contract dict>, "k": np, "v": np} or None
+        when the rid holds no exportable session.
+
+        Parked sessions: the covering pool blocks are gathered to host
+        and the parked entry is dropped — but the blocks stay registered
+        as donor material, so same-prompt siblings still fork locally.
+        Host-tier sessions are taken from the store (materialised). The
+        metadata carries the weight version; the importing replica
+        rejects a version mismatch as an honest miss (the migration
+        raced a weight commit). Safe from the HTTP thread: parked blocks
+        are never written by in-flight chunks, and the gather serialises
+        under _sched_lock -> _weight_lock like every other pool read."""
+        try:
+            # bind this engine's mesh: the gather traces on the HTTP
+            # thread, which (unlike the scheduler thread) has no ambient
+            # mesh bound per pass
+            with mesh_lib.mesh_scope(self.mesh), self._sched_lock:
+                parked = self._parked.get(rid)
+                if parked is not None:
+                    slot, covered, _ = parked
+                    tokens = list(self._parked_tokens.get(rid) or [])
+                    nb = self._alloc.blocks_for(covered)
+                    if (
+                        covered <= 0
+                        or len(tokens) != covered
+                        or nb <= 0
+                        or nb > int(self._alloc.nblocks[slot])
+                    ):
+                        return None
+                    fn = self._get_host_gather_fn()
+                    with self._weight_lock:
+                        hk, hv = fn(
+                            self._k_cache,
+                            self._v_cache,
+                            jnp.asarray(self._alloc.row(slot, nb)),
+                        )
+                    meta = dict(
+                        rid=rid,
+                        covered=int(covered),
+                        tokens=[int(t) for t in tokens],
+                        rope_delta=int(self._slot_rope_delta[slot]),
+                        base_key=[
+                            int(x) for x in np.asarray(self._slot_keys[slot])
+                        ],
+                        weight_version=int(self._version),
+                        nb=int(nb),
+                    )
+                    # the session moves: drop the parked entry, keep the
+                    # blocks as a donor registration (prefix reuse only)
+                    self._parked.pop(rid, None)
+                    self._parked_tokens.pop(rid, None)
+                    self._register_prefix(slot, tokens)
+                    k, v = np.asarray(hk), np.asarray(hv)
+                    with self._metrics_lock:
+                        self._n_migrated_out += 1
+                        self._migrated_out_bytes += k.nbytes + v.nbytes
+                    return dict(meta=meta, k=k, v=v)
+                with self._host_lock:
+                    store = self._host_store
+                    entry = store.take(rid) if store is not None else None
+                if entry is None:
+                    return None
+                meta = dict(
+                    rid=rid,
+                    covered=int(entry.covered),
+                    tokens=[int(t) for t in entry.tokens],
+                    rope_delta=int(entry.rope_delta),
+                    base_key=[int(x) for x in np.asarray(entry.base_key)],
+                    weight_version=int(entry.weight_version),
+                    nb=int(entry.nb),
+                )
+                k, v = np.asarray(entry.k), np.asarray(entry.v)
+                with self._metrics_lock:
+                    self._n_migrated_out += 1
+                    self._migrated_out_bytes += k.nbytes + v.nbytes
+                return dict(meta=meta, k=k, v=v)
+        except Exception as e:  # noqa: BLE001 — degrade, never wedge
+            # a failed export (gather error, injected swap fault) costs a
+            # re-prefill on whichever replica the session resumes on —
+            # never the caller's thread
+            logger.warning(f"kv export of {rid} failed: {e!r}")
+            return None
+
+    def _ensure_host_store_locked(self, block_size: int) -> None:
+        """Caller holds _host_lock. A decode-role replica without an
+        explicit host tier still needs somewhere for imported sessions
+        (and their miss tombstones) to land; bound it by
+        kv_import_pool_mb — the LRU evicts like any host tier."""
+        if self._host_store is None:
+            self._host_store = HostKVStore(
+                budget_bytes=int(
+                    max(
+                        float(getattr(self.config, "kv_import_pool_mb", 256.0)),
+                        1.0,
+                    )
+                    * 1024
+                    * 1024
+                ),
+                block_nbytes=max(self._block_nbytes, 1),
+                block_size=block_size,
+            )
+
+    def import_session(self, meta: dict, k: Any, v: Any) -> str:
+        """Land a migrated session in this engine's host tier, where the
+        next /generate for its rid promotes it through the swap-in seam
+        (zero re-prefill). Returns "ok", "stale_version" (the KV was
+        computed under a different weight version — the rid is
+        tombstoned so its resume counts an honest miss and re-prefills
+        under the current weights), or "rejected" (malformed/budget).
+        """
+        if self._alloc is None or self._k_cache is None:
+            return "rejected"
+        try:
+            rid = str(meta["rid"])
+            covered = int(meta["covered"])
+            nb = int(meta["nb"])
+            tokens = [int(t) for t in meta["tokens"]]
+            wv = int(meta.get("weight_version", -1))
+            base_key = np.asarray(meta["base_key"], dtype=np.uint32)
+            k = np.asarray(k)
+            v = np.asarray(v)
+        except (KeyError, TypeError, ValueError):
+            return "rejected"
+        L, _, bs, nkv, hd = self._k_cache.shape
+        if (
+            k.shape != (L, nb, bs, nkv, hd)
+            or v.shape != k.shape
+            or base_key.shape != (2,)
+            or covered <= 0
+            or len(tokens) != covered
+            or self._alloc.blocks_for(covered) != nb
+        ):
+            return "rejected"
+        if wv >= 0 and wv != self._version:
+            # migration raced a weight commit: resuming on this KV would
+            # emit tokens the current policy never produced — reject as
+            # an honest miss (the tombstone makes the resume lookup count
+            # it) and let the resume re-prefill under the new weights
+            with self._host_lock:
+                self._ensure_host_store_locked(bs)
+                self._host_store.tombstone(rid)
+            with self._metrics_lock:
+                self._n_migrate_version_rejects += 1
+            logger.warning(
+                f"kv import of {rid} rejected: weight version {wv} != "
+                f"{self._version}"
+            )
+            return "stale_version"
+        entry = HostKVEntry(
+            rid=rid,
+            k=k,
+            v=v,
+            nb=nb,
+            covered=covered,
+            tokens=tokens,
+            rope_delta=int(meta.get("rope_delta", 0)),
+            base_key=base_key,
+            weight_version=wv,
+            ts=time.monotonic(),
+            pending=False,
+        )
+        with self._host_lock:
+            self._ensure_host_store_locked(bs)
+            ok = self._host_store.put(entry)
+        if not ok:
+            return "rejected"
+        with self._metrics_lock:
+            self._n_migrated_in += 1
+            self._migrated_in_bytes += k.nbytes + v.nbytes
+        return "ok"
+
     # -- weight updates -------------------------------------------------
     def _invalidate_parked(self) -> None:
         """Drop every parked KV cache.
@@ -3776,6 +4142,19 @@ class JaxDecodeEngine(InferenceEngine):
         return self._version
 
     # -- observability --------------------------------------------------
+    def reset_timing_windows(self) -> None:
+        """Clear the rolling ITL windows and busy/idle accumulators.
+        Bench hygiene: call on an IDLE engine between a warmup phase and
+        a measured trace, so the reported percentiles describe the trace
+        alone. Counters (tokens, prefills, migrations) are untouched —
+        those are deltas the caller snapshots."""
+        with self._metrics_lock:
+            self._chunk_itl_ms.clear()
+            self._chunk_wall_itl_ms.clear()
+            self._dev_busy_s = 0.0
+            self._dev_idle_s = 0.0
+            self._last_ready_t = None
+
     def get_metrics(self) -> dict:
         """Live load/latency counters for the decode server's /metrics and
         the router's least-token-usage policy (parity: the per-server token
@@ -3810,6 +4189,7 @@ class JaxDecodeEngine(InferenceEngine):
         # prevents torn busy/idle pairs and mid-append deque iteration.
         with self._metrics_lock:
             itl = np.asarray(self._chunk_itl_ms, dtype=np.float64)
+            itl_wall = np.asarray(self._chunk_wall_itl_ms, dtype=np.float64)
             span = self._dev_busy_s + self._dev_idle_s
             dev_busy_s = self._dev_busy_s
             dev_idle_s = self._dev_idle_s
@@ -3823,6 +4203,19 @@ class JaxDecodeEngine(InferenceEngine):
             spec_drafted = self._spec_drafted
             spec_accepted = self._spec_accepted
             spec_rejected = self._spec_rejected
+            ttft_queue = np.asarray(self._ttft_queue_ms, dtype=np.float64)
+            ttft_prefill = np.asarray(self._ttft_prefill_ms, dtype=np.float64)
+            ttft_transfer = np.asarray(
+                self._ttft_transfer_ms, dtype=np.float64
+            )
+            queue_secs_total = self._queue_secs_total
+            prefill_secs_total = self._prefill_secs_total
+            transfer_secs_total = self._transfer_secs_total
+            migrated_in = self._n_migrated_in
+            migrated_out = self._n_migrated_out
+            migrated_in_bytes = self._migrated_in_bytes
+            migrated_out_bytes = self._migrated_out_bytes
+            migrate_version_rejects = self._n_migrate_version_rejects
         # host-KV-tier snapshot (own lock — rank 25, before _metrics at
         # 30): occupancy + swap traffic are the pressure signals the
         # prefix-aware router will route on, next to
@@ -3845,12 +4238,14 @@ class JaxDecodeEngine(InferenceEngine):
                     evictions=hs.evictions,
                     rejected=hs.rejected_puts,
                     avoided=hs.reprefill_tokens_avoided,
+                    version_rejects=hs.version_rejects,
                 )
             else:
                 host = dict(
                     enabled=False, budget_bytes=0, bytes_used=0, entries=0,
                     resident_tokens=0, occupancy=0.0, swap_out=0, swap_in=0,
                     hits=0, misses=0, evictions=0, rejected=0, avoided=0,
+                    version_rejects=0,
                 )
         host_lookups = host["hits"] + host["misses"]
         # prefix-cache hit rate: admissions served by KV reuse (fork /
@@ -3877,6 +4272,62 @@ class JaxDecodeEngine(InferenceEngine):
             ),
             "itl_p50_ms": float(np.percentile(itl, 50)) if itl.size else 0.0,
             "itl_p99_ms": float(np.percentile(itl, 99)) if itl.size else 0.0,
+            # WALL inter-token latency (ready→ready per emitted token):
+            # includes the host gap between chunks, where a co-located
+            # scheduler serializes prompt prefills in front of every
+            # resident decode slot — the head-of-line number the
+            # disaggregated decode role keeps flat
+            "itl_wall_p50_ms": (
+                float(np.percentile(itl_wall, 50)) if itl_wall.size else 0.0
+            ),
+            "itl_wall_p99_ms": (
+                float(np.percentile(itl_wall, 99)) if itl_wall.size else 0.0
+            ),
+            # TTFT decomposition (disaggregation observability): queue =
+            # enqueue→admission wait, prefill = prompt prefill dispatch
+            # wall, transfer = host-tier/migration swap-in wall — a
+            # migrated session's TTFT trades its prefill share for a
+            # (much smaller) transfer share. Percentiles over the recent
+            # window + monotonic totals.
+            "ttft_queue_p50_ms": (
+                float(np.percentile(ttft_queue, 50)) if ttft_queue.size else 0.0
+            ),
+            "ttft_queue_p99_ms": (
+                float(np.percentile(ttft_queue, 99)) if ttft_queue.size else 0.0
+            ),
+            "ttft_prefill_p50_ms": (
+                float(np.percentile(ttft_prefill, 50))
+                if ttft_prefill.size
+                else 0.0
+            ),
+            "ttft_prefill_p99_ms": (
+                float(np.percentile(ttft_prefill, 99))
+                if ttft_prefill.size
+                else 0.0
+            ),
+            "ttft_transfer_p50_ms": (
+                float(np.percentile(ttft_transfer, 50))
+                if ttft_transfer.size
+                else 0.0
+            ),
+            "ttft_transfer_p99_ms": (
+                float(np.percentile(ttft_transfer, 99))
+                if ttft_transfer.size
+                else 0.0
+            ),
+            "queue_secs_total": round(queue_secs_total, 6),
+            "prefill_secs_total": round(prefill_secs_total, 6),
+            "transfer_secs_total": round(transfer_secs_total, 6),
+            # cross-replica KV migration (role fleets / drain): sessions
+            # + bytes in/out, and imports refused on a weight-version
+            # mismatch (the racing-commit case — honest misses)
+            "role": getattr(self.config, "role", "unified"),
+            "kv_migrated_in_sessions_total": migrated_in,
+            "kv_migrated_out_sessions_total": migrated_out,
+            "kv_migrated_in_bytes_total": migrated_in_bytes,
+            "kv_migrated_out_bytes_total": migrated_out_bytes,
+            "kv_migrate_version_rejects_total": migrate_version_rejects,
+            "kv_host_version_rejects_total": host["version_rejects"],
             "prefills_total": self._n_prefills,
             "prefix_forks_total": self._n_prefix_forks,
             "prefix_inplace_total": self._n_prefix_inplace,

@@ -201,6 +201,13 @@ class HostKVEntry:
     base_key: np.ndarray  # the slot's sampling base key (uint32 [2]) —
     # restored at promotion so the resumed stream keeps sampling with
     # fold_in(original_key, position): bit-identical to never-evicted
+    # Weight version the KV was computed under. Local entries can never go
+    # stale (weight installs clear the store), but a MIGRATED entry can
+    # race a weight commit on the receiving replica — `match` rejects a
+    # version-mismatched entry as an honest miss rather than resuming a
+    # stream the new policy never produced (extends PR 7's install-flush
+    # tombstone rule across replicas). -1 = unknown (legacy callers).
+    weight_version: int = -1
     ts: float = 0.0
     nbytes: int = 0
     pending: bool = field(default=False, repr=False)
@@ -270,15 +277,30 @@ class HostKVStore:
         self.evictions = 0
         self.rejected_puts = 0
         self.reprefill_tokens_avoided = 0
+        # entries dropped at lookup because their weight_version no longer
+        # matches the engine's (migration raced a weight commit); each is
+        # also counted in `misses` — the split exists for observability
+        self.version_rejects = 0
 
     def __len__(self) -> int:
         return len(self._entries)
+
+    def rids(self) -> list[str]:
+        """Resident entry rids, LRU-first (drain/migration enumerates
+        these to stream every host-resident session to a survivor)."""
+        return list(self._entries)
 
     def resident_tokens(self) -> int:
         return sum(e.covered for e in self._entries.values())
 
     def occupancy(self) -> float:
         return self.bytes_used / self.budget_bytes if self.budget_bytes else 0.0
+
+    def tombstone(self, rid: str) -> None:
+        """Mark `rid` known-but-unusable (e.g. a version-rejected import):
+        its next exact-resume lookup counts an honest miss instead of
+        silently falling through to a fresh-request re-prefill."""
+        self._tombstone(rid)
 
     # -- internals ------------------------------------------------------
     def _tombstone(self, rid: str) -> None:
@@ -337,16 +359,35 @@ class HostKVStore:
         return True
 
     # -- promotion (swap-in) -------------------------------------------
-    def match(self, rid: str, covered: int, tokens: list[int]) -> bool:
+    def match(
+        self,
+        rid: str,
+        covered: int,
+        tokens: list[int],
+        weight_version: int | None = None,
+    ) -> bool:
         """Exact-resume peek: does an entry cover precisely `tokens`?
         Counts a MISS (and drops the stale entry) when the rid was
         offloaded but can no longer serve this resume; counts nothing for
-        rids that were never offloaded."""
+        rids that were never offloaded. `weight_version` (the engine's
+        current version) additionally rejects entries whose KV was
+        computed under different weights — a migrated entry racing a
+        weight commit must re-prefill under the new policy, not resume a
+        stream it never produced."""
         e = self._entries.get(rid)
         if e is None:
             if rid in self._tombstones:
                 del self._tombstones[rid]
                 self.misses += 1
+            return False
+        if (
+            weight_version is not None
+            and e.weight_version >= 0
+            and e.weight_version != weight_version
+        ):
+            self._drop(rid, tombstone=False)
+            self.misses += 1
+            self.version_rejects += 1
             return False
         if e.covered == covered and e.tokens == tokens:
             return True

@@ -29,6 +29,34 @@ Endpoints:
   POST /abort_weights             {"push_id"} — drop staging for a failed push
   POST /set_version               {"version": N}
 
+Disaggregated prefill/decode (--role {unified,prefill,decode}):
+
+  POST /prefill                   run ONLY the prompt prefill (body like
+                                   /generate + optional "target" decode
+                                   replica + "xid"); the parked session is
+                                   then streamed server→server to the
+                                   target over the KV wire format, where
+                                   it lands in the host tier and the
+                                   client's /generate resumes it with
+                                   ZERO re-prefill. Transfer failures
+                                   degrade: the decode replica simply
+                                   re-prefills (honest miss).
+  POST /kv_recv?xid=ID            one framed KV bucket (pack_kv_session);
+                                   staged per-xid with interval-merged
+                                   coverage — duplicate/re-split retry
+                                   frames are safe, torn frames are
+                                   rejected before a byte stages
+  POST /kv_commit                 {"xid"} — finalize + import the staged
+                                   session(s); idempotent per xid (a
+                                   retried commit replays the cached
+                                   result, never double-imports)
+  POST /drain                     {"targets": [addr...]} — park in-flight
+                                   generations (clients resume via their
+                                   interrupt loop) and stream every
+                                   parked + host-tier session to the
+                                   targets: scale-down without losing a
+                                   single session to re-prefill
+
 Generation runs on the engine's background scheduler thread; the aiohttp
 loop only brokers futures, so thousands of streams multiplex over one
 static-shape decode program.
@@ -138,17 +166,49 @@ class DecodeServer:
         # engine's concurrency).
         self._idem: "OrderedDict[str, dict[str, Any]]" = OrderedDict()
         self._idem_hits = 0
+        # -- cross-replica KV migration state (ISSUE 10) ----------------
+        # All accessed only between awaits on the one aiohttp event loop
+        # (same single-context argument as _idem above — no lock needed).
+        # Per-xid staging for inbound KV sessions: the sender may re-send
+        # every frame on a retry; WeightStaging's interval-merged coverage
+        # absorbs duplicates, and a torn frame is rejected before staging.
+        self._kv_staging: "OrderedDict[str, dict[str, Any]]" = OrderedDict()
+        # xid -> completed /kv_commit response: a retried commit (sender
+        # replaying a migration whose response was lost) returns the
+        # cached result instead of importing twice — the exactly-once
+        # half the sender's full-stream replay relies on.
+        self._kv_done: "OrderedDict[str, dict[str, Any]]" = OrderedDict()
+        self._migrate_stats = dict(
+            out_sessions=0,
+            out_bytes=0,
+            out_failures=0,
+            in_frames=0,
+            in_commits=0,
+            commit_dedups=0,
+            transfer_secs=0.0,
+        )
 
     # -- handlers -------------------------------------------------------
     async def _health(self, request: web.Request) -> web.Response:
         return web.json_response(
-            {"status": "ok", "version": self.engine.get_version()}
+            {
+                "status": "ok",
+                "version": self.engine.get_version(),
+                # the router's role-aware scheduler reads this: prefill
+                # replicas are picked by prefix affinity, decode replicas
+                # by kv-pool headroom
+                "role": getattr(self.config, "role", "unified"),
+            }
         )
 
     async def _info(self, request: web.Request) -> web.Response:
         return web.json_response(
             {
                 "model_path": self.config.model_path,
+                "role": getattr(self.config, "role", "unified"),
+                "kv_migrate_chunk_mb": getattr(
+                    self.config, "kv_migrate_chunk_mb", 64.0
+                ),
                 "context_length": self.config.context_length,
                 "max_running_requests": self.config.max_running_requests,
                 "decode_runahead_chunks": self.config.decode_runahead_chunks,
@@ -263,6 +323,16 @@ class DecodeServer:
         # prevented (the exactly-once evidence bench --mode fleet reads)
         out["idem_entries"] = len(self._idem)
         out["idem_hits_total"] = self._idem_hits
+        # KV-migration observability (server side): sessions/bytes
+        # streamed out, inbound frames/commits, commit dedups (the
+        # exactly-once evidence), and abandoned transfers (degraded to
+        # re-prefill). The engine's own kv_migrated_* counters sit next
+        # to these at the top level.
+        out["kv_migrate"] = dict(
+            self._migrate_stats,
+            staging_xids=len(self._kv_staging),
+            done_xids=len(self._kv_done),
+        )
         return web.json_response(out)
 
     async def _pause(self, request: web.Request) -> web.Response:
@@ -504,6 +574,311 @@ class DecodeServer:
                 self._sync_stats["aborted_pushes"] += 1
         return web.json_response({"status": "ok", "dropped": dropped})
 
+    # -- disaggregated prefill/decode: KV-session migration -------------
+    # Transfer shape mirrors the weight push (frames -> staging -> one
+    # commit) because it IS the same plumbing: pack_kv_session frames ride
+    # WeightStaging's interval-merged coverage, so the sender's recovery
+    # story is "replay the whole session under the same xid" — duplicate
+    # frames merge, the commit dedups, and the handoff lands exactly once.
+    _MIGRATE_TIMEOUT_S = 60.0
+    _KV_STAGING_MAX = 64
+    _KV_DONE_MAX = 1024
+
+    def _prune_kv_maps(self) -> None:
+        now = time.monotonic()
+        ttl = self.config.idempotency_ttl_s
+        for xid in list(self._kv_done):
+            if now - self._kv_done[xid]["t"] > ttl:
+                del self._kv_done[xid]
+        while len(self._kv_done) > self._KV_DONE_MAX:
+            self._kv_done.popitem(last=False)
+        # staging whose feed went silent is a crashed sender: the replay
+        # (same xid) restarts from an empty staging area harmlessly
+        for xid in list(self._kv_staging):
+            if now - self._kv_staging[xid]["last_t"] > ttl:
+                del self._kv_staging[xid]
+        while len(self._kv_staging) > self._KV_STAGING_MAX:
+            victim, _ = self._kv_staging.popitem(last=False)
+            logger.warning(f"kv staging {victim} dropped (map full)")
+
+    async def _migrate_session_out(
+        self, target: str, rid: str, xid: str, retries: int = 1
+    ) -> dict[str, Any] | None:
+        """Export `rid` and stream it to `target` under delivery id `xid`.
+
+        The export MOVES the session out of this engine first; a transfer
+        that fails past its replay budget therefore degrades to a
+        re-prefill on whichever replica the session resumes on — never a
+        wedged handler. One full-stream replay (same xid) covers a
+        mid-transfer death: re-sent frames interval-merge and the commit
+        is idempotent, so the handoff lands exactly once."""
+        from areal_tpu.core.weight_transfer import pack_kv_session
+        from areal_tpu.utils.http import arequest_with_retry
+
+        loop = asyncio.get_running_loop()
+        sess = await loop.run_in_executor(
+            None, self.engine.export_session, rid
+        )
+        if sess is None:
+            return None
+        frames = list(
+            pack_kv_session(
+                sess["meta"],
+                sess["k"],
+                sess["v"],
+                chunk_mb=getattr(self.config, "kv_migrate_chunk_mb", 64.0),
+            )
+        )
+        nbytes = sum(len(f) for f in frames)
+        t0 = time.monotonic()
+        last: Exception | None = None
+        for attempt in range(retries + 1):
+            try:
+                for frame in frames:
+                    # send seam: an abort models the sender dying
+                    # mid-stream — the replay (same xid) must land the
+                    # session exactly once
+                    await fault_injection.afire(
+                        "kv.migrate.send",
+                        rid=rid, xid=xid, target=target, attempt=attempt,
+                    )
+                    await arequest_with_retry(
+                        target,
+                        f"/kv_recv?xid={xid}",
+                        data=frame,
+                        max_retries=2,
+                        timeout=self._MIGRATE_TIMEOUT_S,
+                    )
+                out = await arequest_with_retry(
+                    target,
+                    "/kv_commit",
+                    payload={"xid": xid, "rid": rid},
+                    max_retries=2,
+                    timeout=self._MIGRATE_TIMEOUT_S,
+                )
+                dt = time.monotonic() - t0
+                self._migrate_stats["out_sessions"] += 1
+                self._migrate_stats["out_bytes"] += nbytes
+                self._migrate_stats["transfer_secs"] += dt
+                return {"bytes": nbytes, "secs": dt, "commit": out}
+            except Exception as e:  # noqa: BLE001 — replay, then degrade
+                last = e
+                if attempt < retries:
+                    logger.warning(
+                        f"kv migration of {rid} to {target} failed "
+                        f"({e!r}); replaying under xid {xid}"
+                    )
+        self._migrate_stats["out_failures"] += 1
+        logger.warning(
+            f"kv migration of {rid} to {target} abandoned ({last!r}); "
+            "the session resumes with a re-prefill"
+        )
+        return None
+
+    async def _prefill(self, request: web.Request) -> web.Response:
+        """Prefill-only generation (the prefill role's hot path): run the
+        prompt, park the KV, optionally hand the session to a decode
+        replica. Idempotent per xid like /generate."""
+        body = await request.json()
+        xid = body.get("xid")
+        await fault_injection.afire(
+            "server.prefill",
+            rid=str(body.get("rid") or ""), xid=str(xid or ""),
+            addr=str(self.addr or ""),
+        )
+        if xid is not None:
+            ent = self._idem.get(xid)
+            if ent is not None:
+                self._idem_hits += 1
+                if ent["done"]:
+                    self._idem.move_to_end(xid)
+                    return web.json_response(
+                        {**ent["resp"], "dedup": "completed"}
+                    )
+                out = await asyncio.shield(ent["fut"])
+                return web.json_response({**out, "dedup": "in_progress"})
+            ent = {
+                "done": False,
+                "fut": asyncio.get_running_loop().create_future(),
+                "t": time.monotonic(),
+            }
+            self._idem[xid] = ent
+        req = ModelRequest(
+            rid=body.get("rid") or ModelRequest().rid,
+            input_ids=[int(t) for t in body["input_ids"]],
+            gconfig=_parse_gconfig(body.get("gconfig", {})),
+            image_data=body.get("image_data"),
+        )
+        target = body.get("target")
+        try:
+            resp = await self.engine.aprefill(req)
+            out: dict[str, Any] = {
+                "status": "ok",
+                "stop_reason": resp.stop_reason,
+                "latency": resp.latency,
+                "migrated": False,
+                "kv_bytes": 0,
+            }
+            if target and target != self.addr:
+                moved = await self._migrate_session_out(
+                    target, req.rid, xid or f"pf-{req.rid}"
+                )
+                if moved is not None:
+                    out["migrated"] = True
+                    out["kv_bytes"] = moved["bytes"]
+                    out["transfer_secs"] = moved["secs"]
+        except BaseException as e:
+            if xid is not None and self._idem.get(xid) is ent:
+                del self._idem[xid]
+                if not ent["fut"].done():
+                    ent["fut"].set_exception(e)
+                    ent["fut"].exception()
+            raise
+        if xid is not None and self._idem.get(xid) is ent:
+            self._idem[xid] = {"done": True, "resp": out, "t": time.monotonic()}
+            self._idem.move_to_end(xid)
+            if not ent["fut"].done():
+                ent["fut"].set_result(out)
+            self._prune_idem()
+        return web.json_response(out)
+
+    async def _kv_recv(self, request: web.Request) -> web.Response:
+        """Stage one inbound KV frame under its migration xid."""
+        payload = await request.read()
+        xid = request.query.get("xid") or ""
+        if not xid:
+            return web.json_response(
+                {"status": "error", "message": "xid required"}, status=400
+            )
+        # recv seam: an abort models the receiver dying with the frame in
+        # hand; torn truncates it in flight — the manifest length-check
+        # rejects the torn frame (500) and the sender's frame retry
+        # re-covers the byte ranges
+        await fault_injection.afire(
+            "kv.migrate.recv", xid=xid, addr=str(self.addr or "")
+        )
+        payload = fault_injection.tear("kv.migrate.recv", payload, xid=xid)
+        if xid in self._kv_done:
+            # straggler frame of an already-committed migration (the
+            # sender replayed after losing the commit response): drop it,
+            # the commit retry will hit the dedup cache
+            return web.json_response({"status": "ok", "staged": 0})
+        ent = self._kv_staging.get(xid)
+        if ent is None:
+            from areal_tpu.core.weight_transfer import WeightStaging
+
+            ent = {"staging": WeightStaging(), "t0": time.monotonic()}
+            self._kv_staging[xid] = ent
+        ent["last_t"] = time.monotonic()
+        ent["staging"].add_bucket(payload)  # torn frame -> ValueError -> 500
+        self._migrate_stats["in_frames"] += 1
+        self._prune_kv_maps()
+        return web.json_response(
+            {"status": "ok", "staged": len(ent["staging"])}
+        )
+
+    async def _kv_commit(self, request: web.Request) -> web.Response:
+        """Finalize + import a staged migration; idempotent per xid."""
+        body = await request.json()
+        xid = str(body.get("xid") or "")
+        done = self._kv_done.get(xid)
+        if done is not None:
+            # the sender lost our response and replayed: never import twice
+            self._kv_done.move_to_end(xid)
+            self._migrate_stats["commit_dedups"] += 1
+            return web.json_response({**done["resp"], "dedup": True})
+        ent = self._kv_staging.get(xid)
+        if ent is None:
+            return web.json_response(
+                {"status": "error", "message": f"no staged kv for {xid!r}"},
+                status=400,
+            )
+        from areal_tpu.core.weight_transfer import unpack_kv_sessions
+
+        try:
+            sessions = unpack_kv_sessions(ent["staging"].finalize())
+            if not sessions:
+                raise ValueError("no complete kv session staged")
+        except (RuntimeError, ValueError) as e:
+            # incomplete/malformed/empty: KEEP the staging so the
+            # sender's replay can top up the missing byte ranges and
+            # re-commit
+            return web.json_response(
+                {"status": "error", "message": str(e)}, status=400
+            )
+        del self._kv_staging[xid]
+        loop = asyncio.get_running_loop()
+        t0 = time.monotonic()
+        counts = {"ok": 0, "stale_version": 0, "rejected": 0}
+        rids = []
+        for meta, k, v in sessions:
+            verdict = await loop.run_in_executor(
+                None, self.engine.import_session, meta, k, v
+            )
+            counts[verdict] = counts.get(verdict, 0) + 1
+            if verdict == "ok":
+                rids.append(meta["rid"])
+        resp = {
+            "status": "ok",
+            "imported": counts["ok"],
+            "stale_version": counts["stale_version"],
+            "rejected": counts["rejected"],
+            "rids": rids,
+        }
+        self._kv_done[xid] = {"resp": resp, "t": time.monotonic()}
+        self._migrate_stats["in_commits"] += 1
+        self._migrate_stats["transfer_secs"] += time.monotonic() - t0
+        self._prune_kv_maps()
+        return web.json_response(resp)
+
+    async def _drain(self, request: web.Request) -> web.Response:
+        """Stream every resumable session to the target replicas (scale-
+        down / maintenance): in-flight generations are parked first (their
+        clients resume through the interrupt loop and the router lands
+        them on a survivor, where the migrated KV makes the resume a
+        zero-re-prefill promotion)."""
+        import uuid as _uuid
+
+        body = await request.json()
+        targets = [t for t in body.get("targets") or [] if t and t != self.addr]
+        if not targets:
+            return web.json_response(
+                {"status": "error", "message": "targets required"}, status=400
+            )
+        loop = asyncio.get_running_loop()
+        async with self._ctl_lock:
+            await loop.run_in_executor(None, self.engine.pause_generation)
+            aborted = (
+                self.engine.abort_all()
+                if body.get("abort_active", True)
+                else 0
+            )
+            if not self._client_paused:
+                self.engine.continue_generation()
+        rids = self.engine.list_exportable_sessions()
+        drained = failed = 0
+        total_bytes = 0
+        for i, rid in enumerate(rids):
+            xid = f"drain-{_uuid.uuid4().hex[:12]}"
+            moved = await self._migrate_session_out(
+                targets[i % len(targets)], rid, xid
+            )
+            if moved is None:
+                failed += 1
+            else:
+                drained += 1
+                total_bytes += moved["bytes"]
+        return web.json_response(
+            {
+                "status": "ok",
+                "aborted": aborted,
+                "sessions": len(rids),
+                "drained": drained,
+                "failed": failed,
+                "bytes": total_bytes,
+            }
+        )
+
     # -- lifecycle ------------------------------------------------------
     def build_app(self) -> web.Application:
         app = web.Application(client_max_size=1024**3)
@@ -522,6 +897,10 @@ class DecodeServer:
         app.router.add_post("/commit_weights", self._commit_weights)
         app.router.add_post("/abort_weights", self._abort_weights)
         app.router.add_post("/set_version", self._set_version)
+        app.router.add_post("/prefill", self._prefill)
+        app.router.add_post("/kv_recv", self._kv_recv)
+        app.router.add_post("/kv_commit", self._kv_commit)
+        app.router.add_post("/drain", self._drain)
         return app
 
     async def start(
@@ -585,6 +964,9 @@ async def _serve(args: argparse.Namespace) -> None:
     config = JaxDecodeConfig(
         model_path=args.model_path,
         dtype=args.dtype,
+        role=args.role,
+        kv_migrate_chunk_mb=args.kv_migrate_chunk_mb,
+        kv_import_pool_mb=args.kv_import_pool_mb,
         context_length=args.context_length,
         max_running_requests=args.max_running_requests,
         new_tokens_per_chunk=args.new_tokens_per_chunk,
@@ -667,6 +1049,32 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(description="areal_tpu decode server")
     p.add_argument("--model-path", default="")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument(
+        "--role",
+        default="unified",
+        choices=["unified", "prefill", "decode"],
+        help="disaggregated fleet role: 'prefill' replicas run prompt "
+             "prefills (/prefill) and stream the KV to decode replicas "
+             "over the bucketed KV wire; 'decode' replicas import those "
+             "sessions and resume them with zero re-prefill; 'unified' "
+             "(default) does both. Roles steer the router — every role "
+             "still serves every endpoint, so a degraded fleet keeps "
+             "working",
+    )
+    p.add_argument(
+        "--kv-migrate-chunk-mb",
+        type=float,
+        default=64.0,
+        help="frame size (MiB per HTTP body) for migrated KV sessions",
+    )
+    p.add_argument(
+        "--kv-import-pool-mb",
+        type=float,
+        default=256.0,
+        help="host-tier budget (MiB) created lazily when a migration "
+             "arrives while --kv-host-pool-mb is 0 — imported sessions "
+             "need a host tier to land in",
+    )
     p.add_argument("--context-length", type=int, default=32768)
     p.add_argument("--max-running-requests", type=int, default=64)
     p.add_argument("--new-tokens-per-chunk", type=int, default=128)

@@ -250,6 +250,17 @@ def run_experiment(
                 else 0
             )
             gen_tp = alloc.gen.tp_size if alloc.gen is not None else 1
+            # Disaggregated role fleet (launcher.prefill_replicas): the
+            # first K replicas launch as prefill (compute-bound, stream KV
+            # out), the rest as decode (memory-bound, import + resume).
+            n_prefill = int(
+                getattr(config.launcher, "prefill_replicas", 0) or 0
+            )
+            if n_prefill and n_prefill >= n_servers:
+                raise ValueError(
+                    f"launcher.prefill_replicas={n_prefill} must leave at "
+                    f"least one decode replica (gen dp = {n_servers})"
+                )
             for i in range(n_servers):
                 env = {}
                 if n_servers > 1 or gen_tp > 1:
@@ -275,6 +286,17 @@ def run_experiment(
                     "--dtype", dec.dtype,
                     "--seed", str(dec.random_seed),
                 ]
+                if n_prefill:
+                    role = "prefill" if i < n_prefill else "decode"
+                    extra += ["--role", role]
+                    if role == "decode" and float(
+                        getattr(dec, "kv_host_pool_mb", 0.0)
+                    ) > 0:
+                        extra += [
+                            "--kv-host-pool-mb", str(dec.kv_host_pool_mb)
+                        ]
+                elif getattr(dec, "role", "unified") != "unified":
+                    extra += ["--role", dec.role]
                 from areal_tpu.models.smoke import OFFLINE_SENTINELS
 
                 if model_path in OFFLINE_SENTINELS:

@@ -102,6 +102,7 @@ _GUARDED_BY = {
     "DecodeRouter._submitted": "_lock",
     "DecodeRouter._accepted": "_lock",
     "DecodeRouter._breaker": "_lock",
+    "DecodeRouter._roles": "_lock",
 }
 
 # /metrics keys the admission controller snapshots per replica
@@ -118,6 +119,17 @@ _PRESSURE_KEYS = (
     "kv_host_pool_enabled",
     "kv_host_pool_occupancy",
     "prefix_cache_hit_rate",
+    # disaggregation observability: replica role + cross-replica KV
+    # migration traffic, surfaced per-replica in the pressure snapshots
+    # and summed fleet-wide on the router's /metrics
+    "role",
+    "kv_migrated_in_sessions_total",
+    "kv_migrated_out_sessions_total",
+    "kv_migrated_in_bytes_total",
+    "kv_migrated_out_bytes_total",
+    "kv_migrate_version_rejects_total",
+    "ttft_prefill_p99_ms",
+    "ttft_transfer_p99_ms",
 )
 
 
@@ -203,7 +215,12 @@ class DecodeRouter:
             breaker_probes_total=0,
             breaker_closes_total=0,
             deadline_sheds_total=0,
+            disagg_schedules_total=0,
         )
+        # replica role ("unified" | "prefill" | "decode"), learned from
+        # each /health poll: a disaggregated fleet schedules prefill by
+        # prefix affinity and decode by kv-pool headroom
+        self._roles: dict[str, str] = {}
         # per-replica circuit breaker (slow/erroring replicas are probed,
         # not hammered): state in {"closed", "open", "half_open"}, `bad` =
         # consecutive bad polls, `probes` = in-flight half-open probe
@@ -261,9 +278,13 @@ class DecodeRouter:
                             max_retries=1,
                         )
                         version = int(data.get("version", 0))
+                        role = str(data.get("role", "unified"))
                     except Exception:  # noqa: BLE001 — dead server drops out
                         logger.warning(f"server {s} failed health poll")
-                        return s, None, None, 0.0, None, time.monotonic() - t0
+                        return (
+                            s, None, None, 0.0, None,
+                            time.monotonic() - t0, "unified",
+                        )
                     rtt = time.monotonic() - t0
                     est_snapshot = self._est_since_poll[s]
                     try:
@@ -291,7 +312,7 @@ class DecodeRouter:
                         logger.debug(f"metrics probe of {s} failed: {e!r}")
                         load = None
                         pressure = None
-                    return s, version, load, est_snapshot, pressure, rtt
+                    return s, version, load, est_snapshot, pressure, rtt, role
 
                 # fan out: one hung server must not stale the whole fleet's
                 # measurements for its full timeout
@@ -314,8 +335,14 @@ class DecodeRouter:
         self._versions = versions
         for p in probes:
             s, v, load, est_snapshot, pressure = p[:5]
-            # probes from older callers (unit tests) may omit the RTT
+            # probes from older callers (unit tests) may omit the RTT/role
             rtt = p[5] if len(p) > 5 else None
+            if v is not None:
+                # role from /health (only live servers update it); the
+                # pressure snapshot below carries it as a per-replica label
+                self._roles[s] = p[6] if len(p) > 6 else "unified"
+                if pressure is not None:
+                    pressure = dict(pressure, role=self._roles[s])
             slow = (
                 self.config.breaker_slow_s > 0
                 and rtt is not None
@@ -507,6 +534,7 @@ class DecodeRouter:
             | set(self._measured_tokens)
             | set(self._pressure)
             | set(self._breaker)
+            | set(self._roles)
         )
         for s in tracked - keep:
             for d in (
@@ -519,6 +547,7 @@ class DecodeRouter:
                 self._pressure,
                 self._versions,
                 self._breaker,
+                self._roles,
             ):
                 d.pop(s, None)
 
@@ -604,16 +633,28 @@ class DecodeRouter:
         nb = min(len(prefix) // block, self.config.prefix_max_blocks)
         return [hash(tuple(prefix[: b * block])) for b in range(nb, 0, -1)]
 
+    def _role_of(self, s: str) -> str:
+        return self._roles.get(s, "unified")
+
     def _pick_locked(
         self, req: dict[str, Any]
-    ) -> tuple[str | None, float]:
-        """Choose a server for `req` -> (addr, prefix_discount_tokens);
-        addr None when no admissible server exists right now (the caller
-        queues). The discount is the prompt work the chosen server SKIPS
-        because it already holds the request's prefix KV (fork / suffix
-        prefill instead of a full prefill) — the accounting charges the
-        marginal cost, not the blind estimate, so affinity does not
-        self-destruct by inflating the affine server's apparent load."""
+    ) -> tuple[str | None, float, str | None]:
+        """Choose server(s) for `req` -> (addr, prefix_discount_tokens,
+        prefill_addr); addr None when no admissible server exists right
+        now (the caller queues). The discount is the prompt work the
+        chosen server SKIPS because it already holds the request's prefix
+        KV (fork / suffix prefill instead of a full prefill) — the
+        accounting charges the marginal cost, not the blind estimate, so
+        affinity does not self-destruct by inflating the affine server's
+        apparent load.
+
+        Disaggregated fleets (prefill-role replicas alive): the request
+        gets BOTH a decode home (picked by kv-pool headroom — the
+        memory-bound resource that actually caps a decode replica) and a
+        prefill replica (picked by prefix affinity — the prefill side is
+        where donor-KV forks save the compute). prefill_addr None means
+        no handoff: the decode server prefills itself, which is also the
+        graceful degradation when every prefill replica is down/hot."""
         qid = req.get("qid")
         prev_url = req.get("previous_server_url")
         prev_version = req.get("previous_version")
@@ -623,20 +664,34 @@ class DecodeRouter:
             and prev_version == self.fleet_version
             and self._breaker_admits(prev_url)
         ):
-            return prev_url, 0.0  # resume with live KV on the same weights
+            # resume with live KV on the same weights: the previous server
+            # already holds the session — a prefill handoff would only
+            # re-compute what is parked there
+            return prev_url, 0.0, None
         if qid and qid in self._qid_to_server:
             cached = self._qid_to_server[qid]
             # a tripped breaker diverts even affine traffic — but the
             # mapping itself survives, so the qid returns home on close
             if cached in self.servers and self._breaker_admits(cached):
-                return cached, 0.0
+                return cached, 0.0, None
         need = self._request_cost(req)
+        prefill_pool = [
+            s for s in self.servers if self._role_of(s) == "prefill"
+        ]
+        decode_pool = [
+            s for s in self.servers if self._role_of(s) != "prefill"
+        ]
+        if prefill_pool and decode_pool:
+            return self._pick_disagg_locked(req, prefill_pool, decode_pool)
         candidates = [s for s in self.servers if self._admissible(s, need)]
         if not candidates:
-            return None, 0.0
+            return None, 0.0, None
         policy = self.schedule_policy
         if policy == "prefix_affinity":
-            return self._pick_prefix_affine_locked(req, candidates, need)
+            addr, discount = self._pick_prefix_affine_locked(
+                req, candidates, need
+            )
+            return addr, discount, None
         if policy == "round_robin":
             addr = candidates[self._rr % len(candidates)]
             self._rr += 1
@@ -648,7 +703,51 @@ class DecodeRouter:
             raise web.HTTPBadRequest(
                 reason=f"unknown schedule policy {policy}"
             )
-        return addr, 0.0
+        return addr, 0.0, None
+
+    def _pick_disagg_locked(
+        self,
+        req: dict[str, Any],
+        prefill_pool: list[str],
+        decode_pool: list[str],
+    ) -> tuple[str | None, float, str | None]:
+        """Role-aware pick: decode home by kv-pool headroom, prefill by
+        prefix affinity. A handed-off request costs the decode replica
+        only its DECODE share (the prompt KV arrives over the wire), so
+        the decode accounting discounts the full prompt."""
+        prompt_cost = float(req.get("prompt_len", 0))
+        decode_need = max(self._request_cost(req) - prompt_cost, 0.0)
+        decode_cands = [
+            s for s in decode_pool if self._admissible(s, decode_need)
+        ]
+        if not decode_cands:
+            return None, 0.0, None
+        headrooms = {
+            s: self._kv_headroom(s, decode_need) for s in decode_cands
+        }
+        if all(h is not None for h in headrooms.values()):
+            # memory-bound role: the replica with the most pool headroom
+            # absorbs the longest-lived KV working set
+            addr = max(decode_cands, key=lambda s: headrooms[s])
+        else:
+            addr = min(decode_cands, key=self._token_load)
+        prefill_cands = [
+            s for s in prefill_pool if self._admissible(s, prompt_cost)
+        ]
+        prefill_addr = None
+        if prefill_cands:
+            # compute-bound role: prefix affinity lands GRPO siblings /
+            # session turns where their donor KV already sits, turning
+            # full prefills into forks/suffix passes
+            prefill_addr, _ = self._pick_prefix_affine_locked(
+                req, prefill_cands, prompt_cost
+            )
+            # transient charge, self-correcting at the next metrics poll
+            # (the prefill replica's own /metrics absorbs the real load)
+            self._est_since_poll[prefill_addr] += prompt_cost
+        discount = prompt_cost if prefill_addr is not None else 0.0
+        self._counters["disagg_schedules_total"] += 1
+        return addr, discount, prefill_addr
 
     def _pick_prefix_affine_locked(
         self, req: dict[str, Any], candidates: list[str], need: float
@@ -693,7 +792,7 @@ class DecodeRouter:
 
     def _try_schedule_locked(self, req: dict[str, Any]) -> dict[str, Any] | None:
         """Pick + account, or None when every replica is saturated."""
-        addr, discount = self._pick_locked(req)
+        addr, discount, prefill_addr = self._pick_locked(req)
         if addr is None:
             return None
         qid = req.get("qid")
@@ -708,7 +807,13 @@ class DecodeRouter:
             self._qid_cost[qid] = self._qid_cost.get(qid, 0.0) + cost
             self._qid_pending[qid] = self._qid_pending.get(qid, 0) + 1
             self._qid_touched[qid] = time.monotonic()
-        return {"url": addr, "version": self.fleet_version}
+        out = {"url": addr, "version": self.fleet_version}
+        if prefill_addr is not None:
+            # disaggregated fleet: the client runs the prompt on this
+            # replica first (/prefill streams the KV to `url`), then
+            # /generate on `url` resumes it with zero re-prefill
+            out["prefill_url"] = prefill_addr
+        return out
 
     def _drain_queue_locked(self) -> None:
         """Admit queued requests in FIFO order while pressure allows; an
@@ -888,10 +993,24 @@ class DecodeRouter:
         async with self._lock:
             sched = self._counters["schedules_total"]
             hits = self._counters["affinity_hits_total"]
+            # fleet-wide KV migration traffic, summed from the replicas'
+            # pressure snapshots ("migrated" = sessions landed in a host
+            # tier after a prefill handoff or a drain)
+            mig_sessions = sum(
+                int(p.get("kv_migrated_in_sessions_total", 0) or 0)
+                for p in self._pressure.values()
+            )
+            mig_bytes = sum(
+                int(p.get("kv_migrated_in_bytes_total", 0) or 0)
+                for p in self._pressure.values()
+            )
             return web.json_response(
                 {
                     "schedule_policy": self.schedule_policy,
                     "servers": self.servers,
+                    "roles": {s: self._role_of(s) for s in self.servers},
+                    "kv_migrated_sessions_total": mig_sessions,
+                    "kv_migrated_bytes_total": mig_bytes,
                     "queue_depth": sum(
                         1 for w in self._waitq if not w.fut.done()
                     ),
@@ -989,6 +1108,10 @@ def main(argv: list[str] | None = None) -> None:
         "--route-ttl-s", type=float, default=defaults.route_ttl_s
     )
     args = p.parse_args(argv)
+    # join the experiment's shared discovery store (launcher-provided env)
+    # — without this a standalone router process can neither discover the
+    # decode servers nor register its own address for the clients
+    name_resolve.reconfigure_from_env()
 
     async def _serve():
         router = DecodeRouter(

@@ -1101,6 +1101,528 @@ def bench_fleet(model, n_replicas, n_groups, group_size, prompt_len,
     )
 
 
+def bench_disagg(model, n_decode_reqs, n_prefill_reqs, prompt_short,
+                 prompt_long, new_tokens, max_running, chunk=None,
+                 drain_sessions=4, drain_prompt=96, drain_tokens=48):
+    """Disaggregated prefill/decode bench (ISSUE 10).
+
+    Leg 1 — head-of-line ITL: a mixed trace of decode-heavy requests
+    (short prompt, long generation) and prefill-heavy requests (long
+    prompt, tiny generation) replayed against two equal-size fleets:
+
+      * DISAGG: 1 prefill-role + 1 decode-role replica. The router sends
+        every prompt to the prefill replica (prefix affinity), which
+        streams the finished KV server->server to the decode replica
+        (host-tier import); the decode replica's scheduler NEVER runs a
+        transformer prefill between decode chunks.
+      * UNIFIED: 2 unified replicas (the same router, classic policy).
+        Every long prefill runs inside some replica's scheduler loop,
+        stalling every resident decode slot for its duration — the
+        head-of-line hit this bench measures.
+
+    Reported: p50/p99 of per-request mean ITL (client-observed wall,
+    which includes the stalls the engine's device-only ITL hides) for
+    the decode-heavy requests, with the disagg fleet run FIRST so any
+    process-warm advantage goes to the unified baseline. Asserted: every
+    request completes exactly once with its full token budget on both
+    fleets (no lost/duplicated requests).
+
+    Leg 2 — drain migration, per kv layout (paged AND workspace), with
+    half the sessions greedy and half sampled: sessions generate
+    mid-stream on replica A, `/drain` parks them (clients see
+    stop_reason="interrupt") and streams every parked session to
+    replica B, and the resumes run on B. Asserted: B runs ZERO prompt
+    prefills (every resume is a host-tier promotion of the migrated
+    blocks), and partial+resumed streams are BIT-IDENTICAL to a
+    never-interrupted oracle engine (tokens AND logprobs, greedy and
+    sampled)."""
+    import asyncio
+    import threading
+    import uuid as _uuid
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+        RouterConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core.remote_inf_engine import RemoteInfEngine
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.launcher.decode_server import DecodeServer
+    from areal_tpu.launcher.router import DecodeRouter
+    from areal_tpu.utils import name_resolve
+    from areal_tpu.utils.http import arequest_with_retry, close_current_session
+    from areal_tpu.models.qwen2 import init_params
+
+    name_resolve.reconfigure(name_resolve.NameResolveConfig(type="memory"))
+    params = init_params(model, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(31)
+    n_chunk = chunk or min(128, new_tokens)
+    ctx = prompt_long + max(new_tokens, 16) + n_chunk + 128
+    decode_prompts = [
+        rng.randint(1, model.vocab_size, (prompt_short,)).tolist()
+        for _ in range(n_decode_reqs)
+    ]
+    prefill_prompts = [
+        rng.randint(1, model.vocab_size, (prompt_long,)).tolist()
+        for _ in range(n_prefill_reqs)
+    ]
+
+    def _post(addr, ep, payload, timeout=120):
+        async def _p():
+            try:
+                return await arequest_with_retry(
+                    addr, ep, payload=payload, max_retries=1, timeout=timeout
+                )
+            finally:
+                await close_current_session()
+
+        return asyncio.run(_p())
+
+    class _Replica:
+        def __init__(self, role="unified", prewarm_plans=(), kv_layout="paged",
+                     host_mb=0.0, seed=1):
+            dcfg = JaxDecodeConfig(
+                context_length=ctx,
+                max_running_requests=max_running,
+                new_tokens_per_chunk=n_chunk,
+                dtype=model.dtype,
+                kv_cache_dtype=model.dtype,
+                kv_layout=kv_layout,
+                kv_host_pool_mb=host_mb,
+                role=role,
+                kv_migrate_chunk_mb=8.0,
+                random_seed=seed,
+            )
+            self.engine = JaxDecodeEngine(dcfg, InferenceEngineConfig())
+            self.engine.set_model(params, model)
+            self.engine.initialize()
+            # warm EVERY prompt bucket the trace will hit (short decode
+            # prompts AND long prefill prompts) on every replica of both
+            # fleets, so the timed window measures scheduling, not
+            # first-compiles
+            for plen, wcfg in prewarm_plans:
+                self.engine.prewarm(prompt_len=plen, gconfig=wcfg)
+            # pass the REAL engine config so /health advertises the role
+            self.server = DecodeServer(dcfg, engine=self.engine,
+                                       shutdown_grace=0.5)
+            self.addr = None
+            self._loop = None
+            self._ready = threading.Event()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+            assert self._ready.wait(60), "disagg replica failed to start"
+
+        def _run(self):
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _start():
+                self.addr = await self.server.start(host="127.0.0.1", port=0)
+                self._ready.set()
+
+            self._loop.run_until_complete(_start())
+            self._loop.run_forever()
+
+        def stop(self):
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self.server.stop(), self._loop
+                ).result(30)
+            except Exception as e:  # noqa: BLE001 — already down
+                print(f"[disagg] replica stop: {e!r}", file=sys.stderr)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+            self.engine.destroy()
+
+    class _RouterThread:
+        def __init__(self, servers, exp, trial):
+            self.router = DecodeRouter(
+                exp,
+                trial,
+                servers,
+                config=RouterConfig(
+                    schedule_policy="prefix_affinity",
+                    health_poll_interval=0.25,
+                    queue_timeout_s=60.0,
+                ),
+            )
+            self.addr = None
+            self._loop = None
+            self._ready = threading.Event()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+            assert self._ready.wait(30), "disagg router failed to start"
+
+        def _run(self):
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def _start():
+                self.addr = await self.router.start("127.0.0.1", 0)
+                self._ready.set()
+
+            self._loop.run_until_complete(_start())
+            self._loop.run_forever()
+
+        def stop(self):
+            asyncio.run_coroutine_threadsafe(
+                self.router.stop(), self._loop
+            ).result(30)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10)
+
+    gcfg_decode = GenerationHyperparameters(
+        max_new_tokens=new_tokens, temperature=1.0, top_p=1.0
+    )
+    gcfg_prefill = GenerationHyperparameters(
+        max_new_tokens=8, temperature=1.0, top_p=1.0
+    )
+    # several sequential long prefills per worker keep prefill pressure on
+    # for the WHOLE decode window (one burst would be over before the
+    # decode streams finish on small configs)
+    prefill_turns = 3
+
+    def run_itl_leg(label, replicas):
+        exp, trial = "benchdisagg", f"{label}-{_uuid.uuid4().hex[:6]}"
+        addrs = [r.addr for r in replicas]
+        rt = _RouterThread(addrs, exp, trial)
+        client = RemoteInfEngine(
+            InferenceEngineConfig(
+                experiment_name=exp,
+                trial_name=trial,
+                request_timeout=600,
+                request_retries=1,
+            )
+        )
+        client.addresses = list(addrs)
+        results: dict[str, object] = {}
+        try:
+            time.sleep(0.8)  # >= one poll round: roles + pressure known
+            for r in replicas:
+                # percentiles below must describe the TRACE, not prewarm
+                r.engine.reset_timing_windows()
+            m0s = [r.engine.get_metrics() for r in replicas]
+
+            async def decode_req(i):
+                rid = f"d{i}"
+                r = await client.agenerate(
+                    ModelRequest(
+                        rid=rid, input_ids=decode_prompts[i],
+                        gconfig=gcfg_decode,
+                    )
+                )
+                assert rid not in results, f"duplicate completion {rid}"
+                results[rid] = r
+
+            async def prefill_worker(i):
+                # continuous long-prefill pressure landing MID-decode:
+                # the head-of-line shape a co-located scheduler serializes
+                # in front of every resident decode slot's next chunk
+                await asyncio.sleep(0.02 * i)
+                for t in range(prefill_turns):
+                    rid = f"p{i}-t{t}"
+                    r = await client.agenerate(
+                        ModelRequest(
+                            rid=rid, input_ids=prefill_prompts[i],
+                            gconfig=gcfg_prefill,
+                        )
+                    )
+                    assert rid not in results, f"duplicate completion {rid}"
+                    results[rid] = r
+                    await asyncio.sleep(0.01)
+
+            async def drive():
+                try:
+                    await asyncio.gather(
+                        *[decode_req(i) for i in range(n_decode_reqs)],
+                        *[prefill_worker(i) for i in range(n_prefill_reqs)],
+                    )
+                finally:
+                    await close_current_session()
+
+            t0 = time.perf_counter()
+            asyncio.run(drive())
+            wall = time.perf_counter() - t0
+        finally:
+            rt.stop()
+        # exactly-once: every request completed once with its full budget
+        n_expected = n_decode_reqs + n_prefill_reqs * prefill_turns
+        assert len(results) == n_expected, f"{label}: lost requests"
+        for i in range(n_decode_reqs):
+            r = results[f"d{i}"]
+            assert len(r.output_tokens) == new_tokens, (
+                f"{label}: d{i} truncated ({len(r.output_tokens)})"
+            )
+        # WALL inter-token latency from the engines that actually decode
+        # (ready→ready per emitted token, so the inter-chunk host gap —
+        # where a co-located scheduler serializes long prefills — counts;
+        # client-side latency/ttft can't see this: the remote protocol is
+        # not streaming, so TTFT ≈ latency there). In the disagg fleet
+        # every decode chunk runs on the decode-role replica; in the
+        # unified fleet both replicas decode, so their windows merge.
+        decoding = [
+            r for r in replicas
+            if r.engine.config.role != "prefill"
+        ]
+        ms = [r.engine.get_metrics() for r in decoding]
+        import itertools as _it
+
+        samples = np.asarray(
+            list(
+                _it.chain.from_iterable(
+                    r.engine._chunk_wall_itl_ms for r in decoding
+                )
+            ),
+            dtype=np.float64,
+        )
+        return dict(
+            itl_p50_ms=(
+                float(np.percentile(samples, 50)) if samples.size else 0.0
+            ),
+            itl_p99_ms=(
+                float(np.percentile(samples, 99)) if samples.size else 0.0
+            ),
+            itl_dev_p99_ms=max(m["itl_p99_ms"] for m in ms),
+            wall_s=wall,
+            m0s=m0s,
+        )
+
+    # -- leg 1: disagg FIRST (warm advantage to the unified baseline) ---
+    warm_plans = (
+        (prompt_short, gcfg_decode),
+        (prompt_long, gcfg_prefill),
+    )
+    dis_replicas = [
+        _Replica(role="prefill", prewarm_plans=warm_plans),
+        _Replica(role="decode", prewarm_plans=warm_plans),
+    ]
+    try:
+        disagg = run_itl_leg("disagg", dis_replicas)
+        # post-prewarm deltas: what the TRACE did, not the warmup
+        dm, d0 = dis_replicas[1].engine.get_metrics(), disagg["m0s"][1]
+        pm, p0 = dis_replicas[0].engine.get_metrics(), disagg["m0s"][0]
+        decode_trace_prefills = dm["prefills_total"] - d0["prefills_total"]
+        disagg_detail = dict(
+            decode_replica_prefills=decode_trace_prefills,
+            decode_replica_host_hits=(
+                dm["kv_host_hits_total"] - d0["kv_host_hits_total"]
+            ),
+            decode_replica_migrated_in=(
+                dm["kv_migrated_in_sessions_total"]
+                - d0["kv_migrated_in_sessions_total"]
+            ),
+            decode_ttft_transfer_p99_ms=dm["ttft_transfer_p99_ms"],
+            prefill_replica_prefills=(
+                pm["prefills_total"] - p0["prefills_total"]
+            ),
+            prefill_ttft_prefill_p99_ms=pm["ttft_prefill_p99_ms"],
+        )
+        # the mechanism itself: the decode replica's scheduler never ran a
+        # transformer prompt prefill during the trace — every admission
+        # was a host-tier promotion of migrated blocks
+        assert decode_trace_prefills == 0, (
+            f"decode replica ran {decode_trace_prefills} prefills — "
+            "the prefill handoff is not covering the trace"
+        )
+    finally:
+        for r in dis_replicas:
+            r.stop()
+    uni_replicas = [
+        _Replica(role="unified", prewarm_plans=warm_plans) for _ in range(2)
+    ]
+    try:
+        unified = run_itl_leg("unified", uni_replicas)
+    finally:
+        for r in uni_replicas:
+            r.stop()
+
+    # -- leg 2: drain migration, both kv layouts, greedy + sampled ------
+    def run_drain(kv_layout):
+        greedy = GenerationHyperparameters(
+            max_new_tokens=drain_tokens, greedy=True
+        )
+        sampled = GenerationHyperparameters(
+            max_new_tokens=drain_tokens, temperature=0.8, top_p=0.9
+        )
+        gcfgs = [
+            greedy if i % 2 == 0 else sampled for i in range(drain_sessions)
+        ]
+        drng = np.random.RandomState(77)
+        prompts = [
+            drng.randint(1, model.vocab_size, (drain_prompt,)).tolist()
+            for _ in range(drain_sessions)
+        ]
+        # oracle: never-interrupted runs, same seed + admission order
+        oracle_eng = JaxDecodeEngine(
+            JaxDecodeConfig(
+                context_length=ctx,
+                max_running_requests=max_running,
+                new_tokens_per_chunk=n_chunk,
+                dtype=model.dtype,
+                kv_cache_dtype=model.dtype,
+                kv_layout=kv_layout,
+                random_seed=7,
+            ),
+            InferenceEngineConfig(),
+        )
+        oracle_eng.set_model(params, model)
+        oracle_eng.initialize()
+        oracle = {}
+        try:
+            for i in range(drain_sessions):
+                r = oracle_eng.generate(
+                    ModelRequest(
+                        rid=f"s{i}", input_ids=prompts[i], gconfig=gcfgs[i]
+                    ),
+                    timeout=300,
+                )
+                oracle[f"s{i}"] = (list(r.output_tokens), list(r.output_logprobs))
+        finally:
+            oracle_eng.destroy()
+
+        a = _Replica(role="unified", kv_layout=kv_layout, host_mb=256.0,
+                     seed=7)
+        b = _Replica(role="unified", kv_layout=kv_layout, seed=7)
+        try:
+            partials: dict[str, dict] = {}
+            lock = threading.Lock()
+
+            def submit(i):
+                out = _post(
+                    a.addr, "/generate",
+                    dict(
+                        rid=f"s{i}",
+                        input_ids=prompts[i],
+                        gconfig=dict(
+                            max_new_tokens=gcfgs[i].max_new_tokens,
+                            greedy=gcfgs[i].greedy,
+                            temperature=gcfgs[i].temperature,
+                            top_p=gcfgs[i].top_p,
+                        ),
+                    ),
+                    timeout=300,
+                )
+                with lock:
+                    partials[f"s{i}"] = out
+
+            threads = []
+            for i in range(drain_sessions):
+                t = threading.Thread(target=submit, args=(i,), daemon=True)
+                t.start()
+                threads.append(t)
+                # sequential-enough arrival: admission order (and so the
+                # sampling base keys) matches the oracle's
+                time.sleep(0.15)
+            # drain once every session is admitted and mid-stream
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                m = a.engine.get_metrics()
+                if (
+                    m["running_requests"] >= drain_sessions
+                    and m["generated_tokens_total"] >= drain_sessions
+                ):
+                    break
+                time.sleep(0.02)
+            drain_out = _post(
+                a.addr, "/drain", {"targets": [b.addr]}, timeout=300
+            )
+            for t in threads:
+                t.join(timeout=120)
+            assert len(partials) == drain_sessions, "lost interrupt responses"
+            b0 = b.engine.get_metrics()
+            full: dict[str, tuple] = {}
+            for i in range(drain_sessions):
+                rid = f"s{i}"
+                part = partials[rid]
+                assert part["stop_reason"] == "interrupt", part["stop_reason"]
+                resume_ids = prompts[i] + [int(t) for t in part["output_tokens"]]
+                left = gcfgs[i].max_new_tokens - len(part["output_tokens"])
+                out = _post(
+                    b.addr, "/generate",
+                    dict(
+                        rid=rid,
+                        input_ids=resume_ids,
+                        gconfig=dict(
+                            max_new_tokens=left,
+                            greedy=gcfgs[i].greedy,
+                            temperature=gcfgs[i].temperature,
+                            top_p=gcfgs[i].top_p,
+                        ),
+                    ),
+                    timeout=300,
+                )
+                full[rid] = (
+                    [int(t) for t in part["output_tokens"]]
+                    + [int(t) for t in out["output_tokens"]],
+                    [float(x) for x in part["output_logprobs"]]
+                    + [float(x) for x in out["output_logprobs"]],
+                )
+            b1 = b.engine.get_metrics()
+            mismatched = sum(
+                1
+                for rid, (toks, lps) in full.items()
+                if toks != oracle[rid][0] or lps != oracle[rid][1]
+            )
+            reprefills = b1["prefills_total"] - b0["prefills_total"]
+            assert drain_out["drained"] == drain_sessions, drain_out
+            assert drain_out["failed"] == 0, drain_out
+            assert reprefills == 0, (
+                f"{kv_layout}: {reprefills} resumes paid a re-prefill"
+            )
+            assert mismatched == 0, (
+                f"{kv_layout}: {mismatched} drained streams diverged"
+            )
+            return dict(
+                drained=drain_out["drained"],
+                kv_bytes=drain_out["bytes"],
+                resume_reprefills=reprefills,
+                resume_host_hits=(
+                    b1["kv_host_hits_total"] - b0["kv_host_hits_total"]
+                ),
+                reprefill_tokens_avoided=(
+                    b1["reprefill_tokens_avoided_total"]
+                    - b0["reprefill_tokens_avoided_total"]
+                ),
+                streams_bitidentical=int(mismatched == 0),
+            )
+        finally:
+            a.stop()
+            b.stop()
+
+    drain_paged = run_drain("paged")
+    drain_ws = run_drain("workspace")
+
+    return dict(
+        disagg_decode_reqs=n_decode_reqs,
+        disagg_prefill_reqs=n_prefill_reqs,
+        disagg_itl_p50_ms=disagg["itl_p50_ms"],
+        disagg_itl_p99_ms=disagg["itl_p99_ms"],
+        disagg_itl_dev_p99_ms=disagg["itl_dev_p99_ms"],
+        disagg_wall_s=disagg["wall_s"],
+        unified_itl_p50_ms=unified["itl_p50_ms"],
+        unified_itl_p99_ms=unified["itl_p99_ms"],
+        unified_itl_dev_p99_ms=unified["itl_dev_p99_ms"],
+        unified_wall_s=unified["wall_s"],
+        disagg_decode_itl_p99_speedup=(
+            unified["itl_p99_ms"] / disagg["itl_p99_ms"]
+            if disagg["itl_p99_ms"] > 0
+            else 0.0
+        ),
+        disagg_decode_itl_p50_speedup=(
+            unified["itl_p50_ms"] / disagg["itl_p50_ms"]
+            if disagg["itl_p50_ms"] > 0
+            else 0.0
+        ),
+        **{f"disagg_{k}": v for k, v in disagg_detail.items()},
+        **{f"disagg_drain_paged_{k}": v for k, v in drain_paged.items()},
+        **{f"disagg_drain_ws_{k}": v for k, v in drain_ws.items()},
+    )
+
+
 def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
                 new_tokens, max_running, chunk=None, turns=2, seed=123):
     """Chaos bench (ISSUE 9 tentpole proof): replay the fleet session-reuse
@@ -1124,6 +1646,18 @@ def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
          late rather than dying), plus a router.schedule abort (the
          router's own handler failing over to the client's transport
          retry).
+
+    The fleet is DISAGGREGATED (ISSUE 10): one prefill-role replica joins
+    the `n_replicas` unified ones, so every request's prompt runs on the
+    prefill replica and the KV streams to a decode replica before
+    generation. The schedule adds `kv.migrate.send` (sender dies
+    mid-stream — the full-session replay under the same xid must land the
+    handoff exactly once via interval-merged staging + commit dedup) and
+    a torn `kv.migrate.recv` frame (rejected by the manifest length-check
+    before a byte stages; the frame retry re-covers it). The
+    dup_generations == 0 assertion is the exactly-once proof for the
+    handoff: an abandoned or double-imported migration would surface as
+    an extra (or missing) engine-side admission.
 
     Exactly-once is asserted three ways: every (group, member, turn)
     stream completes exactly once client-side (0 lost), the summed
@@ -1182,20 +1716,24 @@ def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
         return asyncio.run(_g())
 
     class _Replica:
-        def __init__(self, warm_plen):
+        def __init__(self, warm_plen, role="unified"):
             dcfg = JaxDecodeConfig(
                 context_length=ctx,
                 max_running_requests=max_running,
                 new_tokens_per_chunk=chunk or min(128, new_tokens),
                 dtype=model.dtype,
                 kv_cache_dtype=model.dtype,
+                role=role,
+                kv_migrate_chunk_mb=0.05,  # several frames per session:
+                # gives the kv.migrate fault points mid-stream hits
             )
             self.engine = JaxDecodeEngine(dcfg, InferenceEngineConfig())
             self.engine.set_model(params, model)
             self.engine.initialize()
             self.engine.prewarm(prompt_len=warm_plen, gconfig=gcfg)
+            # the real dcfg (not a default) so /health advertises the role
             self.server = DecodeServer(
-                JaxDecodeConfig(), engine=self.engine, shutdown_grace=0.5
+                dcfg, engine=self.engine, shutdown_grace=0.5
             )
             self.addr = None
             self._loop = None
@@ -1275,7 +1813,12 @@ def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
 
     def run_trace(label, plan):
         exp, trial = "benchchaos", f"{label}-{_uuid.uuid4().hex[:6]}"
+        # disaggregated fleet: n_replicas unified (decode-capable) + one
+        # prefill-role replica every prompt runs on; identical for oracle
+        # and chaos runs, so greedy streams stay a pure function of the
+        # prompt regardless of which faults fire on the handoff path
         replicas = [_Replica(min(plens)) for _ in range(n_replicas)]
+        replicas.append(_Replica(min(plens), role="prefill"))
         addrs = [r.addr for r in replicas]
         rt = _RouterThread(addrs, exp, trial)
         client = RemoteInfEngine(
@@ -1334,6 +1877,14 @@ def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
                 _http_get(r.addr, "/metrics")["idem_hits_total"]
                 for r in replicas
             )
+            out["migrated_in"] = sum(
+                r.engine.get_metrics()["kv_migrated_in_sessions_total"]
+                for r in replicas
+            )
+            out["migrate_dedups"] = sum(
+                _http_get(r.addr, "/metrics")["kv_migrate"]["commit_dedups"]
+                for r in replicas
+            )
             out["router_metrics"] = _http_get(rt.addr, "/metrics")
             out["fault_counters"] = fault_injection.snapshot()
         finally:
@@ -1362,6 +1913,14 @@ def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
                        at=(3, 8), times=2, delay_s=0.2, jitter_s=0.1),
             FaultPoint(site="router.schedule", mode="abort",
                        at=(2,), times=1),
+            # the disaggregated handoff path (ISSUE 10): a sender dying
+            # mid-KV-stream — the full-session replay under the same xid
+            # must land the handoff exactly once — and a torn KV frame the
+            # receiver's manifest length-check rejects before staging
+            FaultPoint(site="kv.migrate.send", mode="abort",
+                       at=(1,), times=1),
+            FaultPoint(site="kv.migrate.recv", mode="torn",
+                       at=(4,), times=1),
         ],
     )
 
@@ -1398,6 +1957,13 @@ def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
     assert chaos["idem_hits"] >= 1, (
         "error-after-effect never exercised the idempotency replay"
     )
+    kv_faults = {
+        k: v for k, v in counters.items() if k.startswith("kv.migrate")
+    }
+    assert kv_faults, "kv.migrate fault points never fired"
+    assert chaos["migrated_in"] >= 1, (
+        "no KV session ever migrated — the handoff path went untested"
+    )
     rm = chaos["router_metrics"]
     return dict(
         chaos_replicas=n_replicas,
@@ -1411,6 +1977,9 @@ def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
         chaos_fault_modes_fired=len(modes_fired),
         chaos_faults_injected=faults_total,
         chaos_idem_replays=chaos["idem_hits"],
+        chaos_kv_migrated_sessions=chaos["migrated_in"],
+        chaos_kv_migrate_commit_dedups=chaos["migrate_dedups"],
+        chaos_kv_migrate_faults={k: int(v) for k, v in sorted(kv_faults.items())},
         chaos_recovery_max_s=recovery_max_s,
         chaos_oracle_wall_s=oracle["wall_s"],
         chaos_wall_s=chaos["wall_s"],
@@ -1983,6 +2552,7 @@ BENCH_MODE_FNS = {
     "kvoffload": bench_kvoffload,
     "fleet": bench_fleet,
     "chaos": bench_chaos,
+    "disagg": bench_disagg,
 }
 BENCH_MODES = ("all", *BENCH_MODE_FNS)
 # headline metric per dev mode (modes that skip the trainer MFU line)
@@ -1997,6 +2567,7 @@ MODE_HEADLINES = {
     "kvoffload": ("kvoffload_resume_ttft_speedup", "x"),
     "fleet": ("fleet_affinity_ttft_p50_speedup", "x"),
     "chaos": ("chaos_exactly_once", "bool"),
+    "disagg": ("disagg_decode_itl_p99_speedup", "x"),
 }
 
 
@@ -2353,6 +2924,20 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("disagg"):
+            decode.update(
+                _retry_transport(
+                    lambda: bench_disagg(
+                        model, n_decode_reqs=16, n_prefill_reqs=8,
+                        prompt_short=64, prompt_long=2048, new_tokens=256,
+                        max_running=32, drain_sessions=8, drain_prompt=512,
+                        drain_tokens=128,
+                    ),
+                    what="bench_disagg",
+                    attempts=2,
+                    base_delay=15.0,
+                )
+            )
         if want("grpo"):
             # GRPO co-locates trainer (fwd+bwd+opt) and decode engine on
             # one chip: run the actor with remat on to leave HBM headroom
@@ -2499,14 +3084,30 @@ def main() -> None:
                 )
             )
         if want("chaos"):
-            # greedy streams + a seeded 5-point schedule over 2 replicas;
-            # prompts past the 64-token affinity block so the chaos trace
-            # exercises the same fork/suffix reuse paths the fleet smoke
-            # does while faults land mid-stream
+            # greedy streams + a seeded 7-point schedule over 2 decode
+            # replicas + 1 prefill replica; prompts past the 64-token
+            # affinity block so the chaos trace exercises the same
+            # fork/suffix reuse paths the fleet smoke does while faults
+            # land mid-stream AND mid-KV-handoff
             decode.update(
                 bench_chaos(
                     model, n_replicas=2, n_groups=3, group_size=2,
                     prompt_len=96, new_tokens=16, max_running=4, chunk=8,
+                )
+            )
+        if want("disagg"):
+            # long prefills (256 tok on the tiny model) landing mid-trace
+            # against 8-token decode chunks: the co-located baseline
+            # serializes each prefill ahead of the next decode chunk, the
+            # disaggregated fleet never does — that gap is the p99 ITL
+            # headline. Drain leg: 4 sessions (greedy+sampled alternating)
+            # per kv layout, migrated mid-stream and resumed bit-identically
+            decode.update(
+                bench_disagg(
+                    model, n_decode_reqs=8, n_prefill_reqs=4,
+                    prompt_short=48, prompt_long=1024, new_tokens=256,
+                    max_running=16, chunk=4, drain_sessions=4,
+                    drain_prompt=96, drain_tokens=48,
                 )
             )
         if want("grpo"):

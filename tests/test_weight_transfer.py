@@ -251,3 +251,132 @@ def test_staging_reset_clears_partial_state():
         st.add_bucket(b)
     out = st.finalize()
     assert out["w"].shape == (600, 1024)
+
+
+# -- KV-frame payloads on the shared staging plumbing (ISSUE 10) --------
+# The migrated-session wire format rides the SAME framed buckets as the
+# weight push; these pin the staging contracts the migration relies on
+# for non-weight payloads: torn-frame rejection before a byte stages,
+# interval re-merge across differently-split retry frames, and the
+# empty-manifest edge cases.
+
+
+def _kv_session_parts(n_tokens=12, nb=3, seed=0):
+    from areal_tpu.core.weight_transfer import pack_kv_session
+
+    rng = np.random.RandomState(seed)
+    k = rng.rand(2, nb, 4, 2, 4).astype(np.float32)
+    v = rng.rand(2, nb, 4, 2, 4).astype(np.float32)
+    meta = dict(
+        rid="sess", covered=n_tokens, tokens=list(range(n_tokens)),
+        rope_delta=0, base_key=[1, 2], weight_version=0, nb=nb,
+    )
+    return meta, k, v, pack_kv_session
+
+
+def test_kv_frame_torn_rejection():
+    """A truncated KV frame must raise BEFORE anything stages (silently
+    staging a short part would count phantom coverage and materialize a
+    corrupt session)."""
+    from areal_tpu.core.weight_transfer import WeightStaging
+
+    meta, k, v, pack_kv_session = _kv_session_parts()
+    frames = list(pack_kv_session(meta, k, v, chunk_mb=0.001))
+    assert len(frames) >= 2
+    st = WeightStaging()
+    for cut in (3, len(frames[0]) // 2, len(frames[0]) - 1):
+        with pytest.raises(ValueError, match="torn"):
+            st.add_bucket(frames[0][:cut])
+    # nothing staged by the torn attempts; the intact frames still land
+    assert len(st) == 0 and not st._bufs
+    for f in frames:
+        st.add_bucket(f)
+    from areal_tpu.core.weight_transfer import unpack_kv_sessions
+
+    (got_meta, got_k, got_v), = unpack_kv_sessions(st.finalize())
+    assert got_meta == meta
+    assert np.array_equal(got_k, k) and np.array_equal(got_v, v)
+
+
+def test_kv_frames_interval_remerge_across_resplit_retries():
+    """A retry that re-packs the same session at a DIFFERENT chunk size
+    overlaps the original frames' byte ranges arbitrarily; merged-interval
+    coverage must count each byte once and still materialize exact
+    tensors (a plain coverage sum would double-count and either corrupt
+    or wedge the session)."""
+    from areal_tpu.core.weight_transfer import (
+        WeightStaging,
+        unpack_kv_sessions,
+    )
+
+    meta, k, v, pack_kv_session = _kv_session_parts(seed=1)
+    frames_a = list(pack_kv_session(meta, k, v, chunk_mb=0.001))
+    frames_b = list(pack_kv_session(meta, k, v, chunk_mb=0.0017))
+    assert len(frames_a) != len(frames_b)  # genuinely different splits
+    st = WeightStaging()
+    # half of split A lands, then the full re-split retry replays B
+    for f in frames_a[: len(frames_a) // 2]:
+        st.add_bucket(f)
+    for f in frames_b:
+        st.add_bucket(f)
+    (got_meta, got_k, got_v), = unpack_kv_sessions(st.finalize())
+    assert got_meta == meta
+    assert np.array_equal(got_k, k) and np.array_equal(got_v, v)
+
+
+def test_unpack_bucket_parts_empty_manifest_cases():
+    """Empty payload sets: pack of nothing yields no frames; a frame
+    whose manifest is an empty list unpacks to no parts (not an error);
+    an empty staging finalizes to {} and holds no sessions."""
+    import json as _json
+    import struct as _struct
+
+    from areal_tpu.core.weight_transfer import (
+        WeightStaging,
+        pack_buckets,
+        unpack_bucket_parts,
+        unpack_kv_sessions,
+    )
+
+    assert list(pack_buckets({})) == []
+    mjson = _json.dumps([]).encode()
+    empty_frame = _struct.pack("<Q", len(mjson)) + mjson
+    assert unpack_bucket_parts(empty_frame) == []
+    st = WeightStaging()
+    st.add_bucket(empty_frame)
+    assert unpack_kv_sessions(st.finalize()) == []
+    # sub-header garbage is torn, not empty
+    with pytest.raises(ValueError, match="torn"):
+        unpack_bucket_parts(b"\x01\x02")
+
+
+def test_unpack_kv_sessions_rejects_structurally_incomplete():
+    from areal_tpu.core.weight_transfer import (
+        WeightStaging,
+        unpack_kv_sessions,
+    )
+
+    meta, k, v, pack_kv_session = _kv_session_parts(seed=2)
+    frames = list(pack_kv_session(meta, k, v, chunk_mb=64))
+    st = WeightStaging()
+    for f in frames:
+        st.add_bucket(f)
+    staged = st.finalize()
+    # blocks without metadata
+    no_meta = {n: a for n, a in staged.items() if not n.startswith("kvmeta/")}
+    with pytest.raises(ValueError, match="without session metadata"):
+        unpack_kv_sessions(no_meta)
+    # metadata without blocks
+    no_blocks = {n: a for n, a in staged.items() if n.startswith("kvmeta/")}
+    with pytest.raises(ValueError, match="incomplete"):
+        unpack_kv_sessions(no_blocks)
+    # malformed metadata (missing required resume fields)
+    import json as _json
+
+    bad = dict(staged)
+    bad_meta = {kk: vv for kk, vv in meta.items() if kk != "base_key"}
+    bad["kvmeta/sess"] = np.frombuffer(
+        _json.dumps(bad_meta).encode(), dtype=np.uint8
+    )
+    with pytest.raises(ValueError, match="malformed"):
+        unpack_kv_sessions(bad)
