@@ -278,6 +278,24 @@ class JaxDecodeConfig:
     random_seed: int = 1
     dtype: str = "bfloat16"
     kv_cache_dtype: str = "bfloat16"
+    # Paged-pool storage scheme (parity surface: SGLang's fp8/int8 KV
+    # cache serving):
+    #   "fp" (default): the pool stores kv_cache_dtype verbatim — the
+    #     pre-quantization behavior, bit for bit, and the numerics oracle
+    #     int8 drift is measured against.
+    #   "int8": the pool stores int8 with per-(row, kv-head) f32 scales
+    #     (ops/kv_quant.py; requires kv_layout="paged"). Rows are
+    #     quantized ONCE at the decode/verify/prefill scatters and
+    #     dequantized inside the paged-attention kernels right after each
+    #     block's HBM→VMEM DMA — the same MB of pool holds ~2x the
+    #     sessions, and every byte-moving path (host-tier swaps, session
+    #     export/import, /drain migration) ships the quantized blocks +
+    #     scales as-is, halving swap and wire bytes too. Mixed-dtype
+    #     fleets reject migrated sessions as tombstoned honest misses
+    #     (kv_migrate_dtype_rejects_total), like the weight-version rule.
+    #     Drift (logprob delta, spec accept-rate shift) is measured by
+    #     `bench.py --mode kvquant`, not assumed zero.
+    kv_dtype: str = "fp"  # "fp" | "int8"
     # Replica role in a disaggregated fleet (launcher/decode_server.py):
     #   "unified" (default): one replica does both prefill and decode.
     #   "prefill": compute-bound role — runs prompt prefills only (via

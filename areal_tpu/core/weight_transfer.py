@@ -339,7 +339,10 @@ def unpack_bucket(payload: bytes) -> dict[str, np.ndarray]:
 KV_META_PREFIX = "kvmeta/"
 KV_DATA_PREFIX = "kvdata/"
 
-# HostKVEntry fields the wire metadata must carry for an exact resume
+# HostKVEntry fields the wire metadata must carry for an exact resume.
+# `kv_dtype` is optional-with-default on READ ("fp") so pre-quantization
+# senders stay decodable; int8 sessions always stamp it and additionally
+# ship their per-row scale blocks as .../ks and .../vs tensors.
 _KV_META_REQUIRED = (
     "rid", "covered", "tokens", "rope_delta", "base_key", "weight_version",
     "nb",
@@ -347,18 +350,33 @@ _KV_META_REQUIRED = (
 
 
 def pack_kv_session(
-    meta: dict, k: np.ndarray, v: np.ndarray, chunk_mb: float = 64
+    meta: dict,
+    k: np.ndarray,
+    v: np.ndarray,
+    ks: np.ndarray | None = None,
+    vs: np.ndarray | None = None,
+    chunk_mb: float = 64,
 ) -> Iterable[bytes]:
     """Frame one session's KV blocks + resume metadata as wire buckets.
 
     `meta` must carry the HostKVEntry resume contract (see
-    _KV_META_REQUIRED); `k`/`v` are the session's gathered pool blocks.
-    The metadata travels first so a receiver that streams frames in order
-    can validate the session before most of the bytes arrive (staging
-    itself is order-independent)."""
+    _KV_META_REQUIRED); `k`/`v` are the session's gathered pool blocks —
+    for an int8 session (meta["kv_dtype"] == "int8") the int8 bytes
+    VERBATIM, with the f32 scale blocks in `ks`/`vs`. The wire never
+    requantizes: the session's pool bytes ARE the payload, which is what
+    halves migration traffic for quantized fleets. The metadata travels
+    first so a receiver that streams frames in order can validate the
+    session before most of the bytes arrive (staging itself is
+    order-independent)."""
     missing = [f for f in _KV_META_REQUIRED if f not in meta]
     if missing:
         raise ValueError(f"kv session meta missing fields: {missing}")
+    if (str(meta.get("kv_dtype", "fp")) == "int8") != (ks is not None):
+        raise ValueError(
+            "kv session scales must travel iff meta kv_dtype == 'int8' "
+            f"(kv_dtype={meta.get('kv_dtype', 'fp')!r}, "
+            f"scales={'present' if ks is not None else 'absent'})"
+        )
     rid = str(meta["rid"])
     mjson = np.frombuffer(
         json.dumps(meta, sort_keys=True).encode(), dtype=np.uint8
@@ -368,19 +386,25 @@ def pack_kv_session(
         (f"{KV_DATA_PREFIX}{rid}/k", k),
         (f"{KV_DATA_PREFIX}{rid}/v", v),
     ]
+    if ks is not None:
+        named.append((f"{KV_DATA_PREFIX}{rid}/ks", ks))
+        named.append((f"{KV_DATA_PREFIX}{rid}/vs", vs))
     yield from pack_buckets(named, chunk_mb=chunk_mb)
 
 
 def unpack_kv_sessions(
     staged: dict[str, np.ndarray],
-) -> list[tuple[dict, np.ndarray, np.ndarray]]:
-    """Finalized staging → [(meta, k, v)] per complete session.
+) -> list[tuple[dict, np.ndarray, np.ndarray, tuple[np.ndarray, np.ndarray] | None]]:
+    """Finalized staging → [(meta, k, v, scales)] per complete session,
+    where `scales` is (ks, vs) for int8 sessions and None for fp ones.
 
     Raises ValueError when a session is structurally incomplete (metadata
-    without blocks or vice versa) or its metadata is malformed — the
-    commit handler turns that into a client-visible error instead of
-    importing a half-session."""
-    out: list[tuple[dict, np.ndarray, np.ndarray]] = []
+    without blocks, an int8 session missing its scale blocks, or vice
+    versa) or its metadata is malformed — the commit handler turns that
+    into a client-visible error instead of importing a half-session."""
+    out: list[
+        tuple[dict, np.ndarray, np.ndarray, tuple[np.ndarray, np.ndarray] | None]
+    ] = []
     meta_keys = sorted(n for n in staged if n.startswith(KV_META_PREFIX))
     data_keys = {n for n in staged if n.startswith(KV_DATA_PREFIX)}
     for mk in meta_keys:
@@ -393,7 +417,19 @@ def unpack_kv_sessions(
         missing = [f for f in _KV_META_REQUIRED if f not in meta]
         if missing or str(meta["rid"]) != rid:
             raise ValueError(f"kv session {rid!r} metadata malformed")
-        out.append((meta, staged[kk], staged[vk]))
+        sk = f"{KV_DATA_PREFIX}{rid}/ks"
+        sv = f"{KV_DATA_PREFIX}{rid}/vs"
+        scales = None
+        if str(meta.get("kv_dtype", "fp")) == "int8":
+            if sk not in staged or sv not in staged:
+                raise ValueError(
+                    f"kv session {rid!r} incomplete: int8 blocks without "
+                    "scale blocks"
+                )
+            scales = (staged[sk], staged[sv])
+            data_keys.discard(sk)
+            data_keys.discard(sv)
+        out.append((meta, staged[kk], staged[vk], scales))
         data_keys.discard(kk)
         data_keys.discard(vk)
     if data_keys:

@@ -173,10 +173,15 @@ _GUARDED_BY = {
     "JaxDecodeEngine._migrated_in_bytes": "_metrics_lock",
     "JaxDecodeEngine._migrated_out_bytes": "_metrics_lock",
     "JaxDecodeEngine._n_migrate_version_rejects": "_metrics_lock",
+    "JaxDecodeEngine._n_migrate_dtype_rejects": "_metrics_lock",
     # device buffers swapped under _weight_lock at every mutation site
     # that can race a dispatched chunk
     "JaxDecodeEngine._k_cache": "_weight_lock",
     "JaxDecodeEngine._v_cache": "_weight_lock",
+    # int8 per-row scale pools (kv_dtype="int8"): paged exactly like the
+    # data pools and swapped at the same _weight_lock sites
+    "JaxDecodeEngine._k_scale": "_weight_lock",
+    "JaxDecodeEngine._v_scale": "_weight_lock",
     "JaxDecodeEngine._freq_counts": "_weight_lock",
 }
 
@@ -402,8 +407,15 @@ class JaxDecodeEngine(InferenceEngine):
         self.mesh = None
         self._param_shardings = None
         self._cache_sharding = None
+        self._scale_sharding = None
         self._k_cache = None
         self._v_cache = None
+        # int8 scale pools ([L, n_blocks, nKV, block_size] f32); None on
+        # the fp path — `_kv_operands` then hands out bare arrays and
+        # every jitted pool fn keeps its pre-quantization trace
+        self._k_scale = None
+        self._v_scale = None
+        self._kv_quant = False
         self._slot_lengths = None  # np [R]
         self._slots: list[_Slot | None] = []
         # Interrupted requests keep their KV parked in the slot so a resume
@@ -457,6 +469,10 @@ class JaxDecodeEngine(InferenceEngine):
         self._migrated_in_bytes = 0
         self._migrated_out_bytes = 0
         self._n_migrate_version_rejects = 0
+        # imports refused because the session's kv dtype (fp vs int8)
+        # differs from this engine's pool — mixed-dtype fleets tombstone
+        # the rid as an honest miss, like the weight-version rule
+        self._n_migrate_dtype_rejects = 0
         # K+V bytes of one pool block (set in initialize; import_session
         # needs it to size a lazily created host tier)
         self._block_nbytes = 0
@@ -634,6 +650,20 @@ class JaxDecodeEngine(InferenceEngine):
                 f"kv_layout={self.config.kv_layout!r} not in "
                 "('paged', 'workspace')"
             )
+        from areal_tpu.ops.kv_quant import KV_DTYPES
+
+        if self.config.kv_dtype not in KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype={self.config.kv_dtype!r} not in {KV_DTYPES}"
+            )
+        self._kv_quant = self.config.kv_dtype == "int8"
+        if self._kv_quant and self.config.kv_layout != "paged":
+            # the workspace layout IS the fp numerics oracle — quantizing
+            # it would leave nothing to measure drift against
+            raise ValueError(
+                "kv_dtype='int8' requires kv_layout='paged' "
+                "(kv_layout='workspace' stays the fp numerics oracle)"
+            )
         if getattr(self.config, "role", "unified") not in (
             "unified", "prefill", "decode",
         ):
@@ -677,14 +707,22 @@ class JaxDecodeEngine(InferenceEngine):
         self._alloc = KVBlockAllocator(R, n_blocks, bs, max_bps)
         # host-RAM tier under the pool: budgeted by kv_host_pool_mb
         # (0 = disabled — eviction drops KV and resume re-prefills,
-        # exactly the pre-tier behavior)
+        # exactly the pre-tier behavior). PHYSICAL bytes per block: int8
+        # pools store 1 byte/element plus one f32 scale per (row, head) —
+        # every byte counter downstream (host budget, swap totals,
+        # migration totals, workspace-copy totals) derives from this, so
+        # none of them can silently assume the fp element size.
+        kv_elem = (
+            1 if self._kv_quant
+            else jnp.dtype(self.config.kv_cache_dtype).itemsize
+        )
+        kv_scale_bytes = 4 if self._kv_quant else 0
         block_nbytes = (
             2  # K and V
             * cfg.num_hidden_layers
             * bs
             * cfg.num_key_value_heads
-            * cfg.head_dim_
-            * jnp.dtype(self.config.kv_cache_dtype).itemsize
+            * (cfg.head_dim_ * kv_elem + kv_scale_bytes)
         )
         self._block_nbytes = int(block_nbytes)
         with self._host_lock:
@@ -705,11 +743,29 @@ class JaxDecodeEngine(InferenceEngine):
             cfg.num_key_value_heads,
             cfg.head_dim_,
         )
-        self._k_cache = jnp.zeros(shape, kv_dtype)
-        self._v_cache = jnp.zeros(shape, kv_dtype)
+        pool_dtype = jnp.int8 if self._kv_quant else kv_dtype
+        self._k_cache = jnp.zeros(shape, pool_dtype)
+        self._v_cache = jnp.zeros(shape, pool_dtype)
         if self._cache_sharding is not None:
             self._k_cache = jax.device_put(self._k_cache, self._cache_sharding)
             self._v_cache = jax.device_put(self._v_cache, self._cache_sharding)
+        self._k_scale = self._v_scale = None
+        if self._kv_quant:
+            # per-(row, head) f32 scales, paged like the data pool; the
+            # kv-head axis precedes block_size so a Pallas scale block is
+            # (1, 1, bs) with the 128-multiple page size on the lane dim
+            sshape = (
+                cfg.num_hidden_layers, n_blocks, cfg.num_key_value_heads, bs
+            )
+            self._k_scale = jnp.zeros(sshape, jnp.float32)
+            self._v_scale = jnp.zeros(sshape, jnp.float32)
+            if self._scale_sharding is not None:
+                self._k_scale = jax.device_put(
+                    self._k_scale, self._scale_sharding
+                )
+                self._v_scale = jax.device_put(
+                    self._v_scale, self._scale_sharding
+                )
         self._slot_lengths = np.zeros(R, dtype=np.int32)
         self._slot_rope_delta = np.zeros(R, dtype=np.int32)
         self._slot_used_freq = np.zeros(R, dtype=bool)
@@ -757,6 +813,7 @@ class JaxDecodeEngine(InferenceEngine):
             self._migrated_in_bytes = 0
             self._migrated_out_bytes = 0
             self._n_migrate_version_rejects = 0
+            self._n_migrate_dtype_rejects = 0
 
         from areal_tpu.core.workflow_executor import WorkflowExecutor
 
@@ -781,6 +838,7 @@ class JaxDecodeEngine(InferenceEngine):
             self._executor.destroy()
         self.params = None
         self._k_cache = self._v_cache = None
+        self._k_scale = self._v_scale = None
         self._alloc = None
         with self._host_lock:
             if self._host_store is not None:
@@ -979,11 +1037,16 @@ class JaxDecodeEngine(InferenceEngine):
 
             cfg = self.model_config
             img_tok = self._image_token_id
+            quant = self._kv_quant
 
             def prefill_and_write(
-                params, kp, vp, ids, positions, bt_row, true_len, img_embeds,
+                params, kq, vq, ids, positions, bt_row, true_len, img_embeds,
                 cos, sin,
             ):
+                from areal_tpu.ops.kv_quant import (
+                    join_pool, quantize_kv, scales_blocked, split_pool,
+                )
+
                 valid = jnp.arange(ids.shape[0]) < true_len
                 embeds = params["embed"]["embedding"][ids].astype(
                     jnp.dtype(cfg.dtype)
@@ -1000,19 +1063,26 @@ class JaxDecodeEngine(InferenceEngine):
                     rope_cos=cos,
                     rope_sin=sin,
                 )
+                kp, ksc = split_pool(kq)
+                vp, vsc = split_pool(vq)
                 L, _, bsz, nkv, hd = kp.shape
                 nb_w = bt_row.shape[0]
                 pad = nb_w * bsz - bucket
                 if pad:
                     k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
                     v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                if quant:
+                    k, sk = quantize_kv(k)
+                    v, sv = quantize_kv(v)
+                    ksc = ksc.at[:, bt_row].set(scales_blocked(sk, nb_w, bsz))
+                    vsc = vsc.at[:, bt_row].set(scales_blocked(sv, nb_w, bsz))
                 kp = kp.at[:, bt_row].set(
                     k.reshape(L, nb_w, bsz, nkv, hd).astype(kp.dtype)
                 )
                 vp = vp.at[:, bt_row].set(
                     v.reshape(L, nb_w, bsz, nkv, hd).astype(vp.dtype)
                 )
-                return kp, vp
+                return join_pool(kp, ksc), join_pool(vp, vsc)
 
             self._embed_prefill_fns[key] = jax.jit(
                 prefill_and_write, donate_argnums=(1, 2)
@@ -1107,6 +1177,7 @@ class JaxDecodeEngine(InferenceEngine):
             self.mesh = None
             self._param_shardings = None
             self._cache_sharding = None
+            self._scale_sharding = None
             return
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1142,6 +1213,10 @@ class JaxDecodeEngine(InferenceEngine):
         )
         self._cache_sharding = NamedSharding(
             self.mesh, P(None, None, None, kv_axis, None)
+        )
+        # int8 scale pools are [L, n_blocks, nKV, block_size]
+        self._scale_sharding = NamedSharding(
+            self.mesh, P(None, None, kv_axis, None)
         )
 
     def _chunk_bucket(self, active: np.ndarray, grow: int | None = None) -> int:
@@ -1222,6 +1297,7 @@ class JaxDecodeEngine(InferenceEngine):
         n_chunk = self.config.new_tokens_per_chunk
         paged = self.config.kv_layout == "paged"
         paged_impl = self._paged_impl
+        quant = self._kv_quant
 
         # sampler shared with the speculative verify chunk (see
         # _make_sample_fn) — per-slot exactness and the top_p==1 primary-key
@@ -1253,11 +1329,17 @@ class JaxDecodeEngine(InferenceEngine):
 
                 counts_init = counts0 if freq else jnp.zeros((), jnp.float32)
 
-                if paged and paged_impl == "pallas":
+                if paged and (paged_impl == "pallas" or quant):
                     # in-pool: the pool itself is the scan carry (donated,
                     # so XLA updates it in place), the write is an O(1)
                     # row scatter, and attention reads through the block
-                    # table — no gather, no scatter
+                    # table — no gather, no scatter. Int8 pools take this
+                    # branch on BOTH impls: every read must round-trip the
+                    # quantized representation (the xla gather-once path
+                    # below would attend fp rows written earlier in the
+                    # SAME chunk, making streams depend on chunk
+                    # boundaries — park/resume and migration bit-identity
+                    # would break).
                     def step(carry, _):
                         tokens, lengths, kpc, vpc, counts = carry
                         logits, kpc, vpc = decode_step_paged(
@@ -1603,6 +1685,27 @@ class JaxDecodeEngine(InferenceEngine):
                 self._table_uploads += 1
         return self._dev_table
 
+    def _kv_operands(self):
+        """The pool operands a jitted pool fn receives: bare (k, v) data
+        arrays on the fp path (the pre-quantization trace, byte for
+        byte), or ((data, scales), (data, scales)) pytree tuples when
+        kv_dtype='int8'. Caller holds _weight_lock for the dispatch."""
+        if self._k_scale is None:
+            return self._k_cache, self._v_cache
+        return (
+            (self._k_cache, self._k_scale),
+            (self._v_cache, self._v_scale),
+        )
+
+    def _set_kv_operands(self, kq, vq) -> None:
+        """Store a pool fn's returned operands back (inverse of
+        `_kv_operands`). Caller holds _weight_lock."""
+        if self._k_scale is None:
+            self._k_cache, self._v_cache = kq, vq
+        else:
+            self._k_cache, self._k_scale = kq
+            self._v_cache, self._v_scale = vq
+
     def _get_prefill_fn(self, bucket: int):
         """Cache-warm only: writes the prompt's KV rows at a slot offset.
 
@@ -1638,8 +1741,13 @@ class JaxDecodeEngine(InferenceEngine):
         key = (bucket, B)
         if key not in self._batched_prefill_fns:
             cfg = self.model_config
+            quant = self._kv_quant
 
-            def batched(params, kp, vp, ids_b, positions, bts_b, lens_b):
+            def batched(params, kq, vq, ids_b, positions, bts_b, lens_b):
+                from areal_tpu.ops.kv_quant import (
+                    join_pool, quantize_kv, scales_blocked, split_pool,
+                )
+
                 # bts_b: [B, nb_w] block-table rows to scatter into
                 def core(ids, true_len):
                     valid = jnp.arange(bucket) < true_len
@@ -1650,6 +1758,8 @@ class JaxDecodeEngine(InferenceEngine):
                     return k, v
 
                 ks, vs = jax.vmap(core)(ids_b, lens_b)  # [B, L, bucket, ...]
+                kp, ksc = split_pool(kq)
+                vp, vsc = split_pool(vq)
                 L, _, bsz, nkv, hd = kp.shape
                 nb_w = bts_b.shape[1]
                 pad = nb_w * bsz - bucket
@@ -1661,13 +1771,24 @@ class JaxDecodeEngine(InferenceEngine):
                         # decode overwrites them
                         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
                         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                    if quant:
+                        # prompt rows quantize at THIS scatter, like the
+                        # decode rows at theirs — one scheme everywhere
+                        k, sk = quantize_kv(k)
+                        v, sv = quantize_kv(v)
+                        ksc = ksc.at[:, bts_b[b]].set(
+                            scales_blocked(sk, nb_w, bsz)
+                        )
+                        vsc = vsc.at[:, bts_b[b]].set(
+                            scales_blocked(sv, nb_w, bsz)
+                        )
                     kp = kp.at[:, bts_b[b]].set(
                         k.reshape(L, nb_w, bsz, nkv, hd).astype(kp.dtype)
                     )
                     vp = vp.at[:, bts_b[b]].set(
                         v.reshape(L, nb_w, bsz, nkv, hd).astype(vp.dtype)
                     )
-                return kp, vp
+                return join_pool(kp, ksc), join_pool(vp, vsc)
 
             self._batched_prefill_fns[key] = jax.jit(
                 batched, donate_argnums=(1, 2)
@@ -1683,12 +1804,14 @@ class JaxDecodeEngine(InferenceEngine):
         transformer prefill both replace."""
         if True not in self._fork_fns:
 
-            def copy_block(kp, vp, src_b, dst_b):
-                k = jnp.take(kp, src_b[None], axis=1)
-                v = jnp.take(vp, src_b[None], axis=1)
-                kp = kp.at[:, dst_b[None]].set(k)
-                vp = vp.at[:, dst_b[None]].set(v)
-                return kp, vp
+            def copy_block(kq, vq, src_b, dst_b):
+                # tree-mapped so int8 operands copy the scale block through
+                # the same block ids as the data block (fp: bare arrays)
+                def cp(pool):
+                    blk = jnp.take(pool, src_b[None], axis=1)
+                    return pool.at[:, dst_b[None]].set(blk)
+
+                return jax.tree.map(cp, kq), jax.tree.map(cp, vq)
 
             self._fork_fns[True] = jax.jit(copy_block, donate_argnums=(0, 1))
         return self._fork_fns[True]
@@ -1701,12 +1824,13 @@ class JaxDecodeEngine(InferenceEngine):
             src_b, dst_b = cp
             fn = self._get_block_copy_fn()
             with self._weight_lock:
-                self._k_cache, self._v_cache = fn(
-                    self._k_cache,
-                    self._v_cache,
+                kq, vq = self._kv_operands()
+                self._set_kv_operands(*fn(
+                    kq,
+                    vq,
                     jnp.asarray(src_b, jnp.int32),
                     jnp.asarray(dst_b, jnp.int32),
-                )
+                ))
 
     # -- host KV tier (kv_host_pool_mb) --------------------------------
     def _get_host_gather_fn(self):
@@ -1717,8 +1841,12 @@ class JaxDecodeEngine(InferenceEngine):
         re-specialises per nb; the trace is a pair of takes."""
         if self._host_gather_fn is None:
 
-            def gather(kp, vp, bt_row):
-                return jnp.take(kp, bt_row, axis=1), jnp.take(vp, bt_row, axis=1)
+            def gather(kq, vq, bt_row):
+                # tree-mapped: int8 operands gather the scale blocks too —
+                # the host entry (and the migration wire) then carries the
+                # quantized bytes + scales AS-IS, no requantization
+                take = lambda pool: jnp.take(pool, bt_row, axis=1)  # noqa: E731
+                return jax.tree.map(take, kq), jax.tree.map(take, vq)
 
             self._host_gather_fn = jax.jit(gather)
         return self._host_gather_fn
@@ -1731,10 +1859,15 @@ class JaxDecodeEngine(InferenceEngine):
         slots keep decoding while the bytes land."""
         if self._host_upload_fn is None:
 
-            def upload(kp, vp, bt_row, hk, hv):
-                kp = kp.at[:, bt_row].set(hk.astype(kp.dtype))
-                vp = vp.at[:, bt_row].set(hv.astype(vp.dtype))
-                return kp, vp
+            def upload(kq, vq, bt_row, hk, hv):
+                # tree-mapped: int8 host entries upload (data, scales)
+                # pairs — the stored int8 bytes land verbatim (the astype
+                # is an identity there), so a promoted stream reads the
+                # exact bytes the offload gathered
+                def put(pool, host):
+                    return pool.at[:, bt_row].set(host.astype(pool.dtype))
+
+                return jax.tree.map(put, kq, hk), jax.tree.map(put, vq, hv)
 
             self._host_upload_fn = jax.jit(upload, donate_argnums=(0, 1))
         return self._host_upload_fn
@@ -1756,14 +1889,19 @@ class JaxDecodeEngine(InferenceEngine):
         if nb <= 0 or nb > int(self._alloc.nblocks[slot]):
             return False
         try:
+            from areal_tpu.ops.kv_quant import split_pool
+
             fn = self._get_host_gather_fn()
             with self._weight_lock:
-                hk, hv = fn(
-                    self._k_cache,
-                    self._v_cache,
+                kq, vq = self._kv_operands()
+                hkq, hvq = fn(
+                    kq,
+                    vq,
                     jnp.asarray(self._alloc.row(slot, nb)),
                 )
-            for arr in (hk, hv):
+            hk, hks = split_pool(hkq)
+            hv, hvs = split_pool(hvq)
+            for arr in (hk, hv, hks, hvs):
                 copy_async = getattr(arr, "copy_to_host_async", None)
                 if copy_async is not None:
                     copy_async()
@@ -1771,6 +1909,9 @@ class JaxDecodeEngine(InferenceEngine):
                 rid=rid,
                 k=hk,
                 v=hv,
+                ks=hks,
+                vs=hvs,
+                kv_dtype=self.config.kv_dtype,
                 nb=nb,
                 covered=int(covered),
                 tokens=list(tokens),
@@ -1822,14 +1963,20 @@ class JaxDecodeEngine(InferenceEngine):
                 self._host_store.restore(entry)
             raise PoolDry("no device blocks for host-tier promotion")
         fn = self._get_host_upload_fn()
+        hk = jnp.asarray(entry.k)
+        hv = jnp.asarray(entry.v)
+        if entry.ks is not None:
+            hk = (hk, jnp.asarray(entry.ks))
+            hv = (hv, jnp.asarray(entry.vs))
         with self._weight_lock:
-            self._k_cache, self._v_cache = fn(
-                self._k_cache,
-                self._v_cache,
+            kq, vq = self._kv_operands()
+            self._set_kv_operands(*fn(
+                kq,
+                vq,
                 jnp.asarray(self._alloc.row(slot_idx, entry.nb)),
-                jnp.asarray(entry.k),
-                jnp.asarray(entry.v),
-            )
+                hk,
+                hv,
+            ))
         self._slot_rope_delta[slot_idx] = entry.rope_delta
         self._slot_keys[slot_idx] = entry.base_key
         item.base_key = np.array(entry.base_key)
@@ -1861,11 +2008,18 @@ class JaxDecodeEngine(InferenceEngine):
         key = (suffix_bucket, prefix_bucket, nb)
         if key not in self._suffix_prefill_fns:
             cfg = self.model_config
+            quant = self._kv_quant
 
-            def suffix_prefill(params, kp, vp, bt_row, ids, suffix_len,
+            def suffix_prefill(params, kq, vq, bt_row, ids, suffix_len,
                                prefix_len):
                 from areal_tpu.models.qwen2 import prefill_with_prefix
+                from areal_tpu.ops.kv_quant import (
+                    dequantize_kv, join_pool, quantize_kv, scales_blocked,
+                    scales_rowmajor, split_pool,
+                )
 
+                kp, ksc = split_pool(kq)
+                vp, vsc = split_pool(vq)
                 L, _, bsz, nkv, hd = kp.shape
                 ws_k = jnp.take(kp, bt_row, axis=1).reshape(
                     L, nb * bsz, nkv, hd
@@ -1879,10 +2033,43 @@ class JaxDecodeEngine(InferenceEngine):
                 pv = jax.lax.slice(
                     ws_v, (0, 0, 0, 0), (L, prefix_bucket, nkv, hd)
                 )
+                if quant:
+                    # row-major scale workspace rides alongside the data
+                    # workspace; the PREFIX is dequantized for the suffix
+                    # pass (the same int8 view decode attends through), and
+                    # the prefix blocks scatter back their original bytes —
+                    # only the fresh suffix rows are (first-)quantized
+                    ws_ks = scales_rowmajor(jnp.take(ksc, bt_row, axis=1))
+                    ws_vs = scales_rowmajor(jnp.take(vsc, bt_row, axis=1))
+                    pk = dequantize_kv(
+                        pk,
+                        jax.lax.slice(ws_ks, (0, 0, 0), (L, prefix_bucket, nkv)),
+                        jnp.dtype(cfg.dtype),
+                    )
+                    pv = dequantize_kv(
+                        pv,
+                        jax.lax.slice(ws_vs, (0, 0, 0), (L, prefix_bucket, nkv)),
+                        jnp.dtype(cfg.dtype),
+                    )
                 valid = jnp.arange(ids.shape[0]) < suffix_len
                 ks, vs = prefill_with_prefix(
                     params, ids, pk, pv, prefix_len, cfg, valid=valid
                 )
+                if quant:
+                    ks, sk = quantize_kv(ks)
+                    vs, sv = quantize_kv(vs)
+                    ws_ks = jax.lax.dynamic_update_slice(
+                        ws_ks, sk, (0, prefix_len, 0)
+                    )
+                    ws_vs = jax.lax.dynamic_update_slice(
+                        ws_vs, sv, (0, prefix_len, 0)
+                    )
+                    ksc = ksc.at[:, bt_row].set(
+                        scales_blocked(ws_ks, nb, bsz)
+                    )
+                    vsc = vsc.at[:, bt_row].set(
+                        scales_blocked(ws_vs, nb, bsz)
+                    )
                 ws_k = jax.lax.dynamic_update_slice(
                     ws_k, ks.astype(kp.dtype), (0, prefix_len, 0, 0)
                 )
@@ -1895,7 +2082,7 @@ class JaxDecodeEngine(InferenceEngine):
                 vp = vp.at[:, bt_row].set(
                     ws_v.reshape(L, nb, bsz, nkv, hd)
                 )
-                return kp, vp
+                return join_pool(kp, ksc), join_pool(vp, vsc)
 
             self._suffix_prefill_fns[key] = jax.jit(
                 suffix_prefill, donate_argnums=(1, 2)
@@ -2337,15 +2524,16 @@ class JaxDecodeEngine(InferenceEngine):
                 fn = self._get_suffix_prefill_fn(sb, pb, nb)
                 t_pf = time.monotonic()
                 with self._weight_lock:
-                    self._k_cache, self._v_cache = fn(
+                    kq, vq = self._kv_operands()
+                    self._set_kv_operands(*fn(
                         self.params,
-                        self._k_cache,
-                        self._v_cache,
+                        kq,
+                        vq,
                         jnp.asarray(self._alloc.row(slot_idx, nb)),
                         jnp.asarray(ids),
                         len(suffix),
                         plen,
-                    )
+                    ))
                 self._note_prefill_wall(time.monotonic() - t_pf)
                 self._register_prefix(slot_idx, list(prompt[:-1]))
             elif resumed is None and P > 1 and not promoted:
@@ -2376,10 +2564,11 @@ class JaxDecodeEngine(InferenceEngine):
                     )
                     t_pf = time.monotonic()
                     with self._weight_lock:
-                        self._k_cache, self._v_cache = fn(
+                        kq, vq = self._kv_operands()
+                        self._set_kv_operands(*fn(
                             self.params,
-                            self._k_cache,
-                            self._v_cache,
+                            kq,
+                            vq,
                             jnp.asarray(ids),
                             jnp.asarray(positions),
                             jnp.asarray(self._alloc.row(slot_idx, nb_w)),
@@ -2387,7 +2576,7 @@ class JaxDecodeEngine(InferenceEngine):
                             img_embeds,
                             cos,
                             sin,
-                        )
+                        ))
                     self._note_prefill_wall(time.monotonic() - t_pf)
                 elif is_wave_dup:
                     # duplicate within this admission wave: fork from the
@@ -2496,22 +2685,24 @@ class JaxDecodeEngine(InferenceEngine):
                     slot_idx, ids, pre, _, _ = group[0]
                     fn = self._get_prefill_fn(bucket)
                     with self._weight_lock:
-                        self._k_cache, self._v_cache = fn(
+                        kq, vq = self._kv_operands()
+                        self._set_kv_operands(*fn(
                             self.params,
-                            self._k_cache,
-                            self._v_cache,
+                            kq,
+                            vq,
                             jnp.asarray(ids),
                             jnp.asarray(positions),
                             self._alloc.row(slot_idx, nb_w),
                             pre,
-                        )
+                        ))
                 else:
                     fn = self._get_batched_prefill_fn(bucket, B)
                     with self._weight_lock:
-                        self._k_cache, self._v_cache = fn(
+                        kq, vq = self._kv_operands()
+                        self._set_kv_operands(*fn(
                             self.params,
-                            self._k_cache,
-                            self._v_cache,
+                            kq,
+                            vq,
                             jnp.asarray(
                                 np.stack([g[1] for g in group])
                             ),
@@ -2524,7 +2715,7 @@ class JaxDecodeEngine(InferenceEngine):
                             jnp.asarray(
                                 np.array([g[2] for g in group], np.int32)
                             ),
-                        )
+                        ))
                 self._note_prefill_wall(time.monotonic() - t_pf, n=B)
                 for slot_idx, _, _, _, covered_t in group:
                     self._register_prefix(slot_idx, list(covered_t))
@@ -2549,17 +2740,18 @@ class JaxDecodeEngine(InferenceEngine):
                         nb_w = -(-bucket // self._alloc.block_size)
                         fn = self._get_prefill_fn(bucket)
                         with self._weight_lock:
-                            self._k_cache, self._v_cache = fn(
+                            kq, vq = self._kv_operands()
+                            self._set_kv_operands(*fn(
                                 self.params,
-                                self._k_cache,
-                                self._v_cache,
+                                kq,
+                                vq,
                                 jnp.asarray(ids),
                                 jnp.asarray(
                                     np.arange(bucket, dtype=np.int32)
                                 ),
                                 self._alloc.row(dst, nb_w),
                                 covered,
-                            )
+                            ))
                     else:
                         self._preempt_slot(dst)
                         continue
@@ -2934,9 +3126,10 @@ class JaxDecodeEngine(InferenceEngine):
             verify_fn = self._get_verify_fn(use_topp, nb, spec_w)
             t_dispatch = time.monotonic()
             with self._weight_lock:
+                kq, vq = self._kv_operands()
                 (
-                    self._k_cache,
-                    self._v_cache,
+                    kq,
+                    vq,
                     self._dev_last,
                     self._dev_lengths,
                     toks,
@@ -2944,8 +3137,8 @@ class JaxDecodeEngine(InferenceEngine):
                     accepted,
                 ) = verify_fn(
                     self.params,
-                    self._k_cache,
-                    self._v_cache,
+                    kq,
+                    vq,
                     self._table_device(nb),
                     self._dev_last,
                     self._dev_lengths,
@@ -2958,6 +3151,7 @@ class JaxDecodeEngine(InferenceEngine):
                     jnp.asarray(drafts_np),  # fresh per-dispatch, no alias
                     jnp.asarray(dlens_np),
                 )
+                self._set_kv_operands(kq, vq)
             for arr in (toks, logps, accepted):
                 copy_async = getattr(arr, "copy_to_host_async", None)
                 if copy_async is not None:
@@ -2979,12 +3173,11 @@ class JaxDecodeEngine(InferenceEngine):
             with self._metrics_lock:
                 self._chunks_dispatched += 1
                 if copies:
-                    cfgm = self.model_config
+                    # PHYSICAL bytes: _block_nbytes is dtype-aware (int8
+                    # data + f32 scales), so the counter cannot report fp
+                    # bytes for a quantized pool
                     self._ws_copy_bytes += (
-                        copies * 2 * cfgm.num_hidden_layers * R * nb
-                        * self._alloc.block_size * cfgm.num_key_value_heads
-                        * cfgm.head_dim_
-                        * jnp.dtype(self.config.kv_cache_dtype).itemsize
+                        copies * R * nb * self._block_nbytes
                     )
             return _Inflight(
                 toks=toks,
@@ -3002,10 +3195,11 @@ class JaxDecodeEngine(InferenceEngine):
         chunk_fn = self._get_chunk_fn(use_topp, use_freq, nb)
         t_dispatch = time.monotonic()
         with self._weight_lock:
+            kq, vq = self._kv_operands()
             args = [
                 self.params,
-                self._k_cache,
-                self._v_cache,
+                kq,
+                vq,
                 self._table_device(nb),
                 self._dev_last,
                 self._dev_lengths,
@@ -3025,8 +3219,8 @@ class JaxDecodeEngine(InferenceEngine):
                         (R, self.model_config.vocab_size), jnp.float32
                     )
                 (
-                    self._k_cache,
-                    self._v_cache,
+                    kq,
+                    vq,
                     self._dev_last,
                     self._dev_lengths,
                     toks,
@@ -3035,13 +3229,14 @@ class JaxDecodeEngine(InferenceEngine):
                 ) = chunk_fn(*args, ctl["freq_pens"], self._freq_counts)
             else:
                 (
-                    self._k_cache,
-                    self._v_cache,
+                    kq,
+                    vq,
                     self._dev_last,
                     self._dev_lengths,
                     toks,
                     logps,
                 ) = chunk_fn(*args)
+            self._set_kv_operands(kq, vq)
         # start the device-to-host copies now; _consume_chunk's np.asarray
         # then only waits for data that isn't already on the host
         for arr in (toks, logps):
@@ -3056,22 +3251,20 @@ class JaxDecodeEngine(InferenceEngine):
         # pagedattn bench comparison): workspace pays gather AND scatter
         # of k+v; the paged xla impl keeps only the gather (delta
         # write-back is O(R·n_chunk) rows, negligible); the Pallas
-        # in-pool impl copies nothing.
+        # in-pool impl copies nothing. Int8 on the xla impl runs the
+        # in-pool scan — a per-step gather per layer, honestly n_chunk
+        # gathers of the (already halved) physical block bytes.
         copies = (
             2 if self.config.kv_layout == "workspace"
-            else 1 if self._paged_impl == "xla"
-            else 0
+            else 0 if self._paged_impl == "pallas"
+            else n_chunk if self._kv_quant
+            else 1
         )
         with self._metrics_lock:
             self._chunks_dispatched += 1
             if copies:
-                cfgm = self.model_config
-                self._ws_copy_bytes += (
-                    copies * 2 * cfgm.num_hidden_layers * R * nb
-                    * self._alloc.block_size * cfgm.num_key_value_heads
-                    * cfgm.head_dim_
-                    * jnp.dtype(self.config.kv_cache_dtype).itemsize
-                )
+                # dtype-aware physical bytes (int8 data + f32 scales)
+                self._ws_copy_bytes += copies * R * nb * self._block_nbytes
         return _Inflight(
             toks=toks,
             logps=logps,
@@ -3538,15 +3731,21 @@ class JaxDecodeEngine(InferenceEngine):
                     jnp.zeros(R, dtype=jnp.int32),
                     jnp.asarray(np.array(self._slot_lengths)),
                 )
+                # the ghost compiles below warm whichever (layout,
+                # kv_dtype) variants the live config selects — an int8
+                # engine ghost-compiles the QUANTIZED chunk/verify fns, so
+                # the first quantized wave never eats a compile; skips name
+                # the dtype so an operator can tell WHICH pool variant will
+                # stall
+                kvd = f"{self.config.kv_layout}/{self.config.kv_dtype}"
                 for b in buckets:
                     nb = -(-b // self._alloc.block_size)
                     for use_topp in classes:
                         if (use_topp, False, nb) in self._chunk_fns:
                             continue
-                        layout = self.config.kv_layout
                         if nb > self._alloc.max_blocks_per_slot:
                             logger.warning(
-                                f"prewarm: {layout} chunk variant "
+                                f"prewarm: {kvd} chunk variant "
                                 f"(top_p<1={use_topp}, nb={nb}) skipped — "
                                 "exceeds the pool's max_blocks_per_slot="
                                 f"{self._alloc.max_blocks_per_slot}; a live "
@@ -3558,7 +3757,7 @@ class JaxDecodeEngine(InferenceEngine):
                             self._ghost_chunk(use_topp, nb)
                         except Exception as e:  # noqa: BLE001
                             logger.warning(
-                                f"prewarm: {layout} chunk variant "
+                                f"prewarm: {kvd} chunk variant "
                                 f"(top_p<1={use_topp}, nb={nb}) skipped — "
                                 f"ghost compile failed: {e}; live traffic "
                                 "at this bucket will hit a first-compile "
@@ -3582,10 +3781,9 @@ class JaxDecodeEngine(InferenceEngine):
                                 W = db + 1
                                 if (use_topp, nb, W) in self._verify_fns:
                                     continue
-                                layout = self.config.kv_layout
                                 spec_desc = (
                                     f"spec_decode=ngram spec_k={spec_k} "
-                                    f"{layout} verify variant (W={W}, "
+                                    f"{kvd} verify variant (W={W}, "
                                     f"top_p<1={use_topp}, nb={nb})"
                                 )
                                 if nb > self._alloc.max_blocks_per_slot:
@@ -3626,17 +3824,18 @@ class JaxDecodeEngine(InferenceEngine):
         chunk_fn = self._get_chunk_fn(use_topp, False, nb)
         ctl = self._refresh_ctl()
         with self._weight_lock:
+            kq, vq = self._kv_operands()
             (
-                self._k_cache,
-                self._v_cache,
+                kq,
+                vq,
                 self._dev_last,
                 self._dev_lengths,
                 _toks,
                 _logps,
             ) = chunk_fn(
                 self.params,
-                self._k_cache,
-                self._v_cache,
+                kq,
+                vq,
                 self._table_device(nb),
                 self._dev_last,
                 self._dev_lengths,
@@ -3647,6 +3846,7 @@ class JaxDecodeEngine(InferenceEngine):
                 ctl["greedy"],
                 ctl["rope_delta"],
             )
+            self._set_kv_operands(kq, vq)
 
     def _ghost_verify(self, use_topp: bool, nb: int, W: int) -> None:
         """Dispatch one VERIFY chunk with every slot inactive: same
@@ -3658,9 +3858,10 @@ class JaxDecodeEngine(InferenceEngine):
         verify_fn = self._get_verify_fn(use_topp, nb, W)
         ctl = self._refresh_ctl()
         with self._weight_lock:
+            kq, vq = self._kv_operands()
             (
-                self._k_cache,
-                self._v_cache,
+                kq,
+                vq,
                 self._dev_last,
                 self._dev_lengths,
                 _toks,
@@ -3668,8 +3869,8 @@ class JaxDecodeEngine(InferenceEngine):
                 _acc,
             ) = verify_fn(
                 self.params,
-                self._k_cache,
-                self._v_cache,
+                kq,
+                vq,
                 self._table_device(nb),
                 self._dev_last,
                 self._dev_lengths,
@@ -3682,6 +3883,7 @@ class JaxDecodeEngine(InferenceEngine):
                 jnp.zeros((R, W - 1), dtype=jnp.int32),
                 jnp.zeros(R, dtype=jnp.int32),
             )
+            self._set_kv_operands(kq, vq)
 
     def _warn_wave_not_compiled(self, bucket: int, w: int) -> None:
         """Post-wave prewarm check: a wave can admit below its intended size
@@ -3745,18 +3947,26 @@ class JaxDecodeEngine(InferenceEngine):
 
     def export_session(self, rid: str) -> dict | None:
         """MOVE one session's resumable KV out of this engine: returns
-        {"meta": <HostKVEntry contract dict>, "k": np, "v": np} or None
-        when the rid holds no exportable session.
+        {"meta": <HostKVEntry contract dict>, "k": np, "v": np} — plus
+        "ks"/"vs" scale arrays when the pool is int8 — or None when the
+        rid holds no exportable session.
 
         Parked sessions: the covering pool blocks are gathered to host
         and the parked entry is dropped — but the blocks stay registered
         as donor material, so same-prompt siblings still fork locally.
         Host-tier sessions are taken from the store (materialised). The
-        metadata carries the weight version; the importing replica
-        rejects a version mismatch as an honest miss (the migration
-        raced a weight commit). Safe from the HTTP thread: parked blocks
-        are never written by in-flight chunks, and the gather serialises
-        under _sched_lock -> _weight_lock like every other pool read."""
+        metadata carries the weight version AND the kv dtype; the
+        importing replica rejects a mismatch of either as an honest miss
+        (a version mismatch = the migration raced a weight commit; a
+        dtype mismatch = a mixed-dtype fleet — requantizing in flight
+        would silently change the stream). An int8 session ships its
+        quantized blocks + scales AS-IS on every hop: the wire bytes are
+        the pool bytes, already halved. Safe from the HTTP thread: parked
+        blocks are never written by in-flight chunks, and the gather
+        serialises under _sched_lock -> _weight_lock like every other
+        pool read."""
+        from areal_tpu.ops.kv_quant import split_pool
+
         try:
             # bind this engine's mesh: the gather traces on the HTTP
             # thread, which (unlike the scheduler thread) has no ambient
@@ -3776,11 +3986,14 @@ class JaxDecodeEngine(InferenceEngine):
                         return None
                     fn = self._get_host_gather_fn()
                     with self._weight_lock:
-                        hk, hv = fn(
-                            self._k_cache,
-                            self._v_cache,
+                        kq, vq = self._kv_operands()
+                        hkq, hvq = fn(
+                            kq,
+                            vq,
                             jnp.asarray(self._alloc.row(slot, nb)),
                         )
+                    hk, hks = split_pool(hkq)
+                    hv, hvs = split_pool(hvq)
                     meta = dict(
                         rid=rid,
                         covered=int(covered),
@@ -3791,17 +4004,25 @@ class JaxDecodeEngine(InferenceEngine):
                         ],
                         weight_version=int(self._version),
                         nb=int(nb),
+                        kv_dtype=self.config.kv_dtype,
                     )
                     # the session moves: drop the parked entry, keep the
                     # blocks as a donor registration (prefix reuse only)
                     self._parked.pop(rid, None)
                     self._parked_tokens.pop(rid, None)
                     self._register_prefix(slot, tokens)
-                    k, v = np.asarray(hk), np.asarray(hv)
+                    out = dict(meta=meta, k=np.asarray(hk), v=np.asarray(hv))
+                    if hks is not None:
+                        out["ks"] = np.asarray(hks)
+                        out["vs"] = np.asarray(hvs)
+                    nbytes = sum(
+                        a.nbytes for key_ in ("k", "v", "ks", "vs")
+                        for a in [out.get(key_)] if a is not None
+                    )
                     with self._metrics_lock:
                         self._n_migrated_out += 1
-                        self._migrated_out_bytes += k.nbytes + v.nbytes
-                    return dict(meta=meta, k=k, v=v)
+                        self._migrated_out_bytes += nbytes
+                    return out
                 with self._host_lock:
                     store = self._host_store
                     entry = store.take(rid) if store is not None else None
@@ -3815,12 +4036,22 @@ class JaxDecodeEngine(InferenceEngine):
                     base_key=[int(x) for x in np.asarray(entry.base_key)],
                     weight_version=int(entry.weight_version),
                     nb=int(entry.nb),
+                    kv_dtype=str(entry.kv_dtype),
                 )
-                k, v = np.asarray(entry.k), np.asarray(entry.v)
+                out = dict(
+                    meta=meta, k=np.asarray(entry.k), v=np.asarray(entry.v)
+                )
+                if entry.ks is not None:
+                    out["ks"] = np.asarray(entry.ks)
+                    out["vs"] = np.asarray(entry.vs)
+                nbytes = sum(
+                    a.nbytes for key_ in ("k", "v", "ks", "vs")
+                    for a in [out.get(key_)] if a is not None
+                )
                 with self._metrics_lock:
                     self._n_migrated_out += 1
-                    self._migrated_out_bytes += k.nbytes + v.nbytes
-                return dict(meta=meta, k=k, v=v)
+                    self._migrated_out_bytes += nbytes
+                return out
         except Exception as e:  # noqa: BLE001 — degrade, never wedge
             # a failed export (gather error, injected swap fault) costs a
             # re-prefill on whichever replica the session resumes on —
@@ -3847,13 +4078,21 @@ class JaxDecodeEngine(InferenceEngine):
                 block_size=block_size,
             )
 
-    def import_session(self, meta: dict, k: Any, v: Any) -> str:
+    def import_session(
+        self, meta: dict, k: Any, v: Any, ks: Any = None, vs: Any = None
+    ) -> str:
         """Land a migrated session in this engine's host tier, where the
         next /generate for its rid promotes it through the swap-in seam
         (zero re-prefill). Returns "ok", "stale_version" (the KV was
         computed under a different weight version — the rid is
         tombstoned so its resume counts an honest miss and re-prefills
-        under the current weights), or "rejected" (malformed/budget).
+        under the current weights), "kv_dtype_mismatch" (the session's
+        pool dtype differs from this engine's — a mixed-dtype fleet;
+        requantizing in flight would change the stream, so the rid is
+        tombstoned exactly like a stale version and the resume
+        re-prefills), or "rejected" (malformed/budget). Int8 sessions
+        carry their scale blocks in `ks`/`vs` and land verbatim — no
+        requantization on this hop either.
         """
         if self._alloc is None or self._k_cache is None:
             return "rejected"
@@ -3863,9 +4102,12 @@ class JaxDecodeEngine(InferenceEngine):
             nb = int(meta["nb"])
             tokens = [int(t) for t in meta["tokens"]]
             wv = int(meta.get("weight_version", -1))
+            sess_dtype = str(meta.get("kv_dtype", "fp"))
             base_key = np.asarray(meta["base_key"], dtype=np.uint32)
             k = np.asarray(k)
             v = np.asarray(v)
+            ks = None if ks is None else np.asarray(ks)
+            vs = None if vs is None else np.asarray(vs)
         except (KeyError, TypeError, ValueError):
             return "rejected"
         L, _, bs, nkv, hd = self._k_cache.shape
@@ -3876,6 +4118,30 @@ class JaxDecodeEngine(InferenceEngine):
             or covered <= 0
             or len(tokens) != covered
             or self._alloc.blocks_for(covered) != nb
+        ):
+            return "rejected"
+        if sess_dtype != self.config.kv_dtype:
+            # mixed-dtype fleet: the same tombstoned-honest-miss rule as a
+            # weight-version race — the resume must re-prefill here, not
+            # resume bytes this pool cannot hold losslessly
+            with self._host_lock:
+                self._ensure_host_store_locked(bs)
+                self._host_store.tombstone(rid)
+            with self._metrics_lock:
+                self._n_migrate_dtype_rejects += 1
+            logger.warning(
+                f"kv import of {rid} rejected: session kv_dtype "
+                f"{sess_dtype!r} != engine kv_dtype "
+                f"{self.config.kv_dtype!r}"
+            )
+            return "kv_dtype_mismatch"
+        if self._kv_quant and (
+            k.dtype != np.int8
+            or v.dtype != np.int8
+            or ks is None
+            or vs is None
+            or ks.shape != (L, nb, nkv, bs)
+            or vs.shape != (L, nb, nkv, bs)
         ):
             return "rejected"
         if wv >= 0 and wv != self._version:
@@ -3897,6 +4163,9 @@ class JaxDecodeEngine(InferenceEngine):
             rid=rid,
             k=k,
             v=v,
+            ks=ks,
+            vs=vs,
+            kv_dtype=sess_dtype,
             nb=nb,
             covered=covered,
             tokens=tokens,
@@ -3911,9 +4180,12 @@ class JaxDecodeEngine(InferenceEngine):
             ok = self._host_store.put(entry)
         if not ok:
             return "rejected"
+        nbytes = k.nbytes + v.nbytes + sum(
+            a.nbytes for a in (ks, vs) if a is not None
+        )
         with self._metrics_lock:
             self._n_migrated_in += 1
-            self._migrated_in_bytes += k.nbytes + v.nbytes
+            self._migrated_in_bytes += nbytes
         return "ok"
 
     # -- weight updates -------------------------------------------------
@@ -4216,6 +4488,7 @@ class JaxDecodeEngine(InferenceEngine):
             migrated_in_bytes = self._migrated_in_bytes
             migrated_out_bytes = self._migrated_out_bytes
             migrate_version_rejects = self._n_migrate_version_rejects
+            migrate_dtype_rejects = self._n_migrate_dtype_rejects
         # host-KV-tier snapshot (own lock — rank 25, before _metrics at
         # 30): occupancy + swap traffic are the pressure signals the
         # prefix-aware router will route on, next to
@@ -4327,6 +4600,9 @@ class JaxDecodeEngine(InferenceEngine):
             "kv_migrated_in_bytes_total": migrated_in_bytes,
             "kv_migrated_out_bytes_total": migrated_out_bytes,
             "kv_migrate_version_rejects_total": migrate_version_rejects,
+            # imports refused on a kv-dtype mismatch (mixed-dtype fleet —
+            # tombstoned honest misses, like the version rule)
+            "kv_migrate_dtype_rejects_total": migrate_dtype_rejects,
             "kv_host_version_rejects_total": host["version_rejects"],
             "prefills_total": self._n_prefills,
             "prefix_forks_total": self._n_prefix_forks,
@@ -4337,6 +4613,16 @@ class JaxDecodeEngine(InferenceEngine):
             ),
             "preemptions_total": self._n_preemptions,
             "kv_layout": self.config.kv_layout,
+            # pool storage dtype + PHYSICAL bytes per block (int8 data +
+            # f32 scales when quantized): every byte counter here derives
+            # from kv_block_nbytes, so none assumes the fp element size
+            "kv_dtype": self.config.kv_dtype,
+            "kv_block_nbytes": self._block_nbytes,
+            "kv_pool_device_bytes": (
+                self._alloc.n_blocks * self._block_nbytes
+                if self._alloc
+                else 0
+            ),
             "kv_block_size": self._alloc.block_size if self._alloc else 0,
             "kv_blocks_total": self._alloc.usable_blocks if self._alloc else 0,
             "kv_blocks_free": self._alloc.free_blocks if self._alloc else 0,

@@ -208,6 +208,17 @@ class HostKVEntry:
     # stream the new policy never produced (extends PR 7's install-flush
     # tombstone rule across replicas). -1 = unknown (legacy callers).
     weight_version: int = -1
+    # int8 pools (kv_dtype="int8"): the per-(row, head) f32 scale blocks
+    # gathered alongside the data blocks ([L, nb, nKV, block_size] each).
+    # None on the fp path. The quantized bytes + scales travel AS-IS
+    # through offload, promotion, export and migration — no hop ever
+    # requantizes, so a promoted/imported stream reads the exact bytes
+    # the original scatter wrote.
+    ks: Any = None
+    vs: Any = None
+    # which pool scheme produced k/v ("fp" | "int8"); migration rejects a
+    # mismatch with the receiving engine as a tombstoned honest miss
+    kv_dtype: str = "fp"
     ts: float = 0.0
     nbytes: int = 0
     pending: bool = field(default=False, repr=False)
@@ -218,6 +229,9 @@ class HostKVEntry:
         if self.pending:
             self.k = np.asarray(self.k)
             self.v = np.asarray(self.v)
+            if self.ks is not None:
+                self.ks = np.asarray(self.ks)
+                self.vs = np.asarray(self.vs)
             self.pending = False
 
 

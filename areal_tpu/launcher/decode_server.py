@@ -213,6 +213,7 @@ class DecodeServer:
                 "max_running_requests": self.config.max_running_requests,
                 "decode_runahead_chunks": self.config.decode_runahead_chunks,
                 "kv_layout": self.config.kv_layout,
+                "kv_dtype": getattr(self.config, "kv_dtype", "fp"),
                 "kv_host_pool_mb": self.config.kv_host_pool_mb,
                 "paged_attn_impl": self.config.paged_attn_impl,
                 "spec_decode": self.config.spec_decode,
@@ -626,6 +627,8 @@ class DecodeServer:
                 sess["meta"],
                 sess["k"],
                 sess["v"],
+                ks=sess.get("ks"),
+                vs=sess.get("vs"),
                 chunk_mb=getattr(self.config, "kv_migrate_chunk_mb", 64.0),
             )
         )
@@ -809,11 +812,14 @@ class DecodeServer:
         del self._kv_staging[xid]
         loop = asyncio.get_running_loop()
         t0 = time.monotonic()
-        counts = {"ok": 0, "stale_version": 0, "rejected": 0}
+        counts = {
+            "ok": 0, "stale_version": 0, "kv_dtype_mismatch": 0, "rejected": 0,
+        }
         rids = []
-        for meta, k, v in sessions:
+        for meta, k, v, scales in sessions:
+            ks, vs = scales if scales is not None else (None, None)
             verdict = await loop.run_in_executor(
-                None, self.engine.import_session, meta, k, v
+                None, self.engine.import_session, meta, k, v, ks, vs
             )
             counts[verdict] = counts.get(verdict, 0) + 1
             if verdict == "ok":
@@ -822,6 +828,7 @@ class DecodeServer:
             "status": "ok",
             "imported": counts["ok"],
             "stale_version": counts["stale_version"],
+            "kv_dtype_mismatch": counts["kv_dtype_mismatch"],
             "rejected": counts["rejected"],
             "rids": rids,
         }
@@ -972,6 +979,7 @@ async def _serve(args: argparse.Namespace) -> None:
         new_tokens_per_chunk=args.new_tokens_per_chunk,
         decode_runahead_chunks=args.decode_runahead_chunks,
         kv_layout=args.kv_layout,
+        kv_dtype=args.kv_dtype,
         kv_host_pool_mb=args.kv_host_pool_mb,
         paged_attn_impl=args.paged_attn_impl,
         spec_decode=args.spec_decode,
@@ -1093,6 +1101,18 @@ def main(argv: list[str] | None = None) -> None:
         help="decode KV access: 'paged' attends in place over the paged "
              "pool through the block table (no per-chunk gather/scatter); "
              "'workspace' is the legacy copy-in/copy-out numerics oracle",
+    )
+    p.add_argument(
+        "--kv-dtype",
+        default="fp",
+        choices=["fp", "int8"],
+        help="paged-pool storage: 'fp' keeps kv_cache_dtype (the numerics "
+             "oracle); 'int8' stores the pool quantized with per-row/"
+             "per-head scales (needs --kv-layout paged) — ~2x the resident "
+             "sessions per MB, and swaps/migration ship the quantized "
+             "bytes as-is (mixed-dtype fleets reject imports as honest "
+             "misses). Drift is measured (bench.py --mode kvquant), not "
+             "assumed zero",
     )
     p.add_argument(
         "--kv-host-pool-mb",

@@ -39,6 +39,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
+from typing import Any
 
 PADDING_SEGMENT = -1
 
@@ -1970,14 +1971,14 @@ def decode_step_paged(
     params: dict,
     tokens: jax.Array,  # [R] current input token per slot
     positions: jax.Array,  # [R] logical index the new token occupies
-    k_pool: jax.Array,  # [L, n_blocks, bsz, nKV, hd] paged KV pool
-    v_pool: jax.Array,  # [L, n_blocks, bsz, nKV, hd]
+    k_pool,  # [L, n_blocks, bsz, nKV, hd] paged KV pool, or (int8, scales)
+    v_pool,  # [L, n_blocks, bsz, nKV, hd] or (int8 data, f32 scales)
     block_tables: jax.Array,  # [R, nb] int32: each slot's pool blocks
     cfg: ModelConfig,
     active: jax.Array | None = None,  # [R] bool: slot holds a live request
     rope_offset: jax.Array | None = None,  # [R] added to rope pos only
     attn_impl: str = "auto",  # ops/paged_attention.py impl select
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+) -> tuple[jax.Array, Any, Any]:
     """One batched decode step attending DIRECTLY over the paged pool.
 
     The in-pool twin of `decode_step` (same embed/rope/mlp/lm-head body;
@@ -1997,12 +1998,24 @@ def decode_step_paged(
       (engine/kv_pool.py).
     - **Attention reads through the block table** (ops/paged_attention):
       no workspace gather/scatter round-trip per chunk.
+
+    Int8 pools: `k_pool`/`v_pool` arrive as (int8 data, f32 scales)
+    tuples (ops/kv_quant.py) and are returned in the same form. The new
+    row is quantized HERE, at the O(1) scatter — one quantize per token
+    per layer — and the scale row lands in the scale pool through the
+    same block id, so every downstream byte mover (offload, export,
+    migration) ships the quantized bytes as-is. Attention dequantizes
+    inside ops/paged_attention, so the row just written is read back
+    through its int8 representation — token streams are a pure function
+    of the quantized pool state, invariant to chunk boundaries.
     """
+    from areal_tpu.ops.kv_quant import join_pool, quantize_kv, split_pool
     from areal_tpu.ops.paged_attention import paged_attention
 
     compute_dtype = jnp.dtype(cfg.dtype)
     R = tokens.shape[0]
-    bsz = k_pool.shape[2]
+    k_data, _ = split_pool(k_pool)
+    bsz = k_data.shape[2]
     nb = block_tables.shape[1]
     span = nb * bsz
     nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -2033,15 +2046,24 @@ def decode_step_paged(
         dest_block = jnp.where(active, dest_block, 0)
         dest_off = jnp.where(active, dest_off, 0)
 
-    def write(pool_l, new):  # [n_blocks, bsz, nKV, hd] <- [R, nKV, hd]
-        return pool_l.at[dest_block, dest_off].set(new)
+    def write(pool_l, new):  # [n_blocks, bsz, nKV, hd] <- [R, nKV, hd] fp
+        data, scales = split_pool(pool_l)
+        if scales is None:
+            return data.at[dest_block, dest_off].set(new.astype(data.dtype))
+        # quantize AT the scatter: int8 row + its [R, nKV] scale row land
+        # through the same block id (scales are [n_blocks, nKV, bsz])
+        q_row, s_row = quantize_kv(new)
+        return (
+            data.at[dest_block, dest_off].set(q_row),
+            scales.at[dest_block, :, dest_off].set(s_row),
+        )
 
     def layer(x, inputs):
         layer_p, kp, vp = inputs
         h = _norm(x, layer_p["input_norm"], cfg, layer_p.get("input_norm_bias"))
         q, k_new, v_new = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
-        kp = write(kp, k_new.astype(kp.dtype))
-        vp = write(vp, v_new.astype(vp.dtype))
+        kp = write(kp, k_new)
+        vp = write(vp, v_new)
         attn_out = paged_attention(
             q.reshape(R, nH, hd), kp, vp, block_tables, valid, impl=attn_impl
         )
@@ -2065,11 +2087,17 @@ def decode_step_paged(
         kps, vps = [], []
         for i in range(cfg.num_hidden_layers):
             x, (kp, vp) = layer(
-                x, (params[f"layers_{i}"], k_pool[i], v_pool[i])
+                x,
+                (
+                    params[f"layers_{i}"],
+                    jax.tree.map(lambda p: p[i], k_pool),
+                    jax.tree.map(lambda p: p[i], v_pool),
+                ),
             )
             kps.append(kp)
             vps.append(vp)
-        k_pool, v_pool = jnp.stack(kps), jnp.stack(vps)
+        k_pool = jax.tree.map(lambda *xs: jnp.stack(xs), *kps)
+        v_pool = jax.tree.map(lambda *xs: jnp.stack(xs), *vps)
 
     x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
     if cfg.tie_word_embeddings:
@@ -2204,26 +2232,30 @@ def verify_step_paged(
     params: dict,
     tokens: jax.Array,  # [R, W]: draft inputs, column 0 = the last token
     positions0: jax.Array,  # [R] base index column 0 occupies
-    k_pool: jax.Array,  # [L, n_blocks, bsz, nKV, hd]
-    v_pool: jax.Array,  # [L, n_blocks, bsz, nKV, hd]
+    k_pool,  # [L, n_blocks, bsz, nKV, hd], or (int8 data, f32 scales)
+    v_pool,  # [L, n_blocks, bsz, nKV, hd] or (int8 data, f32 scales)
     block_tables: jax.Array,  # [R, nb]
     cfg: ModelConfig,
     active: jax.Array | None = None,
     rope_offset: jax.Array | None = None,
     attn_impl: str = "auto",
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+) -> tuple[jax.Array, Any, Any]:
     """The in-pool twin of `verify_step` (see its contract): W positions
     per slot scored in one forward DIRECTLY over the paged pool. The KV
     write is an O(W) row scatter through the block table (inactive slots
     redirect to the reserved null block 0, like `decode_step_paged`), and
     attention reads through the table with per-query causal masks
     (ops/paged_attention.paged_attention_qlen — the Pallas impl DMAs each
-    pool block once for all W queries)."""
+    pool block once for all W queries). Int8 pools quantize the W rows at
+    this scatter and return (data, scales) tuples, exactly as
+    `decode_step_paged` does for its single row."""
+    from areal_tpu.ops.kv_quant import quantize_kv, split_pool
     from areal_tpu.ops.paged_attention import paged_attention_qlen
 
     compute_dtype = jnp.dtype(cfg.dtype)
     R, W = tokens.shape
-    bsz = k_pool.shape[2]
+    k_data, _ = split_pool(k_pool)
+    bsz = k_data.shape[2]
     nb = block_tables.shape[1]
     span = nb * bsz
     nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
@@ -2264,15 +2296,24 @@ def verify_step_paged(
         None if active is None else jnp.repeat(active, W, axis=0)
     )
 
-    def write(pool_l, new):  # [n_blocks, bsz, nKV, hd] <- [R*W, nKV, hd]
-        return pool_l.at[dest_block_f, dest_off_f].set(new)
+    def write(pool_l, new):  # [n_blocks, bsz, nKV, hd] <- [R*W, nKV, hd] fp
+        data, scales = split_pool(pool_l)
+        if scales is None:
+            return data.at[dest_block_f, dest_off_f].set(
+                new.astype(data.dtype)
+            )
+        q_rows, s_rows = quantize_kv(new)
+        return (
+            data.at[dest_block_f, dest_off_f].set(q_rows),
+            scales.at[dest_block_f, :, dest_off_f].set(s_rows),
+        )
 
     def layer(x, inputs):
         layer_p, kp, vp = inputs
         h = _norm(x, layer_p["input_norm"], cfg, layer_p.get("input_norm_bias"))
         q, k_new, v_new = _project_qkv(layer_p["attn"], h, cos, sin, cfg)
-        kp = write(kp, k_new.astype(kp.dtype))
-        vp = write(vp, v_new.astype(vp.dtype))
+        kp = write(kp, k_new)
+        vp = write(vp, v_new)
         attn_out = paged_attention_qlen(
             q.reshape(R, W, nH, hd), kp, vp, block_tables, valid,
             impl=attn_impl,
@@ -2297,11 +2338,17 @@ def verify_step_paged(
         kps, vps = [], []
         for i in range(cfg.num_hidden_layers):
             x, (kp, vp) = layer(
-                x, (params[f"layers_{i}"], k_pool[i], v_pool[i])
+                x,
+                (
+                    params[f"layers_{i}"],
+                    jax.tree.map(lambda p: p[i], k_pool),
+                    jax.tree.map(lambda p: p[i], v_pool),
+                ),
             )
             kps.append(kp)
             vps.append(vp)
-        k_pool, v_pool = jnp.stack(kps), jnp.stack(vps)
+        k_pool = jax.tree.map(lambda *xs: jnp.stack(xs), *kps)
+        v_pool = jax.tree.map(lambda *xs: jnp.stack(xs), *vps)
 
     x = _norm(x, params["final_norm"], cfg, params.get("final_norm_bias"))
     if cfg.tie_word_embeddings:

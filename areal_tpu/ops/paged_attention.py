@@ -26,6 +26,15 @@ The per-token KV *write* is not this op's job: `decode_step_paged`
 (models/qwen2.py) writes the single (block, offset) row with a dynamic
 scatter — O(1) per token where the workspace path's one-hot masked
 rewrite touched the whole [R, S] cache per layer per step.
+
+Int8 pools (ops/kv_quant.py): `k_pool`/`v_pool` may arrive as
+(int8 data, f32 scales) tuples. The Pallas kernels then DMA the scale
+block through the SAME block-table index map as the data block and
+dequantize right after the HBM→VMEM transfer — attention math runs in
+f32 exactly as for fp pools, only the bytes moved from HBM are halved.
+The XLA fallback dequantizes immediately after its gather, before the
+workspace-identical einsum sequence, so both impls score the same
+effective values.
 """
 
 from __future__ import annotations
@@ -38,6 +47,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from areal_tpu.ops.kv_quant import dequantize_kv, scales_rowmajor, split_pool
 
 _NEG_INF = -1e30
 
@@ -61,14 +72,28 @@ def _default_interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _gather_dequant(pool, scales, idx, R, nb, bsz, nKV, hd, dtype):
+    """Gather `idx` blocks into [R, nb*bsz, nKV, hd]; int8 pools are
+    dequantized right after the gather (the seam the Pallas kernel puts
+    right after its DMA), so both impls score the same effective values."""
+    c = jnp.take(pool, idx, axis=0).reshape(R, nb * bsz, nKV, hd)
+    if scales is None:
+        return c
+    sc = scales_rowmajor(
+        jnp.take(scales, idx, axis=0).reshape(R, nb, nKV, bsz)
+    )  # [R, nb*bsz, nKV]
+    return dequantize_kv(c, sc, dtype)
+
+
 def _paged_attention_xla(q, k_pool, v_pool, block_table, valid, sm_scale):
+    (k_pool, k_scales), (v_pool, v_scales) = split_pool(k_pool), split_pool(v_pool)
     R, nH, hd = q.shape
     bsz, nKV = k_pool.shape[1], k_pool.shape[2]
     nb = block_table.shape[1]
     group = nH // nKV
     idx = block_table.reshape(-1)
-    kc = jnp.take(k_pool, idx, axis=0).reshape(R, nb * bsz, nKV, hd)
-    vc = jnp.take(v_pool, idx, axis=0).reshape(R, nb * bsz, nKV, hd)
+    kc = _gather_dequant(k_pool, k_scales, idx, R, nb, bsz, nKV, hd, q.dtype)
+    vc = _gather_dequant(v_pool, v_scales, idx, R, nb, bsz, nKV, hd, q.dtype)
     # the exact op/cast sequence of the workspace decode_step attention —
     # bitwise-equal logits are the parity contract with kv_layout="workspace"
     qg = q.reshape(R, nKV, group, hd)
@@ -140,9 +165,66 @@ def _paged_attn_kernel(
         o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
 
 
+def _paged_attn_kernel_q8(
+    bt_ref,  # [R, nb] scalar-prefetch block table
+    mask_ref,  # (1, bsz) int32 validity rows for this block
+    q_ref,  # (1, 1, group, hd)
+    k_ref,  # (1, bsz, 1, hd) int8 — THE pool block bt[r, b], DMA'd in place
+    ks_ref,  # (1, 1, bsz) f32 — that block's K scales, same page walk
+    v_ref,  # (1, bsz, 1, hd) int8
+    vs_ref,  # (1, 1, bsz) f32
+    o_ref,  # (1, 1, group, hd)
+    acc_ref,  # VMEM (group, hd) f32
+    m_ref,  # VMEM (group, 1) f32
+    l_ref,  # VMEM (group, 1) f32
+    *,
+    sm_scale: float,
+):
+    """The split-KV kernel for int8 pools: identical online-softmax body,
+    but each grid step also DMAs the block's per-row scales (a bsz-float
+    strip — tiny next to the halved KV bytes) and dequantizes immediately
+    after the HBM→VMEM transfer. Attention math stays f32."""
+    b = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(b == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [group, hd]
+    # dequantize right after the DMA: int8 rows x per-row scales
+    k = k_ref[0, :, 0].astype(jnp.float32) * ks_ref[0, 0][:, None]  # [bsz, hd]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * sm_scale
+    s = jnp.where(mask_ref[0][None, :] != 0, s, _NEG_INF)
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    m_ref[:] = m_new
+    v = v_ref[0, :, 0].astype(jnp.float32) * vs_ref[0, 0][:, None]
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(b == nb - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
 def _paged_attention_pallas(
     q, k_pool, v_pool, block_table, valid, sm_scale, interpret
 ):
+    (k_pool, k_scales), (v_pool, v_scales) = split_pool(k_pool), split_pool(v_pool)
     R, nH, hd = q.shape
     bsz, nKV = k_pool.shape[1], k_pool.shape[2]
     nb = block_table.shape[1]
@@ -154,23 +236,28 @@ def _paged_attention_pallas(
         )
     qg = q.reshape(R, nKV, group, hd)
     mask = valid.astype(jnp.int32)  # [R, nb*bsz]
+    quant = k_scales is not None
 
-    kernel = functools.partial(_paged_attn_kernel, sm_scale=sm_scale)
+    # the index map IS the page walk: block b of slot r comes straight
+    # from the pool row the table names (scale strips walk the same map)
+    kv_spec = pl.BlockSpec((1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0))
+    sc_spec = pl.BlockSpec((1, 1, bsz), lambda r, h, b, bt: (bt[r, b], h, 0))
+    in_specs = [
+        pl.BlockSpec((1, bsz), lambda r, h, b, bt: (r, b)),
+        pl.BlockSpec((1, 1, group, hd), lambda r, h, b, bt: (r, h, 0, 0)),
+    ]
+    if quant:
+        kernel = functools.partial(_paged_attn_kernel_q8, sm_scale=sm_scale)
+        in_specs += [kv_spec, sc_spec, kv_spec, sc_spec]
+        operands = (qg, k_pool, k_scales, v_pool, v_scales)
+    else:
+        kernel = functools.partial(_paged_attn_kernel, sm_scale=sm_scale)
+        in_specs += [kv_spec, kv_spec]
+        operands = (qg, k_pool, v_pool)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(R, nKV, nb),
-        in_specs=[
-            pl.BlockSpec((1, bsz), lambda r, h, b, bt: (r, b)),
-            pl.BlockSpec((1, 1, group, hd), lambda r, h, b, bt: (r, h, 0, 0)),
-            # the index map IS the page walk: block b of slot r comes
-            # straight from the pool row the table names
-            pl.BlockSpec(
-                (1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0)
-            ),
-            pl.BlockSpec(
-                (1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0)
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, group, hd), lambda r, h, b, bt: (r, h, 0, 0)
         ),
@@ -185,7 +272,7 @@ def _paged_attention_pallas(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R, nKV, group, hd), q.dtype),
         interpret=interpret,
-    )(block_table, mask, qg, k_pool, v_pool)
+    )(block_table, mask, *operands)
     return out.reshape(R, nH, hd)
 
 
@@ -256,9 +343,71 @@ def _paged_verify_kernel(
         )
 
 
+def _paged_verify_kernel_q8(
+    bt_ref,  # [R, nb] scalar-prefetch block table
+    mask_ref,  # (1, W, bsz) int32 validity rows for this block, per query
+    q_ref,  # (1, 1, W, group, hd)
+    k_ref,  # (1, bsz, 1, hd) int8 — THE pool block, DMA'd once for all W
+    ks_ref,  # (1, 1, bsz) f32 — that block's K scales
+    v_ref,  # (1, bsz, 1, hd) int8
+    vs_ref,  # (1, 1, bsz) f32
+    o_ref,  # (1, 1, W, group, hd)
+    acc_ref,  # VMEM (W*group, hd) f32
+    m_ref,  # VMEM (W*group, 1) f32
+    l_ref,  # VMEM (W*group, 1) f32
+    *,
+    sm_scale: float,
+):
+    """Int8 twin of the multi-query verify kernel: one block DMA (data +
+    scale strip) serves all W queries, dequantized right after the
+    transfer — same amortization, half the KV bytes."""
+    b = pl.program_id(2)
+    nb = pl.num_programs(2)
+    W, group, hd = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
+    bsz = k_ref.shape[1]
+
+    @pl.when(b == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32).reshape(W * group, hd)
+    k = k_ref[0, :, 0].astype(jnp.float32) * ks_ref[0, 0][:, None]  # [bsz, hd]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * sm_scale
+    m2 = jnp.broadcast_to(
+        mask_ref[0][:, None, :], (W, group, bsz)
+    ).reshape(W * group, bsz)
+    s = jnp.where(m2 != 0, s, _NEG_INF)
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    p = jnp.where(m_new > _NEG_INF / 2, p, 0.0)
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    m_ref[:] = m_new
+    v = v_ref[0, :, 0].astype(jnp.float32) * vs_ref[0, 0][:, None]
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(b == nb - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0] = (acc_ref[:] / safe_l).reshape(W, group, hd).astype(
+            o_ref.dtype
+        )
+
+
 def _paged_verify_pallas(
     q, k_pool, v_pool, block_table, valid, sm_scale, interpret
 ):
+    (k_pool, k_scales), (v_pool, v_scales) = split_pool(k_pool), split_pool(v_pool)
     R, W, nH, hd = q.shape
     bsz, nKV = k_pool.shape[1], k_pool.shape[2]
     nb = block_table.shape[1]
@@ -272,23 +421,28 @@ def _paged_verify_pallas(
     # the q block so one block DMA serves every query position
     qg = q.reshape(R, W, nKV, group, hd).transpose(0, 2, 1, 3, 4)
     mask = valid.astype(jnp.int32)  # [R, W, nb*bsz]
+    quant = k_scales is not None
 
-    kernel = functools.partial(_paged_verify_kernel, sm_scale=sm_scale)
+    kv_spec = pl.BlockSpec((1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0))
+    sc_spec = pl.BlockSpec((1, 1, bsz), lambda r, h, b, bt: (bt[r, b], h, 0))
+    in_specs = [
+        pl.BlockSpec((1, W, bsz), lambda r, h, b, bt: (r, 0, b)),
+        pl.BlockSpec(
+            (1, 1, W, group, hd), lambda r, h, b, bt: (r, h, 0, 0, 0)
+        ),
+    ]
+    if quant:
+        kernel = functools.partial(_paged_verify_kernel_q8, sm_scale=sm_scale)
+        in_specs += [kv_spec, sc_spec, kv_spec, sc_spec]
+        operands = (qg, k_pool, k_scales, v_pool, v_scales)
+    else:
+        kernel = functools.partial(_paged_verify_kernel, sm_scale=sm_scale)
+        in_specs += [kv_spec, kv_spec]
+        operands = (qg, k_pool, v_pool)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(R, nKV, nb),
-        in_specs=[
-            pl.BlockSpec((1, W, bsz), lambda r, h, b, bt: (r, 0, b)),
-            pl.BlockSpec(
-                (1, 1, W, group, hd), lambda r, h, b, bt: (r, h, 0, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0)
-            ),
-            pl.BlockSpec(
-                (1, bsz, 1, hd), lambda r, h, b, bt: (bt[r, b], 0, h, 0)
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, W, group, hd), lambda r, h, b, bt: (r, h, 0, 0, 0)
         ),
@@ -303,14 +457,14 @@ def _paged_verify_pallas(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((R, nKV, W, group, hd), q.dtype),
         interpret=interpret,
-    )(block_table, mask, qg, k_pool, v_pool)
+    )(block_table, mask, *operands)
     return out.transpose(0, 2, 1, 3, 4).reshape(R, W, nH, hd)
 
 
 def paged_attention_qlen(
     q: jax.Array,  # [R, W, nH, hd]: W query positions per slot
-    k_pool: jax.Array,  # [n_blocks, bsz, nKV, hd] ONE layer's pool
-    v_pool: jax.Array,  # [n_blocks, bsz, nKV, hd]
+    k_pool,  # [n_blocks, bsz, nKV, hd] ONE layer's pool, or (int8, scales)
+    v_pool,  # [n_blocks, bsz, nKV, hd] or (int8 data, f32 scales)
     block_table: jax.Array,  # [R, nb] int32 pool-block ids per slot
     valid: jax.Array,  # [R, W, nb*bsz] bool per-query attendable rows
     *,
@@ -339,12 +493,13 @@ def paged_attention_qlen(
     if impl == "xla":
         from areal_tpu.ops.chunked_attention import verify_attention
 
+        (kd, ks), (vd, vs) = split_pool(k_pool), split_pool(v_pool)
         R, W, nH, hd = q.shape
-        bsz, nKV = k_pool.shape[1], k_pool.shape[2]
+        bsz, nKV = kd.shape[1], kd.shape[2]
         nb = block_table.shape[1]
         idx = block_table.reshape(-1)
-        kc = jnp.take(k_pool, idx, axis=0).reshape(R, nb * bsz, nKV, hd)
-        vc = jnp.take(v_pool, idx, axis=0).reshape(R, nb * bsz, nKV, hd)
+        kc = _gather_dequant(kd, ks, idx, R, nb, bsz, nKV, hd, q.dtype)
+        vc = _gather_dequant(vd, vs, idx, R, nb, bsz, nKV, hd, q.dtype)
         return verify_attention(q, kc, vc, valid, sm_scale=sm_scale)
     return _paged_verify_pallas(
         q, k_pool, v_pool, block_table, valid, sm_scale, interpret
@@ -358,8 +513,8 @@ def paged_attention_qlen(
 
 def paged_attention(
     q: jax.Array,  # [R, nH, hd] query (one decode step per slot)
-    k_pool: jax.Array,  # [n_blocks, bsz, nKV, hd] ONE layer's pool
-    v_pool: jax.Array,  # [n_blocks, bsz, nKV, hd]
+    k_pool,  # [n_blocks, bsz, nKV, hd] ONE layer's pool, or (int8, scales)
+    v_pool,  # [n_blocks, bsz, nKV, hd] or (int8 data, f32 scales)
     block_table: jax.Array,  # [R, nb] int32 pool-block ids per slot
     valid: jax.Array,  # [R, nb*bsz] bool: logical rows each slot attends
     *,

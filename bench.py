@@ -684,6 +684,308 @@ def bench_kvoffload(model, n_sessions, prompt_len, new_tokens, max_running,
     )
 
 
+def bench_kvquant(model, n_sessions, prompt_len, new_tokens, max_running,
+                  pool_mb=0.5, chunk=None, spec_k=4):
+    """Int8 paged KV pool vs fp at FIXED pool MB (ISSUE 11).
+
+    Three legs, every engine paged:
+
+    1. **Capacity + throughput at fixed bytes**: both engines get
+       `kv_pool_tokens` derived from the SAME `pool_mb` budget — int8
+       fits ~2x the tokens (1 byte/element + one f32 scale per
+       (row, head) vs the fp element size), so at a budget sized to
+       pressure the fp pool the int8 engine keeps the whole working set
+       resident while fp preempts/offloads. Reports pool tokens,
+       resident-session capacity, end-to-end tok/s and the
+       preemption/swap traffic for both. The int8 engine runs FIRST so
+       the warm-XLA-process advantage goes to the fp baseline (same
+       conservative ordering as bench_decode_compare).
+    2. **Wire bytes**: one session per dtype is prefilled, parked and
+       exported — the migration payload (blocks + scales, shipped as-is
+       with no requantization) is the /drain and disaggregation unit, so
+       its ratio IS the wire saving.
+    3. **Drift, measured not assumed**: greedy + sampled streams vs the
+       fp oracle (token match fraction, max |logprob delta| over the
+       matched prefix) and the speculative accept-rate on an echo
+       workload for both dtypes (the accept-rate shift is the honest
+       cost speculation pays for quantized verify logits). NOTE the CPU
+       smoke runs RANDOM weights, the worst case for drift: near-uniform
+       logits flip argmax/categorical under tiny KV perturbations, so
+       the match fractions here are a floor — trained checkpoints sit
+       far higher (the math-workload reward comparison is the TPU run's
+       job).
+    """
+    import asyncio as _asyncio
+    import dataclasses
+    import threading as _threading
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.models.qwen2 import init_params
+
+    params = init_params(model, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(17)
+    prompts = [
+        rng.randint(1, model.vocab_size, (prompt_len,)).tolist()
+        for _ in range(n_sessions)
+    ]
+    g = GenerationHyperparameters(
+        max_new_tokens=new_tokens, temperature=1.0, top_p=1.0
+    )
+    L = model.num_hidden_layers
+    nkv = model.num_key_value_heads
+    hd = model.head_dim_
+
+    def bytes_per_token(dt: str) -> int:
+        elem = 1 if dt == "int8" else np.dtype(model.dtype).itemsize
+        scale = 4 if dt == "int8" else 0
+        return 2 * L * nkv * (hd * elem + scale)
+
+    def mk(dt, *, pool_tokens=None, host_mb=0.0, spec="off",
+           R=max_running, role="unified"):
+        dcfg = JaxDecodeConfig(
+            context_length=prompt_len + new_tokens + 128,
+            max_running_requests=R,
+            new_tokens_per_chunk=chunk or min(128, new_tokens),
+            kv_layout="paged",
+            kv_dtype=dt,
+            kv_pool_tokens=pool_tokens,
+            kv_host_pool_mb=host_mb,
+            spec_decode=spec,
+            spec_k=spec_k,
+            role=role,
+            dtype=model.dtype,
+            kv_cache_dtype=model.dtype,
+        )
+        eng = JaxDecodeEngine(
+            dcfg, InferenceEngineConfig(max_concurrent_rollouts=n_sessions)
+        )
+        eng.set_model(params, model)
+        eng.initialize()
+        return eng
+
+    sess_len = prompt_len + new_tokens
+
+    def throughput(dt: str) -> dict:
+        pool_tokens = int(pool_mb * 1024 * 1024 // bytes_per_token(dt))
+        eng = mk(dt, pool_tokens=pool_tokens, host_mb=max(64.0, pool_mb * 4))
+        try:
+            eng.prewarm(prompt_len=prompt_len, gconfig=g, include_fork=False)
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=n_sessions) as pool:
+                rs = list(
+                    pool.map(
+                        lambda p: eng.generate(
+                            ModelRequest(input_ids=p, gconfig=g),
+                            timeout=1800,
+                        ),
+                        prompts,
+                    )
+                )
+            wall = time.perf_counter() - t0
+            m = eng.get_metrics()
+            toks = sum(len(r.output_tokens) for r in rs)
+            return dict(
+                pool_tokens=m["kv_pool_tokens_total"],
+                resident_sessions=m["kv_pool_tokens_total"] // sess_len,
+                tok_s=toks / wall if wall > 0 else 0.0,
+                preemptions=m["preemptions_total"],
+                swap_out=m["kv_swap_out_bytes_total"],
+                swap_in=m["kv_swap_in_bytes_total"],
+                block_nbytes=m["kv_block_nbytes"],
+            )
+        finally:
+            eng.destroy()
+
+    def migrate_bytes(dt: str) -> int:
+        eng = mk(dt, R=2, role="prefill")
+        try:
+            out = {}
+
+            def _go():
+                out["r"] = _asyncio.run(
+                    eng.aprefill(
+                        ModelRequest(
+                            rid="mig", input_ids=prompts[0], gconfig=g
+                        )
+                    )
+                )
+
+            t = _threading.Thread(target=_go, daemon=True)
+            t.start()
+            t.join(300)
+            sess = eng.export_session("mig")
+            assert sess is not None
+            return sum(
+                sess[x].nbytes
+                for x in ("k", "v", "ks", "vs")
+                if x in sess
+            )
+        finally:
+            eng.destroy()
+
+    def streams(dt: str, gg, n=4) -> list:
+        eng = mk(dt, R=max_running)
+        try:
+            with ThreadPoolExecutor(max_workers=n) as pool:
+                return list(
+                    pool.map(
+                        lambda p: eng.generate(
+                            ModelRequest(input_ids=p, gconfig=gg),
+                            timeout=1800,
+                        ),
+                        prompts[:n],
+                    )
+                )
+        finally:
+            eng.destroy()
+
+    # spec leg: the echo model of bench_spec_compare (residual-mixing
+    # kernels zeroed -> greedy decoding cycles), so drafts actually
+    # accept and the dtype's accept-rate shift is observable. Params are
+    # rebuilt per call with the echo surgery applied.
+    def spec_accept(dt: str) -> float:
+        zero = lambda a: a * 0.0  # noqa: E731
+
+        def echoify(layer):
+            return {
+                **layer,
+                "attn": {
+                    **layer["attn"],
+                    "o_kernel": zero(layer["attn"]["o_kernel"]),
+                },
+                "mlp": {
+                    **layer["mlp"],
+                    "down_kernel": zero(layer["mlp"]["down_kernel"]),
+                },
+            }
+
+        eparams = dict(params)
+        if "layers" in eparams:
+            eparams["layers"] = echoify(eparams["layers"])
+        else:
+            for name in list(eparams):
+                if name.startswith("layers_"):
+                    eparams[name] = echoify(eparams[name])
+        dcfg = JaxDecodeConfig(
+            context_length=prompt_len + new_tokens + 128,
+            max_running_requests=2,
+            new_tokens_per_chunk=chunk or min(128, new_tokens),
+            kv_layout="paged",
+            kv_dtype=dt,
+            spec_decode="ngram",
+            spec_k=spec_k,
+            dtype=model.dtype,
+            kv_cache_dtype=model.dtype,
+        )
+        eng = JaxDecodeEngine(
+            dcfg, InferenceEngineConfig(max_concurrent_rollouts=4)
+        )
+        eng.set_model(eparams, model)
+        eng.initialize()
+        try:
+            gg = dataclasses.replace(g, greedy=True)
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                list(
+                    pool.map(
+                        lambda p: eng.generate(
+                            ModelRequest(input_ids=p, gconfig=gg),
+                            timeout=1800,
+                        ),
+                        prompts[:2],
+                    )
+                )
+            return float(
+                eng.get_metrics()["spec_accepted_per_chunk_mean"]
+            )
+        finally:
+            eng.destroy()
+
+    # int8 first: warm-process advantage goes to the fp baseline
+    q = throughput("int8")
+    f = throughput("fp")
+    mig_i8 = migrate_bytes("int8")
+    mig_fp = migrate_bytes("fp")
+
+    drift = {}
+    for name, gg in (
+        ("greedy", dataclasses.replace(g, greedy=True)),
+        ("sampled", dataclasses.replace(g, temperature=0.8, top_p=0.9)),
+    ):
+        fp_rs = streams("fp", gg)
+        i8_rs = streams("int8", gg)
+        matched = total = 0
+        max_dlp = 0.0
+        for rf, ri in zip(fp_rs, i8_rs):
+            total += max(len(rf.output_tokens), 1)
+            for a, b, la, lb in zip(
+                rf.output_tokens, ri.output_tokens,
+                rf.output_logprobs, ri.output_logprobs,
+            ):
+                if a != b:
+                    break
+                matched += 1
+                max_dlp = max(max_dlp, abs(la - lb))
+        drift[f"kvquant_{name}_token_match_frac"] = (
+            round(matched / total, 4) if total else 0.0
+        )
+        drift[f"kvquant_{name}_max_logprob_delta_matched"] = round(
+            max_dlp, 6
+        )
+    acc_fp = spec_accept("fp")
+    acc_i8 = spec_accept("int8")
+
+    return dict(
+        kvquant_pool_mb=pool_mb,
+        kvquant_fp_pool_tokens=f["pool_tokens"],
+        kvquant_int8_pool_tokens=q["pool_tokens"],
+        kvquant_fp_resident_sessions=f["resident_sessions"],
+        kvquant_int8_resident_sessions=q["resident_sessions"],
+        # headline: resident-session (token) capacity at fixed pool MB
+        kvquant_capacity_ratio=(
+            round(q["pool_tokens"] / f["pool_tokens"], 4)
+            if f["pool_tokens"]
+            else 0.0
+        ),
+        kvquant_fp_tok_s=round(f["tok_s"], 2),
+        kvquant_int8_tok_s=round(q["tok_s"], 2),
+        kvquant_tok_s_ratio=(
+            round(q["tok_s"] / f["tok_s"], 4) if f["tok_s"] > 0 else 0.0
+        ),
+        kvquant_fp_preemptions=f["preemptions"],
+        kvquant_int8_preemptions=q["preemptions"],
+        kvquant_fp_swap_out_bytes=f["swap_out"],
+        kvquant_int8_swap_out_bytes=q["swap_out"],
+        kvquant_fp_block_nbytes=f["block_nbytes"],
+        kvquant_int8_block_nbytes=q["block_nbytes"],
+        # bytes PER BLOCK moved by any swap/migrate hop: the per-unit
+        # saving even when absolute swap traffic differs (int8 usually
+        # swaps less because more fits resident)
+        kvquant_block_bytes_ratio=round(
+            f["block_nbytes"] / q["block_nbytes"], 4
+        ),
+        kvquant_fp_migrate_bytes=mig_fp,
+        kvquant_int8_migrate_bytes=mig_i8,
+        kvquant_migrate_bytes_ratio=(
+            round(mig_fp / mig_i8, 4) if mig_i8 else 0.0
+        ),
+        kvquant_fp_spec_accept_per_chunk=round(acc_fp, 4),
+        kvquant_int8_spec_accept_per_chunk=round(acc_i8, 4),
+        kvquant_spec_accept_shift=round(acc_i8 - acc_fp, 4),
+        kvquant_sessions=n_sessions,
+        kvquant_prompt_len=prompt_len,
+        kvquant_new_tokens=new_tokens,
+        **drift,
+    )
+
+
 def bench_fleet(model, n_replicas, n_groups, group_size, prompt_len,
                 new_tokens, max_running, chunk=None, turns=2):
     """Fleet router bench (ISSUE 8): prefix-affinity routing vs
@@ -2550,6 +2852,7 @@ BENCH_MODE_FNS = {
     "weightsync": bench_weightsync,
     "specdecode": bench_spec_compare,
     "kvoffload": bench_kvoffload,
+    "kvquant": bench_kvquant,
     "fleet": bench_fleet,
     "chaos": bench_chaos,
     "disagg": bench_disagg,
@@ -2565,6 +2868,7 @@ MODE_HEADLINES = {
     "weightsync": ("weightsync_commit_pause_s", "s"),
     "specdecode": ("spec_over_off_speedup", "x"),
     "kvoffload": ("kvoffload_resume_ttft_speedup", "x"),
+    "kvquant": ("kvquant_capacity_ratio", "x"),
     "fleet": ("fleet_affinity_ttft_p50_speedup", "x"),
     "chaos": ("chaos_exactly_once", "bool"),
     "disagg": ("disagg_decode_itl_p99_speedup", "x"),
@@ -2900,6 +3204,21 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("kvquant"):
+            decode.update(
+                _retry_transport(
+                    # pool_mb sized so the fp pool holds ~half the 96
+                    # concurrent (512+256)-token sessions while int8
+                    # holds nearly all of them
+                    lambda: bench_kvquant(
+                        model, n_sessions=96, prompt_len=512,
+                        new_tokens=256, max_running=64, pool_mb=300.0,
+                    ),
+                    what="bench_kvquant",
+                    attempts=3,
+                    base_delay=15.0,
+                )
+            )
         if want("fleet"):
             decode.update(
                 _retry_transport(
@@ -3070,6 +3389,15 @@ def main() -> None:
                 bench_kvoffload(
                     model, n_sessions=8, prompt_len=256, new_tokens=64,
                     max_running=4, host_mb=64.0, chunk=8,
+                )
+            )
+        if want("kvquant"):
+            # pool_mb sized so the f32 pool pressures the 4-slot working
+            # set (8 sessions x 320 tokens) while int8 holds it resident
+            decode.update(
+                bench_kvquant(
+                    model, n_sessions=8, prompt_len=256, new_tokens=64,
+                    max_running=4, pool_mb=0.7, chunk=8,
                 )
             )
         if want("fleet"):

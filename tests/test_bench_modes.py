@@ -49,6 +49,19 @@ def test_kvoffload_mode_is_pinned():
     )
 
 
+def test_kvquant_mode_is_pinned():
+    """ISSUE 11: the int8-KV bench must stay reachable as `--mode
+    kvquant` with its fixed-MB capacity-ratio headline — the acceptance
+    proof for quantized pools (capacity, tok/s, swap/wire bytes, drift,
+    spec accept-rate shift) lives behind this entry point."""
+    bench = _load_bench()
+    assert "kvquant" in bench.BENCH_MODE_FNS
+    assert bench.BENCH_MODE_FNS["kvquant"] is bench.bench_kvquant
+    assert bench.MODE_HEADLINES["kvquant"] == (
+        "kvquant_capacity_ratio", "x",
+    )
+
+
 def test_fleet_mode_is_pinned():
     """ISSUE 8 satellite: the fleet-router bench must stay reachable as
     `--mode fleet` with its prefix-affinity-vs-least_requests headline —

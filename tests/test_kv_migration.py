@@ -202,7 +202,8 @@ def test_export_import_stream_bit_identity(kv_layout, gname):
         st.add_bucket(f)
     sessions = unpack_kv_sessions(st.finalize())
     assert len(sessions) == 1
-    meta, k, v = sessions[0]
+    meta, k, v, scales = sessions[0]
+    assert scales is None  # fp session: no scale blocks on the wire
     assert np.array_equal(np.asarray(k), sess["k"])
     assert np.array_equal(np.asarray(v), sess["v"])
 
@@ -477,8 +478,12 @@ def test_drain_migrates_parked_sessions_zero_reprefill():
     the survivor; all resumes are host-tier promotions (zero prefills)
     and partial+resumed streams match the never-interrupted oracle."""
     prompts = [_prompt(40, seed=23 + i) for i in range(2)]
-    # long enough (12 chunks at chunk=4) that the drain lands mid-stream
-    g = GenerationHyperparameters(max_new_tokens=48, greedy=True)
+    # long enough (40 chunks at chunk=4) that the drain reliably lands
+    # mid-stream even when a loaded host delays the /drain round-trip —
+    # at 48 tokens the streams could finish first and the parts came
+    # back "length", a pre-existing flake
+    _BUDGET = 160
+    g = GenerationHyperparameters(max_new_tokens=_BUDGET, greedy=True)
     oracle = _engine(seed=5)
     try:
         oracles = [
@@ -513,7 +518,7 @@ def test_drain_migrates_parked_sessions_zero_reprefill():
             tasks = []
             for i in range(2):
                 tasks.append(
-                    loop.create_task(gen(aa, i, prompts[i], 48))
+                    loop.create_task(gen(aa, i, prompts[i], _BUDGET))
                 )
                 await asyncio.sleep(0.05)  # admission order == oracle's
             # wait until both are mid-stream, then drain
@@ -538,7 +543,7 @@ def test_drain_migrates_parked_sessions_zero_reprefill():
             for i, p in enumerate(parts):
                 part_toks = [int(t) for t in p["output_tokens"]]
                 out = await gen(
-                    ba, i, prompts[i] + part_toks, 48 - len(part_toks)
+                    ba, i, prompts[i] + part_toks, _BUDGET - len(part_toks)
                 )
                 full.append(part_toks + [int(t) for t in out["output_tokens"]])
             m1 = b.get_metrics()

@@ -293,8 +293,8 @@ def test_kv_frame_torn_rejection():
         st.add_bucket(f)
     from areal_tpu.core.weight_transfer import unpack_kv_sessions
 
-    (got_meta, got_k, got_v), = unpack_kv_sessions(st.finalize())
-    assert got_meta == meta
+    (got_meta, got_k, got_v, got_scales), = unpack_kv_sessions(st.finalize())
+    assert got_meta == meta and got_scales is None
     assert np.array_equal(got_k, k) and np.array_equal(got_v, v)
 
 
@@ -319,8 +319,8 @@ def test_kv_frames_interval_remerge_across_resplit_retries():
         st.add_bucket(f)
     for f in frames_b:
         st.add_bucket(f)
-    (got_meta, got_k, got_v), = unpack_kv_sessions(st.finalize())
-    assert got_meta == meta
+    (got_meta, got_k, got_v, got_scales), = unpack_kv_sessions(st.finalize())
+    assert got_meta == meta and got_scales is None
     assert np.array_equal(got_k, k) and np.array_equal(got_v, v)
 
 
