@@ -504,12 +504,85 @@ class RouterConfig:
     breaker_trip_after: int = 3
     breaker_slow_s: float = 0.0
     breaker_probe_requests: int = 1
+    # A half-open probe slot is freed by the probe request COMPLETING
+    # (_release_qid); a probe whose client died first (deadline shed,
+    # crashed caller) would otherwise hold the slot forever and wedge the
+    # breaker half-open. Probe charges older than this TTL are expired by
+    # the poll loop. 0 disables expiry.
+    breaker_probe_ttl_s: float = 60.0
     # -- state expiry ---------------------------------------------------
     # TTL for qid/prefix affinity entries (a crashed client must not leak
     # load accounting forever); 0 disables TTL expiry. route_max_entries
     # LRU-bounds the qid and prefix maps independently of the TTL.
     route_ttl_s: float = 600.0
     route_max_entries: int = 65536
+
+
+@dataclass
+class SupervisorConfig:
+    """Self-healing fleet supervisor (launcher/supervisor.py) policy knobs.
+
+    The supervisor closes ROADMAP item 1's control loop: it polls the
+    router's /metrics and each replica's /health, freezes a
+    FleetSnapshot, and runs the pure planner `plan_actions(snapshot,
+    policy)` whose output drives four safe transitions — scale up (spawn
+    through the launcher seam with jittered-backoff retry and crash-loop
+    escalation), scale down (/drain to survivors, kill only after the
+    drain commits), replace (dead / breaker-open replica drained if
+    reachable, killed, respawned), and re-role (prefill<->decode flip via
+    drain as the workload mix shifts). Every knob below is a planner
+    input, so policy behaviour is unit-testable without a fleet.
+    """
+
+    enabled: bool = False
+    # control-loop cadence; each tick polls, snapshots, plans, dispatches
+    tick_interval_s: float = 1.0
+    # -- capacity bounds -------------------------------------------------
+    # hard floor no plan may violate (scale-down is refused at the floor;
+    # replace preserves capacity and is always allowed)
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # -- SLO signals + hysteresis ---------------------------------------
+    # in-flight requests per replica treated as 1.0 utilization; fleet
+    # util = (running + router queue depth) / (alive * this)
+    util_inflight_target: int = 8
+    # hysteresis band: scale up at/above the high mark, down at/below the
+    # low mark, and HOLD in between (no flapping)
+    scale_up_util: float = 0.85
+    scale_down_util: float = 0.30
+    # router admission-queue depth that forces a scale-up regardless of
+    # the util estimate (queueing is the SLO breach, not a proxy for one)
+    scale_up_queue_depth: int = 4
+    # -- per-action cooldowns -------------------------------------------
+    scale_up_cooldown_s: float = 2.0
+    scale_down_cooldown_s: float = 20.0
+    replace_cooldown_s: float = 2.0
+    rerole_cooldown_s: float = 30.0
+    # -- spawn retry / crash-loop escalation ----------------------------
+    # consecutive spawn failures on one slot before the supervisor stops
+    # retrying it, records a crash_loops_total alert, and continues with
+    # the degraded fleet
+    spawn_max_attempts: int = 3
+    spawn_backoff_s: float = 0.5
+    spawn_backoff_max_s: float = 10.0
+    # each backoff is scaled by uniform[1-j, 1+j] so simultaneous slot
+    # retries don't hammer the launcher in lockstep
+    spawn_backoff_jitter: float = 0.25
+    # -- drain-as-safe-transition ---------------------------------------
+    # a /drain that has not committed within this deadline is aborted and
+    # its action rolled back (the victim keeps serving; drain_rollbacks
+    # counts the abort) — a hung drain must never wedge the control loop
+    drain_deadline_s: float = 30.0
+    # -- liveness --------------------------------------------------------
+    # consecutive failed /health polls before a replica counts as dead in
+    # the snapshot (replace candidate)
+    health_fail_threshold: int = 2
+    health_timeout_s: float = 5.0
+    # -- re-role ---------------------------------------------------------
+    rerole_enabled: bool = True
+    # |observed prefill work share - provisioned prefill replica share|
+    # must exceed this band before a flip is planned (mix-shift hysteresis)
+    rerole_band: float = 0.25
 
 
 @dataclass
@@ -681,6 +754,10 @@ class LauncherConfig:
     # 0 (default) launches every replica unified. Must leave at least one
     # decode replica (prefill_replicas < gen dp size).
     prefill_replicas: int = 0
+    # Self-healing fleet supervisor (launcher/supervisor.py): SLO
+    # autoscaling + replace/re-role over the decode fleet. Off by default;
+    # when enabled the launcher runs the control loop next to the router.
+    supervisor: SupervisorConfig = field(default_factory=SupervisorConfig)
     slurm: SlurmLauncherConfig = field(default_factory=SlurmLauncherConfig)
 
 

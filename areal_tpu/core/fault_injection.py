@@ -24,6 +24,26 @@ Seams (grep for `fault_injection.fire(` / `.afire(` / `.tear(`):
   client.weights.stage  core/remote_inf_engine.py  per staged bucket
   router.schedule       launcher/router.py   /schedule_request handling
   router.poll           launcher/router.py   per-replica health/metrics probe
+  supervisor.spawn      launcher/supervisor.py  before each spawn attempt
+                                             (abort = launcher failure —
+                                             jittered-backoff retry, then
+                                             crash-loop escalation at
+                                             spawn_max_attempts)
+  supervisor.drain      launcher/supervisor.py  inside the drain deadline
+                                             window (delay = a HUNG drain:
+                                             the deadline aborts the
+                                             action and rolls it back)
+  supervisor.health     launcher/supervisor.py  before each replica health
+                                             probe (abort = health flap;
+                                             sustained aborts look like
+                                             death and trigger replace)
+  supervisor.kill       launcher/supervisor.py  after a drain commits,
+                                             before the kill (abort = the
+                                             supervisor dying mid
+                                             transition — the next tick
+                                             replans; the /drain
+                                             in-progress guard makes the
+                                             retried drain safe)
   server.generate       launcher/decode_server.py  before the engine runs
   server.prefill        launcher/decode_server.py  before a prefill-only
                                              admission (disaggregated role)

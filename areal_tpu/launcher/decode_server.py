@@ -187,6 +187,12 @@ class DecodeServer:
             commit_dedups=0,
             transfer_secs=0.0,
         )
+        # In-progress /drain guard (drains are serialized per server):
+        # while a drain runs, this holds its result future; concurrent
+        # /drain calls await it and replay the first result instead of
+        # double-exporting the same sessions. Claimed with no await after
+        # the done-check, so the check-and-set is event-loop-atomic.
+        self._drain_inflight: asyncio.Future | None = None
 
     # -- handlers -------------------------------------------------------
     async def _health(self, request: web.Request) -> web.Response:
@@ -603,16 +609,19 @@ class DecodeServer:
             logger.warning(f"kv staging {victim} dropped (map full)")
 
     async def _migrate_session_out(
-        self, target: str, rid: str, xid: str, retries: int = 1
+        self, target: str, rid: str, xid: str, retries: int = 2
     ) -> dict[str, Any] | None:
         """Export `rid` and stream it to `target` under delivery id `xid`.
 
         The export MOVES the session out of this engine first; a transfer
         that fails past its replay budget therefore degrades to a
         re-prefill on whichever replica the session resumes on — never a
-        wedged handler. One full-stream replay (same xid) covers a
-        mid-transfer death: re-sent frames interval-merge and the commit
-        is idempotent, so the handoff lands exactly once."""
+        wedged handler. The budget is two full-stream replays (same xid):
+        a mid-transfer sender death and a torn frame are INDEPENDENT
+        failures, and a budget of one means any two of them composing on
+        one session silently downgrades the handoff to a re-prefill.
+        Re-sent frames interval-merge and the commit is idempotent, so
+        however many replays run, the handoff lands exactly once."""
         from areal_tpu.core.weight_transfer import pack_kv_session
         from areal_tpu.utils.http import arequest_with_retry
 
@@ -843,15 +852,47 @@ class DecodeServer:
         down / maintenance): in-flight generations are parked first (their
         clients resume through the interrupt loop and the router lands
         them on a survivor, where the migrated KV makes the resume a
-        zero-re-prefill promotion)."""
-        import uuid as _uuid
+        zero-re-prefill promotion).
 
+        Drains are serialized per server: a /drain arriving while one is
+        already running (a supervisor retry racing an operator) awaits the
+        in-flight drain and REPLAYS its result instead of exporting the
+        same sessions twice — each concurrent export would mint fresh
+        drain-xids, so without this guard the idempotency tables on the
+        targets could not dedup the double import."""
         body = await request.json()
         targets = [t for t in body.get("targets") or [] if t and t != self.addr]
         if not targets:
             return web.json_response(
                 {"status": "error", "message": "targets required"}, status=400
             )
+        if (
+            self._drain_inflight is not None
+            and not self._drain_inflight.done()
+        ):
+            # shield: a duplicate whose client gives up must not cancel
+            # the original drain mid-export
+            resp = await asyncio.shield(self._drain_inflight)
+            return web.json_response(dict(resp, dedup="in_progress"))
+        fut = asyncio.get_running_loop().create_future()
+        # no await between the done-check above and this assignment: the
+        # check-and-claim is atomic on the one event loop
+        self._drain_inflight = fut
+        try:
+            resp = await self._drain_once(body, targets)
+            status = 200
+        except Exception as e:  # noqa: BLE001 — waiters need a result,
+            # not a never-retrieved exception
+            resp = {"status": "error", "message": repr(e)}
+            status = 500
+        fut.set_result(resp)
+        return web.json_response(resp, status=status)
+
+    async def _drain_once(
+        self, body: dict[str, Any], targets: list[str]
+    ) -> dict[str, Any]:
+        import uuid as _uuid
+
         loop = asyncio.get_running_loop()
         async with self._ctl_lock:
             await loop.run_in_executor(None, self.engine.pause_generation)
@@ -875,15 +916,33 @@ class DecodeServer:
             else:
                 drained += 1
                 total_bytes += moved["bytes"]
+        return {
+            "status": "ok",
+            "aborted": aborted,
+            "sessions": len(rids),
+            "drained": drained,
+            "failed": failed,
+            "bytes": total_bytes,
+        }
+
+    async def _set_role(self, request: web.Request) -> web.Response:
+        """Flip this replica's role (the supervisor's re-role transition,
+        issued only after a committed /drain). The role only steers the
+        router's scheduler — every replica serves every endpoint — so the
+        flip is a config write here plus the next /health poll on the
+        router side."""
+        body = await request.json()
+        role = str(body.get("role", "")).lower()
+        if role not in ("unified", "prefill", "decode"):
+            return web.json_response(
+                {"status": "error", "message": f"bad role {role!r}"},
+                status=400,
+            )
+        old = getattr(self.config, "role", "unified")
+        self.config.role = role
+        logger.info(f"role flipped {old} -> {role}")
         return web.json_response(
-            {
-                "status": "ok",
-                "aborted": aborted,
-                "sessions": len(rids),
-                "drained": drained,
-                "failed": failed,
-                "bytes": total_bytes,
-            }
+            {"status": "ok", "old_role": old, "role": role}
         )
 
     # -- lifecycle ------------------------------------------------------
@@ -908,6 +967,7 @@ class DecodeServer:
         app.router.add_post("/kv_recv", self._kv_recv)
         app.router.add_post("/kv_commit", self._kv_commit)
         app.router.add_post("/drain", self._drain)
+        app.router.add_post("/set_role", self._set_role)
         return app
 
     async def start(

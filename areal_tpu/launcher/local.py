@@ -38,6 +38,26 @@ logger = logging.getLogger("local_launcher")
 RECOVER_TIME_INTERVAL = 10.0  # parity: local.py:58
 
 
+class DecodeServerHandle:
+    """supervisor.ReplicaHandle over a LocalLauncher subprocess: the
+    addr the replica registered under, plus a kill that reaps the whole
+    process tree and drops the job from the launcher's watch list (a
+    supervisor-initiated kill must not trip _raise_on_failure)."""
+
+    def __init__(self, launcher: "LocalLauncher", job: JobInfo, addr: str):
+        self._launcher = launcher
+        self._job = job
+        self.addr = addr
+
+    def kill(self) -> None:
+        if self._job.proc is not None:
+            kill_process_tree(self._job.proc)
+        try:
+            self._launcher.jobs.remove(self._job)
+        except ValueError:
+            pass
+
+
 class LocalLauncher:
     def __init__(self, experiment_name: str, trial_name: str, fileroot: str):
         self.experiment_name = experiment_name
@@ -115,6 +135,58 @@ class LocalLauncher:
             f"{gethostip()}:{port}",
         ] + (extra_args or [])
         return self.submit(f"decode_server_{server_idx}", cmd, env=env)
+
+    def spawn_decode_server(
+        self,
+        role: str = "unified",
+        *,
+        model_path: str,
+        extra_args: list[str] | None = None,
+        env: dict[str, str] | None = None,
+        timeout: float = 300.0,
+    ) -> "DecodeServerHandle":
+        """Launcher seam for the fleet supervisor
+        (launcher/supervisor.py): spawn ONE decode-server subprocess with
+        the given role, block until it self-registers in name_resolve,
+        and return a handle exposing the (addr, kill) surface the
+        supervisor drives. Raises on spawn/registration failure — the
+        supervisor's jittered-backoff retry and crash-loop escalation own
+        that outcome."""
+        port = find_free_ports(1)[0]
+        addr = f"{gethostip()}:{port}"
+        args = list(extra_args or [])
+        if role != "unified":
+            args += ["--role", role]
+        job = self.submit_decode_server(
+            len(self.jobs),
+            model_path,
+            port=port,
+            extra_args=args,
+            env=env,
+        )
+        key = names.gen_server(self.experiment_name, self.trial_name, addr)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if job.state is JobState.FAILED:
+                break
+            try:
+                if name_resolve.get(key) == addr:
+                    return DecodeServerHandle(self, job, addr)
+            except Exception as e:  # noqa: BLE001 — not registered yet
+                logger.debug(f"spawned server {addr} pending: {e!r}")
+            time.sleep(0.5)
+        # failed or timed out: reap the subprocess before reporting
+        if job.proc is not None:
+            kill_process_tree(job.proc)
+        try:
+            self.jobs.remove(job)
+        except ValueError:
+            pass
+        raise JobFailure(
+            f"decode server {addr} (role={role}) did not register "
+            f"within {timeout}s",
+            recoverable=True,
+        )
 
     def wait_decode_servers(self, count: int, timeout: float = 300.0) -> list[str]:
         """Block until `count` servers registered in name_resolve."""

@@ -130,6 +130,10 @@ _PRESSURE_KEYS = (
     "kv_migrate_version_rejects_total",
     "ttft_prefill_p99_ms",
     "ttft_transfer_p99_ms",
+    # fleet-supervisor inputs: the prefill/decode work-mix estimator
+    # (launcher/supervisor.py) deltas these per tick for re-role decisions
+    "prefill_secs_total",
+    "device_busy_s",
 )
 
 
@@ -213,6 +217,7 @@ class DecodeRouter:
             expired_prefixes_total=0,
             breaker_trips_total=0,
             breaker_probes_total=0,
+            breaker_probe_expiries_total=0,
             breaker_closes_total=0,
             deadline_sheds_total=0,
             disagg_schedules_total=0,
@@ -226,8 +231,13 @@ class DecodeRouter:
         # consecutive bad polls, `probes` = in-flight half-open probe
         # requests. A trip never touches affinity state — entries survive
         # and traffic returns through them once the breaker closes.
+        # `probe_t` stamps the last probe charge: a probe whose client
+        # died before completing (deadline shed) can never _release_qid,
+        # so stale charges are expired on poll after breaker_probe_ttl_s
+        # — without that, the breaker stays half-open with a full probe
+        # budget FOREVER and the replica never re-enters rotation.
         self._breaker: dict[str, dict[str, Any]] = defaultdict(
-            lambda: {"state": "closed", "bad": 0, "probes": 0}
+            lambda: {"state": "closed", "bad": 0, "probes": 0, "probe_t": 0.0}
         )
         self._versions: dict[str, int] = {}
         self._running = 0  # guarded-by: _lock
@@ -435,6 +445,7 @@ class DecodeRouter:
         b = self._breaker[addr]
         if b["state"] == "half_open":
             b["probes"] += 1
+            b["probe_t"] = time.monotonic()
             self._counters["breaker_probes_total"] += 1
 
     def _failover_locked(self, dead: str) -> None:
@@ -489,9 +500,31 @@ class DecodeRouter:
                 f"drained {len(stale)} prefix affinities"
             )
 
+    def _expire_probes_locked(self, now: float) -> None:
+        """Free half-open probe slots whose requests died with their
+        clients (deadline shed before _release_qid): past
+        breaker_probe_ttl_s the charge is dropped so the breaker can
+        issue fresh probes instead of staying wedged half-open."""
+        ttl = self.config.breaker_probe_ttl_s
+        if not self.config.breaker_enabled or ttl <= 0:
+            return
+        for s, b in self._breaker.items():
+            if (
+                b["state"] == "half_open"
+                and b["probes"] > 0
+                and now - b.get("probe_t", 0.0) > ttl
+            ):
+                b["probes"] = 0
+                self._counters["breaker_probe_expiries_total"] += 1
+                logger.warning(
+                    f"expired stale half-open probe charge for {s} "
+                    f"(probe client died before completion)"
+                )
+
     def _expire_locked(self, now: float, discovered: list[str]) -> None:
         """TTL/LRU expiry of routing state (a crashed client or a replaced
         fleet must not leak load accounting forever)."""
+        self._expire_probes_locked(now)
         ttl = self.config.route_ttl_s
         if ttl > 0:
             for qid, t in list(self._qid_touched.items()):

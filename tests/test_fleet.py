@@ -200,6 +200,72 @@ def test_decode_server_idempotency_error_path():
     assert _run_async(_scenario_idem_error_path())
 
 
+# -- /drain idempotency (ISSUE 13 satellite) --------------------------------
+
+
+class DrainStubEngine(StubEngine):
+    """StubEngine + the drain surface: two exportable sessions, no jax."""
+
+    def pause_generation(self):
+        pass
+
+    def continue_generation(self):
+        pass
+
+    def abort_all(self):
+        return 0
+
+    def list_exportable_sessions(self):
+        return ["s1", "s2"]
+
+
+async def _scenario_drain_idempotent():
+    eng = DrainStubEngine(delay=0.0)
+    srv, addr = await _start_stub_server(eng)
+    moved = []
+
+    async def slow_migrate(target, rid, xid, retries=1):
+        # each export mints a fresh drain-xid, so a double export could
+        # NOT be deduped downstream — the per-server guard is the fix
+        moved.append((target, rid, xid))
+        await asyncio.sleep(0.3)
+        return {"bytes": 10}
+
+    srv._migrate_session_out = slow_migrate
+    payload = {"targets": ["127.0.0.1:1"]}
+    try:
+        # concurrent drains (a supervisor retry racing an operator): ONE
+        # export of each session, the duplicate replays the first result
+        r1, r2 = await asyncio.gather(
+            arequest_with_retry(addr, "/drain", payload=payload,
+                                max_retries=1, timeout=30),
+            arequest_with_retry(addr, "/drain", payload=payload,
+                                max_retries=1, timeout=30),
+        )
+        assert len(moved) == 2, f"double export: {moved}"
+        assert {m[1] for m in moved} == {"s1", "s2"}
+        assert len({m[2] for m in moved}) == 2  # one fresh xid per rid
+        assert {r1.get("dedup"), r2.get("dedup")} == {None, "in_progress"}
+        strip = lambda r: {k: v for k, v in r.items() if k != "dedup"}  # noqa: E731
+        assert strip(r1) == strip(r2)  # the replay IS the first result
+        assert strip(r1)["drained"] == 2 and strip(r1)["status"] == "ok"
+
+        # a later (non-concurrent) drain is a fresh run, not a stale replay
+        r3 = await arequest_with_retry(
+            addr, "/drain", payload=payload, max_retries=1, timeout=30
+        )
+        assert "dedup" not in r3
+        assert len(moved) == 4
+        return True
+    finally:
+        await close_current_session()
+        await srv.stop()
+
+
+def test_drain_concurrent_calls_export_once():
+    assert _run_async(_scenario_drain_idempotent())
+
+
 # -- client: least-token-load local fallback (ISSUE 8 satellite) ------------
 
 

@@ -473,6 +473,70 @@ def test_kv_recv_torn_frame_rejected_then_retry_lands_exactly_once():
         dec.destroy()
 
 
+def test_migrate_replay_budget_survives_two_composed_failures():
+    """A sender abort and a torn frame are INDEPENDENT failures: when
+    both compose on ONE migration (the abort on attempt 0, the tear on
+    the replay), the two-replay budget still lands the handoff exactly
+    once instead of abandoning the session to a re-prefill."""
+    from areal_tpu.core import fault_injection
+    from areal_tpu.core.fault_injection import FaultPlan, FaultPoint
+
+    prompt = _prompt(36, seed=29)
+    pre = _engine(role="prefill")
+    pre.config.kv_migrate_chunk_mb = 0.01  # several frames per session
+    dec = _engine(role="decode")
+
+    async def scenario():
+        ps, pa = await _start_server(pre, pre.config)
+        ds, da = await _start_server(dec, dec.config)
+        fault_injection.configure(FaultPlan(
+            seed=7,
+            points=[
+                # attempt 0 dies before its first frame ...
+                FaultPoint(site="kv.migrate.send", mode="abort",
+                           at=(0,), times=1),
+                # ... and attempt 1 (the replay) loses a frame to TWO
+                # consecutive tears — enough to defeat the per-frame
+                # HTTP retry, so only the outer replay budget saves it
+                FaultPoint(site="kv.migrate.recv", mode="torn",
+                           at=(1, 2), times=2),
+            ],
+        ))
+        try:
+            out = await arequest_with_retry(
+                pa, "/prefill",
+                payload=dict(
+                    rid="rb",
+                    input_ids=prompt,
+                    gconfig=dict(max_new_tokens=8, greedy=True),
+                    target=da,
+                    xid="budget-1",
+                ),
+                max_retries=1, timeout=120,
+            )
+            # attempt 2 replays the full stream clean: the handoff landed
+            assert out["migrated"] is True and out["kv_bytes"] > 0
+            fired = fault_injection.snapshot()
+            assert any(k.startswith("kv.migrate.send") for k in fired)
+            assert any(k.startswith("kv.migrate.recv") for k in fired)
+            srv_m = await arequest_with_retry(
+                da, "/metrics", method="GET", max_retries=1, timeout=30
+            )
+            assert srv_m["kv_migrate"]["in_commits"] == 1
+            assert dec.get_metrics()["kv_migrated_in_sessions_total"] == 1
+        finally:
+            fault_injection.deactivate()
+            await ps.stop()
+            await ds.stop()
+            await close_current_session()
+
+    try:
+        _run_async(scenario())
+    finally:
+        pre.destroy()
+        dec.destroy()
+
+
 def test_drain_migrates_parked_sessions_zero_reprefill():
     """/drain parks in-flight generations and streams every session to
     the survivor; all resumes are host-tier promotions (zero prefills)

@@ -472,6 +472,60 @@ def test_breaker_trips_on_slow_polls_and_reenters_via_probe():
     assert out3["url"] == "a:1"
 
 
+def test_breaker_half_open_probe_leak_expires():
+    """ISSUE 13 satellite: a probe whose client dies before _release_qid
+    used to wedge the breaker half-open forever — the probe charge only
+    decremented on completion, so no later request could ever probe (and
+    close) the breaker. The poll loop now expires probe charges older
+    than breaker_probe_ttl_s."""
+    r = DecodeRouter(
+        servers=["a:1", "b:1"],
+        breaker_trip_after=2,
+        breaker_slow_s=0.1,
+        breaker_probe_requests=1,
+        breaker_probe_ttl_s=5.0,
+        dead_after_failures=100,
+    )
+    servers = ["a:1", "b:1"]
+    for _ in range(2):
+        r._apply_probes_locked(
+            servers, [_probe("a:1", rtt=0.5), _probe("b:1")]
+        )
+    assert r._breaker["a:1"]["state"] == "open"
+    r._apply_probes_locked(servers, [_probe("a:1"), _probe("b:1")])
+    assert r._breaker["a:1"]["state"] == "half_open"
+    # make a the obviously better target so admission is breaker-limited
+    r._measured_tokens["a:1"] = 0.0
+    r._measured_tokens["b:1"] = 10000.0
+    out = r._try_schedule_locked(
+        dict(qid="dead-client", prompt_len=10, group_size=1,
+             new_token_budget=8)
+    )
+    assert out["url"] == "a:1"
+    assert r._breaker["a:1"]["probes"] == 1
+    # the probing client dies: _release_qid never runs. Until the TTL the
+    # charge holds (no second probe admitted)...
+    out2 = r._try_schedule_locked(
+        dict(qid="q2", prompt_len=10, group_size=1, new_token_budget=8)
+    )
+    assert out2["url"] == "b:1"
+    r._expire_locked(time.monotonic(), servers)
+    assert r._breaker["a:1"]["probes"] == 1  # not yet stale
+    # ...past the TTL the poll loop reclaims it instead of wedging
+    r._expire_locked(time.monotonic() + 6.0, servers)
+    assert r._breaker["a:1"]["state"] == "half_open"
+    assert r._breaker["a:1"]["probes"] == 0
+    assert r._counters["breaker_probe_expiries_total"] == 1
+    # a fresh probe is admitted again and can close the breaker
+    out3 = r._try_schedule_locked(
+        dict(qid="q3", prompt_len=10, group_size=1, new_token_budget=8)
+    )
+    assert out3["url"] == "a:1"
+    assert r._counters["breaker_probes_total"] == 2
+    r._release_qid("q3")
+    assert r._breaker["a:1"]["state"] == "closed"
+
+
 def test_breaker_relapse_during_half_open():
     """A bad poll during the probe phase reopens the breaker."""
     r = DecodeRouter(
