@@ -664,8 +664,22 @@ class SaverConfig(_Timer):
 
 @dataclass
 class RecoverConfig(_Timer):
+    """Step-level crash recovery (utils/recover.py).
+
+    Each dump is written to a fresh `step-{G}.tmp` directory, sealed with a
+    checksummed, fsynced MANIFEST.json, atomically renamed to `step-{G}`,
+    and only then pruned to `keep_last` — dying at any instant leaves every
+    previously committed recovery point intact. `load` walks committed
+    steps newest→oldest and skips torn/manifest-mismatched candidates
+    instead of crashing.
+    """
+
     mode: str = "disabled"  # "disabled" | "auto" | "fault" | "resume"
     retries: int = 3
+    # committed step-{G} recovery points retained after each successful
+    # dump (newest keep_last survive pruning); >= 1. Two is the floor that
+    # makes a torn newest checkpoint recoverable from its predecessor.
+    keep_last: int = 2
 
 
 @dataclass

@@ -67,6 +67,34 @@ Seams (grep for `fault_injection.fire(` / `.afire(` / `.tear(`):
                                              length-check rejects before
                                              a byte stages)
   task.run              core/async_task_runner.py  rollout task execution
+  recover.dump.save     utils/recover.py     before the engine checkpoint
+                                             is written into step-{G}.tmp
+                                             (abort = trainer dying
+                                             mid-save; the tmp dir is never
+                                             a load candidate)
+  recover.dump.info     utils/recover.py     between the engine checkpoint
+                                             and recover_info.pkl (a
+                                             weights-without-metadata tear)
+  recover.dump.marker   utils/recover.py     between the fsynced manifest
+                                             and the atomic rename — the
+                                             save-vs-marker gap: everything
+                                             written, nothing committed
+  recover.load          utils/recover.py     per load candidate (abort =
+                                             a torn/unreadable checkpoint;
+                                             the walk falls back to the
+                                             next-older committed step)
+  train.step            engine/jax_engine.py before each optimizer step
+                                             (trainer death with weights
+                                             half-applied in HBM only)
+  train.weights.push    engine/jax_engine.py TrainEngine.update_weights
+                                             entry — trainer death mid
+                                             weight-push; decode keeps the
+                                             old version until the restored
+                                             trainer re-pushes
+  dataloader.next       dataset/__init__.py  before each batch is yielded
+                                             (death in the fetch-to-consume
+                                             window; the restored position
+                                             re-yields the batch)
 
 Fault modes:
 

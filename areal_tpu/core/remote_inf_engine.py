@@ -941,11 +941,24 @@ class RemoteInfEngine(InferenceEngine):
         return self._version
 
     # -- rollout queue (delegated) -------------------------------------
-    def submit(self, data, workflow=None, workflow_builder=None, should_accept=None):
-        return self._executor.submit(data, workflow, workflow_builder, should_accept)
+    def submit(self, data, workflow=None, workflow_builder=None, should_accept=None,
+               rollout_id=None):
+        return self._executor.submit(
+            data, workflow, workflow_builder, should_accept, rollout_id=rollout_id
+        )
 
     def wait(self, count, timeout=None):
         return self._executor.wait(count, timeout=timeout)
+
+    # -- sample-ledger checkpointing (delegated) ------------------------
+    def attach_ledger_wal(self, path):
+        self._executor.attach_ledger_wal(path)
+
+    def state_dict(self):
+        return self._executor.state_dict()
+
+    def load_state_dict(self, state):
+        self._executor.load_state_dict(state)
 
     def rollout_batch(self, data, workflow=None, workflow_builder=None, should_accept=None):
         return self._executor.rollout_batch(

@@ -68,3 +68,24 @@ class StalenessManager:
                 accepted=self.rollout_stat.accepted,
                 running=self.rollout_stat.running,
             )
+
+    # -- checkpointing ---------------------------------------------------
+    def state_dict(self) -> dict:
+        """Counters as committed with the recover checkpoint. The caller
+        (WorkflowExecutor.load_state_dict) overrides `accepted` with the
+        ledger's consumed count and forces `running` to 0 on restore —
+        in-flight rollouts and cached-but-unconsumed trajectories die with
+        the process, so restoring them raw would permanently shrink the
+        staleness cap."""
+        with self.lock:
+            return dict(
+                submitted=self.rollout_stat.submitted,
+                accepted=self.rollout_stat.accepted,
+                running=self.rollout_stat.running,
+            )
+
+    def load_state_dict(self, state: dict) -> None:
+        with self.lock:
+            self.rollout_stat.submitted = int(state.get("submitted", 0))
+            self.rollout_stat.accepted = int(state.get("accepted", 0))
+            self.rollout_stat.running = int(state.get("running", 0))

@@ -20,6 +20,7 @@ import numpy as np
 
 from areal_tpu.api.cli_args import InferenceEngineConfig
 from areal_tpu.core.async_task_runner import AsyncTaskRunner, TaskResult
+from areal_tpu.core.sample_ledger import SampleLedger, SampleWAL
 from areal_tpu.core.staleness_manager import StalenessManager
 from areal_tpu.utils import logging, stats_tracker
 from areal_tpu.utils.data import concat_padded_tensors, cycle_dataloader
@@ -86,6 +87,9 @@ class WorkflowExecutor:
         self._version = 0
         self._paused = False
         self._consecutive_failures = 0
+        # exactly-once sample accounting: rollout-id issuance, consumed-id
+        # dedup, and the consumed-batch WAL (core/sample_ledger.py)
+        self.ledger = SampleLedger()
 
     # -- lifecycle ------------------------------------------------------
     def initialize(self, train_data_parallel_size: int | None = None) -> None:
@@ -122,18 +126,25 @@ class WorkflowExecutor:
         workflow: "RolloutWorkflow | None" = None,
         workflow_builder: Callable | None = None,
         should_accept: Callable | None = None,
+        rollout_id: int | None = None,
     ) -> None:
-        """Queue one episode; actual launch happens when capacity allows."""
+        """Queue one episode; actual launch happens when capacity allows.
+
+        `rollout_id` gives the episode a caller-chosen stable identity
+        (deterministic resubmission after a trainer restart regenerates
+        the same ids, so the ledger can dedup); default is the ledger's
+        next monotone id."""
         assert workflow is not None or workflow_builder is not None
+        rid = self.ledger.new_rid() if rollout_id is None else int(rollout_id)
         try:
             self._pending_inputs.put_nowait(
-                (data, workflow, workflow_builder, should_accept)
+                (rid, data, workflow, workflow_builder, should_accept)
             )
         except queue.Full:
             raise RuntimeError("workflow executor input queue full") from None
 
     def _launch_one(self, item) -> None:
-        data, workflow, workflow_builder, should_accept = item
+        rid, data, workflow, workflow_builder, should_accept = item
         if workflow is None:
             workflow = workflow_builder()
         sm = self.staleness_manager
@@ -147,12 +158,12 @@ class WorkflowExecutor:
                 check_trajectory_format(traj)
             if traj is not None and should_accept is not None and not should_accept(traj):
                 traj = None
-            return traj
+            return rid, traj
 
         task_id = self.runner.submit(episode)
         sm.on_rollout_submitted()
         if tracing:
-            logger.info(f"submitted rollout task {task_id}")
+            logger.info(f"submitted rollout task {task_id} (rid {rid})")
 
     def _admit_pending(self) -> None:
         """Move pending submissions into the runner within capacity."""
@@ -197,19 +208,35 @@ class WorkflowExecutor:
             # instead of spinning forever resubmitting doomed episodes.
             self._consecutive_failures += 1
             if self._consecutive_failures >= 16:
+                # embed the root cause in the message itself — operators see
+                # the raised line long before they dig for the __cause__
                 raise RuntimeError(
-                    "16 consecutive rollout episodes failed; last error"
+                    f"16 consecutive rollout episodes failed; last error: "
+                    f"{tr.exception!r}"
                 ) from tr.exception
             return
         # any completed episode (accepted or rejected) breaks the streak
         self._consecutive_failures = 0
-        traj = tr.result
+        rid, traj = tr.result
         if traj is None:
             sm.on_rollout_rejected()
             if self.config.enable_rollout_tracing:
-                logger.info(f"rollout {tr.task_id} rejected")
+                logger.info(f"rollout {tr.task_id} (rid {rid}) rejected")
+            return
+        if not self.ledger.on_accepted(rid, self._version):
+            # already consumed (or already pending) — a duplicate from a
+            # still-running replica after a trainer restart; training on it
+            # again would double-count the sample
+            sm.on_rollout_rejected()
+            logger.info(f"rollout rid {rid} deduped (already in ledger)")
             return
         sm.on_rollout_accepted()
+        # stamp identity so the batch carries provenance through
+        # concat/microbatching and wait() can journal what it consumed
+        key0 = "input_ids" if "input_ids" in traj else next(iter(traj))
+        bs = int(np.asarray(traj[key0]).shape[0])
+        traj["rollout_id"] = np.full((bs,), rid, dtype=np.int64)
+        traj["rollout_version"] = np.full((bs,), self._version, dtype=np.int64)
         self._result_cache.append(traj)
 
     # -- collection -----------------------------------------------------
@@ -234,6 +261,13 @@ class WorkflowExecutor:
             self._result_cache[:count],
             self._result_cache[count:],
         )
+        # journal the consumed batch BEFORE handing it to the trainer: the
+        # WAL entry is durable by the time any weight update can depend on
+        # these samples, so crash recovery can tell trained from lost
+        rids = [int(np.asarray(r["rollout_id"]).flat[0]) for r in results
+                if "rollout_id" in r]
+        if rids:
+            self.ledger.on_consumed(rids, self._version)
         # Shuffle so GRPO groups from the same prompt don't correlate with
         # batch position (parity: workflow_executor wait shuffles).
         random.shuffle(results)
@@ -288,3 +322,35 @@ class WorkflowExecutor:
 
     def get_stats(self):
         return self.staleness_manager.get_stats()
+
+    # -- checkpointing ---------------------------------------------------
+    def attach_ledger_wal(self, path: str) -> None:
+        """Journal consumed batches to a WAL at `path` (colocated with the
+        recover checkpoints; see utils/recover.ledger_wal_path)."""
+        self.ledger.attach_wal(SampleWAL(path))
+
+    def state_dict(self) -> dict[str, Any]:
+        """Sample-ledger + staleness accounting, committed inside the
+        recover checkpoint (RecoverInfo.ledger_info)."""
+        return dict(
+            ledger=self.ledger.state_dict(),
+            staleness=self.staleness_manager.state_dict(),
+        )
+
+    def load_state_dict(self, state: dict[str, Any]) -> None:
+        """Restore after a trainer crash. The staleness cap is recomputed
+        from the ledger: `accepted` := consumed count (cached-but-
+        unconsumed trajectories died with the process and will be
+        regenerated — restoring the raw accepted counter would permanently
+        shrink capacity by the lost cache), `running` := 0 (nothing is in
+        flight in a fresh process). The attached WAL is rolled back to the
+        committed sequence inside ledger.load_state_dict."""
+        self.ledger.load_state_dict(state.get("ledger", {}))
+        consumed = self.ledger.consumed_count()
+        sm_state = dict(state.get("staleness", {}))
+        sm_state["accepted"] = consumed
+        sm_state["running"] = 0
+        sm_state["submitted"] = max(int(sm_state.get("submitted", 0)), consumed)
+        self.staleness_manager.load_state_dict(sm_state)
+        self._result_cache = []
+        self._consecutive_failures = 0

@@ -270,11 +270,18 @@ class SimpleDataLoader:
         return order
 
     def __iter__(self):
+        from areal_tpu.core import fault_injection
+
         order = self._order()
         n = len(self.dataset)
         while self._pos + self.batch_size <= n or (
             not self.drop_last and self._pos < n
         ):
+            # chaos seam: trainer death between fetching a batch and any
+            # downstream effect — the restored position must re-yield it
+            fault_injection.fire(
+                "dataloader.next", epoch=self._epoch, pos=self._pos
+            )
             idx = order[self._pos : self._pos + self.batch_size]
             self._pos += len(idx)
             yield [self.dataset[int(i)] for i in idx]

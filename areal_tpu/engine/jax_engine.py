@@ -766,8 +766,13 @@ class JaxTrainEngine(TrainEngine):
         return self
 
     def update_weights(self, meta: WeightUpdateMeta | None = None) -> None:
+        from areal_tpu.core import fault_injection
+
         meta = meta or self.weight_update_meta
         assert meta is not None
+        # chaos seam: trainer death mid weight-push — decode servers keep
+        # the old version, the restored trainer re-pushes after load
+        fault_injection.fire("train.weights.push", version=self.get_version())
         if meta.type == "memory":
             # Colocated fast path: hand the sharded jax.Arrays directly to
             # the decode engine, which device_puts onto its own shardings —
@@ -1285,7 +1290,12 @@ class JaxTrainEngine(TrainEngine):
         # with different strategies coexist in one process (actor + critic).
         mesh_lib.set_current_mesh(self.mesh)
         assert self.optimizer is not None, "engine has no optimizer"
+        from areal_tpu.core import fault_injection
         from areal_tpu.utils.perf_tracer import annotate, maybe_xprof_step
+
+        # chaos seam: a trainer dying inside an optimizer step (weights
+        # half-applied in HBM, nothing durable) — see bench chaostrain
+        fault_injection.fire("train.step", step=self._step_count)
 
         t_start = time.perf_counter()
         # env-gated device-trace window (AREAL_TPU_XPROF_DIR [+ _STEPS])

@@ -251,11 +251,13 @@ class PPOActor:
                   "incorrect_n_seqs"):
             global_stats.pop(k, None)
 
-        # drop non-training keys
+        # drop non-training keys (rollout_id/rollout_version are ledger
+        # provenance stamps, not model inputs)
         data = {
             k: v
             for k, v in data.items()
-            if k not in ("rewards", "tot_rewards", "kl_rewards", "versions")
+            if k not in ("rewards", "tot_rewards", "kl_rewards", "versions",
+                         "rollout_id", "rollout_version")
         }
 
         self.engine.train()

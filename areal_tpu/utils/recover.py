@@ -1,35 +1,109 @@
-"""Step-level fault recovery: checkpoint + restore of the full training state.
+"""Step-level fault recovery: crash-atomic, versioned checkpoint + restore
+of the full training state.
 
 Parity target: areal/utils/recover.py:29 (RecoverInfo) and :139
-(RecoverHandler). Each dump writes, atomically under a marker file:
+(RecoverHandler), hardened per ISSUE 14: the trainer is the single
+stateful component the whole async loop hangs off, so dying mid-dump must
+never destroy the previous recovery point. Layout:
 
   {fileroot}/recover/{experiment}/{trial}/
-      recover_info.pkl   — StepInfo + saver/evaluator freq-gate state +
-                           dataloader position + engine version
-      checkpoint/        — HF-format weights + optimizer state (optim/)
+      step-{G}/                 — one committed recovery point per dump
+          checkpoint/           — orbax sharded params + optimizer
+          recover_info.pkl      — StepInfo + freq-gate states + dataloader
+                                  position + sample-ledger state + version
+          MANIFEST.json         — relpath/size/sha256 of every file above,
+                                  fsynced BEFORE the atomic rename commits
+                                  the step (no bare pickle trust: the
+                                  pickle's checksum is verified before
+                                  unpickling)
+      step-{G}.tmp/             — an in-progress (or crashed) dump; never
+                                  eligible for load
+      ledger.wal                — consumed-batch journal (core/sample_ledger)
 
-`load` restores engine weights+optimizer, dataloader position, and the
-freq-gate states, then the caller re-pushes weights into the inference
-servers and resumes from `recover_info.last_step_info.next()` — identical
-semantics to the reference's RecoverHandler.
+Dump lifecycle: write everything into `step-{G}.tmp`, fsync the manifest
+(and the file payloads it seals), `os.rename` to `step-{G}` (the commit
+point), fsync the parent dir, THEN prune to `config.keep_last` committed
+steps. A dump failure at any stage degrades to log + metric +
+retry-at-the-next-frequency-gate instead of killing the training loop.
+
+`load` walks committed steps newest→oldest, verifying each manifest;
+torn / mismatched / half-deleted candidates are skipped (counted in
+`recover_torn_skipped_total`) instead of crashing, so a crash mid-dump or
+a partially deleted dir costs one recovery point, never the run. The
+caller re-pushes weights into the inference servers and resumes from
+`recover_info.last_step_info.next()` — identical semantics to the
+reference's RecoverHandler.
+
+Fault seams (core/fault_injection): `recover.dump.save` (before the
+engine checkpoint), `recover.dump.info` (between checkpoint and
+recover_info), `recover.dump.marker` (between manifest and the atomic
+rename — the save-vs-marker gap), `recover.load` (per load candidate; an
+injected failure skips to the next-older step like any torn candidate).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import pickle
 import shutil
+import threading
 from dataclasses import dataclass, field
 from typing import Any
 
 from areal_tpu.api.cli_args import RecoverConfig
 from areal_tpu.api.io_struct import FinetuneSpec, SaveLoadMeta, StepInfo
+from areal_tpu.core import fault_injection
 from areal_tpu.utils import logging
 from areal_tpu.utils.timeutil import FrequencyControl
 
 logger = logging.getLogger("recover")
 
-_DONE_MARKER = "DONE"
+_STEP_PREFIX = "step-"
+_TMP_SUFFIX = ".tmp"
+_MANIFEST = "MANIFEST.json"
+_INFO_FILE = "recover_info.pkl"
+# name of the sample-ledger write-ahead journal colocated with the steps
+LEDGER_WAL = "ledger.wal"
+
+
+class _RecoverMetrics:
+    """Process-wide recovery counters (dump failures are per-process
+    evidence, not per-handler: a respawned handler must not zero them)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+
+    def bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+_GUARDED_BY = {
+    "_RecoverMetrics._counters": "_lock",
+}
+
+_METRICS = _RecoverMetrics()
+
+
+def get_metrics() -> dict[str, int]:
+    """Recovery counters: recover_dumps_total, recover_dump_failures_total,
+    recover_torn_skipped_total, recover_pruned_total, recover_loads_total."""
+    return _METRICS.snapshot()
+
+
+def reset_metrics() -> None:
+    _METRICS.reset()
 
 
 @dataclass
@@ -38,6 +112,13 @@ class RecoverInfo:
     saver_info: dict = field(default_factory=dict)
     evaluator_info: dict = field(default_factory=dict)
     dataloader_info: dict = field(default_factory=dict)
+    # the RecoverHandler's OWN freq-gate state: without it a resumed run's
+    # recover gate restarts cold and can re-fire immediately or skip a dump
+    recover_ctl_info: dict = field(default_factory=dict)
+    # WorkflowExecutor.state_dict(): sample ledger + staleness accounting,
+    # journaled with the checkpoint so the staleness cap and exactly-once
+    # consumption survive a trainer restart
+    ledger_info: dict = field(default_factory=dict)
     version: int = 0
 
 
@@ -47,15 +128,125 @@ def recover_root(config: RecoverConfig) -> str:
     )
 
 
+def ledger_wal_path(config: RecoverConfig) -> str:
+    """The sample-ledger WAL colocated with (and discarded with) the
+    recovery state."""
+    return os.path.join(recover_root(config), LEDGER_WAL)
+
+
+# -- manifest ---------------------------------------------------------------
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # e.g. non-POSIX fs; rename durability is best-effort
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_manifest(step_dir: str, global_step: int) -> None:
+    """Seal `step_dir`: record relpath/size/sha256 of every file, fsync the
+    payloads and then the manifest itself. Must be the LAST write before
+    the atomic rename — a dir whose manifest doesn't verify is torn."""
+    files = []
+    for dirpath, _dirnames, filenames in os.walk(step_dir):
+        for name in sorted(filenames):
+            if dirpath == step_dir and name == _MANIFEST:
+                continue
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, step_dir)
+            files.append(
+                dict(path=rel, size=os.path.getsize(full), sha256=_sha256(full))
+            )
+            # the manifest promises these bytes are durable
+            with open(full, "rb") as f:
+                os.fsync(f.fileno())
+    manifest = dict(global_step=global_step, files=sorted(files, key=lambda d: d["path"]))
+    mpath = os.path.join(step_dir, _MANIFEST)
+    with open(mpath, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(step_dir)
+
+
+def verify_step_dir(step_dir: str) -> tuple[bool, str]:
+    """Check a committed step dir against its manifest. Returns (ok, reason);
+    never raises — an unreadable candidate is just not recoverable."""
+    mpath = os.path.join(step_dir, _MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return False, f"manifest unreadable: {e!r}"
+    entries = manifest.get("files", [])
+    if not any(e["path"] == _INFO_FILE for e in entries):
+        return False, "manifest lists no recover_info.pkl"
+    for entry in entries:
+        full = os.path.join(step_dir, entry["path"])
+        if not os.path.exists(full):
+            return False, f"missing file {entry['path']}"
+        if os.path.getsize(full) != entry["size"]:
+            return False, f"size mismatch for {entry['path']}"
+        if _sha256(full) != entry["sha256"]:
+            return False, f"checksum mismatch for {entry['path']}"
+    return True, "ok"
+
+
+def _step_dirs_newest_first(root: str) -> list[tuple[int, str]]:
+    """Committed [(global_step, path)] newest-first; `.tmp` dirs (crashed or
+    in-progress dumps) are never candidates."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        if not name.startswith(_STEP_PREFIX) or name.endswith(_TMP_SUFFIX):
+            continue
+        full = os.path.join(root, name)
+        if not os.path.isdir(full):
+            continue
+        try:
+            g = int(name[len(_STEP_PREFIX):])
+        except ValueError:
+            continue
+        out.append((g, full))
+    return sorted(out, reverse=True)
+
+
 def check_if_auto_recover(config: RecoverConfig) -> bool:
-    """True when mode permits resuming AND a complete recover checkpoint
-    exists (reference `check_if_auto_recover`)."""
+    """True when mode permits resuming AND a manifest-verified recovery
+    point exists (reference `check_if_auto_recover`, hardened: a
+    half-deleted / torn dir is reported as "no recoverable state" instead
+    of exploding at `load` time)."""
     if config.mode not in ("auto", "resume", "fault"):
         return False
-    root = recover_root(config)
-    return os.path.exists(os.path.join(root, _DONE_MARKER)) and os.path.exists(
-        os.path.join(root, "recover_info.pkl")
-    )
+    candidates = _step_dirs_newest_first(recover_root(config))
+    for g, path in candidates:
+        ok, reason = verify_step_dir(path)
+        if ok:
+            return True
+        logger.warning(
+            f"recover candidate step-{g} fails verification ({reason}); "
+            f"checking older checkpoints"
+        )
+    if candidates:
+        logger.warning(
+            "no recoverable state: every recover candidate failed "
+            "manifest verification"
+        )
+    return False
 
 
 class RecoverHandler:
@@ -78,7 +269,17 @@ class RecoverHandler:
         dataloader=None,
         tokenizer=None,
         force: bool = False,
+        rollout=None,
     ) -> str | None:
+        """Write one crash-atomic recovery point; returns the committed
+        `step-{G}` path, or None when the gate didn't fire OR the dump
+        failed (failure degrades to log + metric — the training loop keeps
+        running and the gate re-fires at its next cadence; the previous
+        committed step is untouched either way).
+
+        `rollout` is the inference engine / WorkflowExecutor whose
+        `state_dict()` (sample ledger + staleness accounting) is journaled
+        with the checkpoint."""
         if self.config.mode == "disabled":
             return None
         if not force and not self.freq_ctl.check(
@@ -86,12 +287,39 @@ class RecoverHandler:
             steps=1,
         ):
             return None
+        try:
+            return self._dump_step(
+                engine, step_info, saver, evaluator, dataloader, tokenizer,
+                rollout,
+            )
+        except Exception as e:  # noqa: BLE001 — a failed dump must not kill training
+            _METRICS.bump("recover_dump_failures_total")
+            logger.error(
+                f"recover dump failed at global_step {step_info.global_step}"
+                f" ({e!r}); previous recovery points are intact, retrying at"
+                f" the next frequency gate"
+            )
+            return None
+
+    def _dump_step(
+        self, engine, step_info, saver, evaluator, dataloader, tokenizer,
+        rollout,
+    ) -> str:
         root = recover_root(self.config)
-        marker = os.path.join(root, _DONE_MARKER)
-        if os.path.exists(marker):
-            os.remove(marker)
-        ckpt = os.path.join(root, "checkpoint")
-        os.makedirs(ckpt, exist_ok=True)
+        os.makedirs(root, exist_ok=True)
+        g = step_info.global_step
+        final = os.path.join(root, f"{_STEP_PREFIX}{g}")
+        tmp = final + _TMP_SUFFIX
+        # a previous crashed attempt at this step leaves a stale tmp dir; a
+        # replayed step after recovery leaves a committed step-{G}. Only the
+        # tmp is cleared now — the committed dir stays valid until the
+        # instant this dump commits (displaced at rename time below).
+        for stale in (tmp, final + ".old"):
+            if os.path.exists(stale):
+                shutil.rmtree(stale)
+        ckpt = os.path.join(tmp, "checkpoint")
+        os.makedirs(ckpt)
+        fault_injection.fire("recover.dump.save", step=g)
         engine.save(
             SaveLoadMeta(
                 # orbax: sharded save of params+optimizer, no host gather
@@ -99,6 +327,7 @@ class RecoverHandler:
                 tokenizer=tokenizer
             )
         )
+        fault_injection.fire("recover.dump.info", step=g)
         info = RecoverInfo(
             last_step_info=step_info,
             saver_info=saver.state_dict() if saver is not None else {},
@@ -108,17 +337,47 @@ class RecoverHandler:
                 if dataloader is not None and hasattr(dataloader, "state_dict")
                 else {}
             ),
+            recover_ctl_info=self.freq_ctl.state_dict(),
+            ledger_info=(
+                rollout.state_dict()
+                if rollout is not None and hasattr(rollout, "state_dict")
+                else {}
+            ),
             version=engine.get_version(),
         )
-        with open(os.path.join(root, "recover_info.pkl"), "wb") as f:
+        with open(os.path.join(tmp, _INFO_FILE), "wb") as f:
             pickle.dump(info, f)
-        with open(marker, "w") as f:
-            f.write("ok")
+            f.flush()
+            os.fsync(f.fileno())
+        _write_manifest(tmp, g)
+        fault_injection.fire("recover.dump.marker", step=g)
+        if os.path.exists(final):
+            # a replayed step after recovery re-dumps the same G: displace
+            # the old dir to a non-candidate name (".old" fails the int()
+            # parse) so the unrecoverable window is two renames, not the
+            # whole engine.save
+            os.rename(final, final + ".old")
+            os.rename(tmp, final)  # the commit point
+            shutil.rmtree(final + ".old")
+        else:
+            os.rename(tmp, final)  # the commit point
+        _fsync_dir(root)
+        _METRICS.bump("recover_dumps_total")
         logger.info(
-            f"dumped recover checkpoint at global_step "
-            f"{step_info.global_step} -> {root}"
+            f"dumped recover checkpoint at global_step {g} -> {final}"
         )
-        return root
+        self._prune(root)
+        return final
+
+    def _prune(self, root: str) -> None:
+        keep = max(1, int(self.config.keep_last))
+        for g, path in _step_dirs_newest_first(root)[keep:]:
+            try:
+                shutil.rmtree(path)
+                _METRICS.bump("recover_pruned_total")
+            except OSError as e:
+                # a stuck prune costs disk, not correctness
+                logger.warning(f"failed to prune recover step-{g}: {e!r}")
 
     # -- load -----------------------------------------------------------
     def load(
@@ -130,37 +389,63 @@ class RecoverHandler:
         inference_engine=None,
         weight_update_meta=None,
     ) -> RecoverInfo | None:
-        """Restore everything; returns the RecoverInfo (resume from
-        `.last_step_info.next()`) or None when no checkpoint exists."""
-        if not check_if_auto_recover(self.config):
+        """Restore everything from the newest VERIFIED recovery point;
+        returns the RecoverInfo (resume from `.last_step_info.next()`) or
+        None when no usable checkpoint exists. Torn / mismatched / failing
+        candidates are skipped newest→oldest (recover_torn_skipped_total)
+        instead of crashing."""
+        if self.config.mode not in ("auto", "resume", "fault"):
             return None
         root = recover_root(self.config)
-        with open(os.path.join(root, "recover_info.pkl"), "rb") as f:
-            info: RecoverInfo = pickle.load(f)
-        engine.load(
-            SaveLoadMeta(
-                path=os.path.join(root, "checkpoint"),
-                weight_format="orbax",
-                with_optim=True,
+        for g, path in _step_dirs_newest_first(root):
+            try:
+                fault_injection.fire("recover.load", step=g)
+                ok, reason = verify_step_dir(path)
+                if not ok:
+                    raise RuntimeError(reason)
+                # the manifest (verified above) checksummed the pickle —
+                # only now is unpickling it trusted
+                with open(os.path.join(path, _INFO_FILE), "rb") as f:
+                    info: RecoverInfo = pickle.load(f)
+                engine.load(
+                    SaveLoadMeta(
+                        path=os.path.join(path, "checkpoint"),
+                        weight_format="orbax",
+                        with_optim=True,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — walk to the next-older candidate
+                _METRICS.bump("recover_torn_skipped_total")
+                logger.warning(
+                    f"skipping recover candidate step-{g} ({e!r}); "
+                    f"falling back to an older checkpoint"
+                )
+                continue
+            engine.set_version(info.version)
+            if saver is not None and info.saver_info:
+                saver.load_state_dict(info.saver_info)
+            if evaluator is not None and info.evaluator_info:
+                evaluator.load_state_dict(info.evaluator_info)
+            if dataloader is not None and info.dataloader_info:
+                dataloader.load_state_dict(info.dataloader_info)
+            if info.recover_ctl_info:
+                self.freq_ctl.load_state_dict(info.recover_ctl_info)
+            if inference_engine is not None:
+                inference_engine.set_version(info.version)
+                if info.ledger_info and hasattr(
+                    inference_engine, "load_state_dict"
+                ):
+                    inference_engine.load_state_dict(info.ledger_info)
+                if weight_update_meta is not None:
+                    # re-push restored weights so decode servers match
+                    engine.update_weights(weight_update_meta)
+            _METRICS.bump("recover_loads_total")
+            logger.info(
+                f"recovered from global_step {info.last_step_info.global_step}"
+                f" (version {info.version}, checkpoint {path})"
             )
-        )
-        engine.set_version(info.version)
-        if saver is not None and info.saver_info:
-            saver.load_state_dict(info.saver_info)
-        if evaluator is not None and info.evaluator_info:
-            evaluator.load_state_dict(info.evaluator_info)
-        if dataloader is not None and info.dataloader_info:
-            dataloader.load_state_dict(info.dataloader_info)
-        if inference_engine is not None:
-            inference_engine.set_version(info.version)
-            if weight_update_meta is not None:
-                # re-push restored weights so decode servers match
-                engine.update_weights(weight_update_meta)
-        logger.info(
-            f"recovered from global_step {info.last_step_info.global_step} "
-            f"(version {info.version})"
-        )
-        return info
+            return info
+        return None
 
     def state_dict(self) -> dict:
         return self.freq_ctl.state_dict()

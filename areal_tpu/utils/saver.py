@@ -28,6 +28,9 @@ class Saver:
             freq_step=config.freq_steps,
             freq_sec=config.freq_secs,
         )
+        # periodic-save failures observed this process (a full disk or a
+        # flaky store must not kill the training loop; see save())
+        self.save_failures = 0
 
     @staticmethod
     def get_save_checkpoint_root(config: SaverConfig, name: str = "default") -> str:
@@ -74,15 +77,24 @@ class Saver:
         path = self.get_save_checkpoint_path(
             self.config, epoch, step, global_step, name
         )
-        engine.save(
-            SaveLoadMeta(
-                path=path,
-                weight_format="hf",
-                with_optim=self.for_recover,
-                tokenizer=tokenizer,
-                base_model_path=base_model_path,
+        try:
+            engine.save(
+                SaveLoadMeta(
+                    path=path,
+                    weight_format="hf",
+                    with_optim=self.for_recover,
+                    tokenizer=tokenizer,
+                    base_model_path=base_model_path,
+                )
             )
-        )
+        except Exception as e:  # noqa: BLE001 — degrade like RecoverHandler.dump
+            self.save_failures += 1
+            logger.error(
+                f"checkpoint save failed at global_step {global_step} "
+                f"({e!r}); retrying at the next frequency gate "
+                f"(failures so far: {self.save_failures})"
+            )
+            return None
         logger.info(f"saved checkpoint at global_step {global_step} -> {path}")
         return path
 

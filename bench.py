@@ -3621,6 +3621,403 @@ def _bench_grpo_run(
     )
 
 
+def bench_chaostrain(
+    model,
+    n_prompts,
+    group_size,
+    prompt_len,
+    new_tokens,
+    steps,
+    mb_tokens,
+    kill_step=2,
+):
+    """Trainer-side chaos: a small deterministic GRPO loop killed at seeded
+    fault points (mid engine.save, the save-vs-marker gap, the
+    consume-vs-dump gap, mid weight-push), resumed from the committed
+    recovery point, and checked against an unfaulted oracle — plus a leg
+    where the NEWEST committed checkpoint is deliberately torn and recovery
+    must fall back to its predecessor.
+
+    Proof obligations per leg (the headline is the AND of all of them):
+    - exactly-once: the sample-ledger WAL ends with one entry per training
+      step, rid union == every generated trajectory, 0 lost / 0 duplicated
+      (the wait()-to-dump window is rolled back and replayed, never
+      double-journaled);
+    - monotone weight versions: the resumed engine version equals the
+      committed version, WAL entry versions never regress;
+    - bit-determinism: post-resume per-step losses and the final weight
+      fingerprint match the oracle (greedy decoding + shuffle-free loader +
+      rollout_id-sorted batches + fixed init keys make the loop replayable).
+    """
+    import shutil
+    import tempfile
+
+    from areal_tpu.api.alloc_mode import ParallelStrategy
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+        MicroBatchSpec,
+        NormConfig,
+        OptimizerConfig,
+        PPOActorConfig,
+        RecoverConfig,
+    )
+    from areal_tpu.api.io_struct import FinetuneSpec, StepInfo, WeightUpdateMeta
+    from areal_tpu.core import fault_injection
+    from areal_tpu.core.fault_injection import (
+        FaultPlan,
+        FaultPoint,
+        InjectedFault,
+    )
+    from areal_tpu.core.sample_ledger import SampleWAL
+    from areal_tpu.dataset import SimpleDataLoader
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.engine.ppo.actor import JaxPPOActor
+    from areal_tpu.utils import recover as recover_mod
+    from areal_tpu.utils.recover import RecoverHandler, ledger_wal_path
+    from areal_tpu.workflow.rlvr import RLVRWorkflow
+
+    samples_per_step = n_prompts * group_size
+    rng = np.random.RandomState(11)
+    # fixed dataset, one epoch == `steps` batches: every leg sees the same
+    # prompts in the same order (loader position is checkpointed state)
+    dataset = [
+        dict(input_ids=rng.randint(1, model.vocab_size, (prompt_len,)).tolist())
+        for _ in range(n_prompts * steps)
+    ]
+    ft_spec = FinetuneSpec(1, len(dataset), samples_per_step)
+
+    def reward(prompt, completion, prompt_ids, completion_ids, **kw):
+        return float(sum(completion_ids[:8]) % 7) / 7.0
+
+    class Env:
+        pass
+
+    def build(fileroot):
+        env = Env()
+        env.rcfg = RecoverConfig(
+            experiment_name="bench", trial_name="chaostrain",
+            fileroot=fileroot, mode="fault", freq_steps=1, keep_last=2,
+        )
+        actor_cfg = PPOActorConfig(
+            experiment_name="bench",
+            trial_name="chaostrain",
+            path="",
+            init_from_scratch=True,  # fixed PRNG keys: identical across legs
+            dtype=model.dtype,
+            mb_spec=MicroBatchSpec(max_tokens_per_mb=mb_tokens),
+            optimizer=OptimizerConfig(
+                lr=1e-3,
+                warmup_steps_proportion=0.0,
+                lr_scheduler_type="constant",
+                gradient_clipping=1.0,
+            ),
+            gradient_checkpointing=model.remat,
+            group_size=group_size,
+            ppo_n_minibatches=1,
+            eps_clip=0.2,
+            kl_ctl=0.0,
+            # batch-level normalization: greedy decoding makes group members
+            # identical, so group-level norm would zero every advantage and
+            # the oracle would be a trivially-flat loop
+            adv_norm=NormConfig(
+                mean_level="batch", std_level="batch", group_size=group_size
+            ),
+            use_decoupled_loss=True,
+            temperature=1.0,
+        )
+        env.actor = JaxPPOActor(actor_cfg)
+        env.actor.model_config = model
+        env.actor.create_process_group(ParallelStrategy())
+        env.actor.initialize(None, ft_spec)
+        env.rollout = JaxDecodeEngine(
+            JaxDecodeConfig(
+                context_length=prompt_len + new_tokens + 128,
+                max_running_requests=64,
+                new_tokens_per_chunk=min(128, new_tokens),
+                dtype=model.dtype,
+                kv_cache_dtype=model.dtype,
+            ),
+            InferenceEngineConfig(
+                max_concurrent_rollouts=samples_per_step * 2,
+                consumer_batch_size=samples_per_step,
+                max_head_offpolicyness=steps + 2,
+                request_timeout=3600,
+            ),
+        )
+        env.rollout.set_model(env.actor.params, model)
+        env.rollout.initialize()
+        env.actor.connect_engine(env.rollout, WeightUpdateMeta.from_memory())
+        env.rollout.attach_ledger_wal(ledger_wal_path(env.rcfg))
+        env.workflow = RLVRWorkflow(
+            reward,
+            GenerationHyperparameters(
+                n_samples=group_size, max_new_tokens=new_tokens,
+                temperature=1.0, top_p=1.0, greedy=True,
+            ),
+        )
+        env.loader = SimpleDataLoader(
+            dataset, batch_size=n_prompts, shuffle=False
+        )
+        env.handler = RecoverHandler(env.rcfg, ft_spec)
+        return env
+
+    def destroy(env):
+        env.rollout.destroy()
+        env.actor.destroy()
+
+    def _si(g):
+        return StepInfo(
+            epoch=0, epoch_step=g, global_step=g, steps_per_epoch=steps
+        )
+
+    def _loss_of(stats):
+        s = stats[0]
+        for k in ("loss", "actor/loss"):
+            if k in s:
+                return float(s[k])
+        for k in sorted(s):
+            if k.endswith("loss"):
+                return float(s[k])
+        return float("nan")
+
+    def _fingerprint(actor):
+        import jax
+
+        return float(
+            sum(
+                float(np.abs(np.asarray(x)).sum())
+                for x in jax.tree_util.tree_leaves(actor.params)
+            )
+        )
+
+    def one_step(env, g):
+        batch = env.rollout.rollout_batch(
+            next(env.data_iter), workflow=env.workflow
+        )
+        # wait() shuffles result order; re-sort by the ledger's rollout_id
+        # stamp so the training batch is identical across crash/resume legs
+        order = np.argsort(np.asarray(batch["rollout_id"]), kind="stable")
+        batch = {k: np.asarray(v)[order] for k, v in batch.items()}
+        batch["prox_logp"] = env.actor.compute_logp(batch)
+        env.actor.compute_advantages(batch)
+        stats = env.actor.ppo_update(batch)
+        env.actor.set_version(g + 1)
+        env.rollout.pause()
+        env.actor.update_weights(None)
+        env.rollout.set_version(g + 1)
+        env.rollout.resume()
+        return _loss_of(stats)
+
+    def dump(env, g):
+        """Returns True when a dump-internal fault seam fired (the injector
+        aborts mid-dump, RecoverHandler degrades — the on-disk state is
+        exactly a process that died there, so the leg abandons the loop)."""
+        before = fault_injection.snapshot()
+        env.handler.dump(
+            env.actor, _si(g), dataloader=env.loader, rollout=env.rollout
+        )
+        after = fault_injection.snapshot()
+        return any(after.get(k, 0) > before.get(k, 0) for k in after)
+
+    def run_leg(fileroot, plan):
+        """Run to completion or the seeded kill; returns (committed per-step
+        losses, crashed step or None, final fingerprint or None)."""
+        env = build(fileroot)
+        env.data_iter = iter(env.loader)
+        if plan is not None:
+            fault_injection.configure(plan)
+        losses, crashed_at, fp = {}, None, None
+        try:
+            for g in range(steps):
+                try:
+                    loss = one_step(env, g)
+                except InjectedFault:
+                    crashed_at = g
+                    break
+                if dump(env, g):
+                    crashed_at = g
+                    break
+                losses[g] = loss
+            if crashed_at is None:
+                fp = _fingerprint(env.actor)
+        finally:
+            fault_injection.deactivate()
+            destroy(env)
+        return losses, crashed_at, fp
+
+    def resume_leg(fileroot, committed_losses):
+        """Fresh env (a restarted trainer), recover, replay to completion."""
+        env = build(fileroot)
+        try:
+            info = env.handler.load(
+                env.actor,
+                dataloader=env.loader,
+                inference_engine=env.rollout,
+                weight_update_meta=WeightUpdateMeta.from_memory(),
+            )
+            assert info is not None, "no recoverable state after crash"
+            start = info.last_step_info.next().global_step
+            resumed_version = env.actor.get_version()
+            env.data_iter = iter(env.loader)
+            losses = dict(committed_losses)
+            for g in range(start, steps):
+                losses[g] = one_step(env, g)
+                dump(env, g)
+            return dict(
+                start=start,
+                resumed_version=resumed_version,
+                losses=losses,
+                fp=_fingerprint(env.actor),
+                wal=SampleWAL(ledger_wal_path(env.rcfg)).replay(),
+            )
+        finally:
+            destroy(env)
+
+    def check_wal(wal):
+        versions = [e["version"] for e in wal]
+        rids = [r for e in wal for r in e["rids"]]
+        lost = steps * n_prompts - len(set(rids))
+        dup = len(rids) - len(set(rids))
+        exactly_once = (
+            versions == list(range(steps)) and lost == 0 and dup == 0
+        )
+        monotonic = versions == sorted(versions)
+        return exactly_once, monotonic, lost, dup
+
+    KILL_SITES = (
+        "recover.dump.save",     # mid engine.save: torn tmp dir left behind
+        "recover.dump.marker",   # save-vs-marker gap: sealed but uncommitted
+        "train.step",            # consume-vs-dump gap: batch journaled, not committed
+        "train.weights.push",    # mid push: update applied in memory, lost
+    )
+    tmp_roots = []
+
+    def mkroot(tag):
+        d = tempfile.mkdtemp(prefix=f"chaostrain-{tag}-")
+        tmp_roots.append(d)
+        return d
+
+    try:
+        # -- oracle: the unfaulted run every leg must reproduce ----------
+        oracle_root = mkroot("oracle")
+        oracle_losses, crashed, oracle_fp = run_leg(oracle_root, None)
+        assert crashed is None and len(oracle_losses) == steps
+        ora_wal = SampleWAL(
+            ledger_wal_path(
+                RecoverConfig(
+                    experiment_name="bench", trial_name="chaostrain",
+                    fileroot=oracle_root, mode="fault",
+                )
+            )
+        ).replay()
+        ora_once, ora_mono, _, _ = check_wal(ora_wal)
+
+        legs = []
+        loss_diffs, fp_diffs = [], []
+        all_once, all_mono = ora_once, ora_mono
+        lost_total = dup_total = 0
+
+        # -- seeded kill legs -------------------------------------------
+        for site in KILL_SITES:
+            root = mkroot(site.replace(".", "-"))
+            plan = FaultPlan(
+                seed=5,
+                points=(
+                    FaultPoint(
+                        site=site, mode="abort", at=(kill_step,), times=1
+                    ),
+                ),
+            )
+            committed, crashed_at, _ = run_leg(root, plan)
+            assert crashed_at == kill_step, (site, crashed_at)
+            res = resume_leg(root, committed)
+            assert res["start"] == kill_step, (site, res["start"])
+            once, mono, lost, dup = check_wal(res["wal"])
+            mono = mono and res["resumed_version"] == res["start"]
+            diff = max(
+                abs(res["losses"][g] - oracle_losses[g]) for g in range(steps)
+            )
+            fpd = abs(res["fp"] - oracle_fp)
+            legs.append(
+                dict(site=site, crashed_at=crashed_at, resume=res["start"],
+                     once=once, loss_diff=diff)
+            )
+            loss_diffs.append(diff)
+            fp_diffs.append(fpd)
+            all_once &= once
+            all_mono &= mono
+            lost_total += lost
+            dup_total += dup
+
+        # -- torn-newest leg: bit-rot the newest COMMITTED checkpoint ----
+        torn_root = mkroot("torn")
+        full_losses, crashed, _ = run_leg(torn_root, None)
+        assert crashed is None
+        rcfg_t = RecoverConfig(
+            experiment_name="bench", trial_name="chaostrain",
+            fileroot=torn_root, mode="fault", keep_last=2,
+        )
+        newest = os.path.join(
+            recover_mod.recover_root(rcfg_t), f"step-{steps - 1}"
+        )
+        with open(os.path.join(newest, "recover_info.pkl"), "ab") as f:
+            f.write(b"\x00bitrot")  # size+checksum mismatch vs manifest
+        recover_mod.reset_metrics()
+        # drop the committed final step's losses: the torn checkpoint means
+        # step steps-1 must be REPLAYED from the predecessor, not trusted
+        res = resume_leg(torn_root, {g: full_losses[g] for g in range(steps - 1)})
+        torn_skipped = recover_mod.get_metrics()["recover_torn_skipped_total"]
+        assert res["start"] == steps - 1, res["start"]
+        once, mono, lost, dup = check_wal(res["wal"])
+        torn_diff = max(
+            abs(res["losses"][g] - oracle_losses[g]) for g in range(steps)
+        )
+        loss_diffs.append(torn_diff)
+        fp_diffs.append(abs(res["fp"] - oracle_fp))
+        all_once &= once
+        all_mono &= mono
+        lost_total += lost
+        dup_total += dup
+        legs.append(
+            dict(site="torn-newest", crashed_at=None, resume=res["start"],
+                 once=once, loss_diff=torn_diff)
+        )
+
+        max_loss_diff = max(loss_diffs)
+        max_fp_diff = max(fp_diffs)
+        ok = (
+            all_once
+            and all_mono
+            and lost_total == 0
+            and dup_total == 0
+            and torn_skipped >= 1
+            and max_loss_diff < 1e-6
+            and max_fp_diff < 1e-4
+        )
+        return dict(
+            chaostrain_exactly_once=ok,
+            chaostrain_kill_legs=len(KILL_SITES),
+            chaostrain_lost_samples=lost_total,
+            chaostrain_double_trained=dup_total,
+            chaostrain_versions_monotonic=all_mono,
+            chaostrain_loss_max_abs_diff=max_loss_diff,
+            chaostrain_fingerprint_max_abs_diff=max_fp_diff,
+            chaostrain_torn_skipped=int(torn_skipped),
+            chaostrain_steps=steps,
+            chaostrain_kill_step=kill_step,
+            chaostrain_legs=[
+                f"{leg['site']}@{leg['crashed_at']}→resume{leg['resume']}"
+                f" once={leg['once']} Δloss={leg['loss_diff']:.2e}"
+                for leg in legs
+            ],
+        )
+    finally:
+        for d in tmp_roots:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 # --mode choice -> bench entry point. The argparse choices are derived from
 # this table and the dev-mode headline metrics live beside it, so a new mode
 # cannot ship half-wired; tests/test_bench_modes.py pins the sync.
@@ -3637,6 +4034,7 @@ BENCH_MODE_FNS = {
     "kvquant": bench_kvquant,
     "fleet": bench_fleet,
     "chaos": bench_chaos,
+    "chaostrain": bench_chaostrain,
     "disagg": bench_disagg,
     "autoscale": bench_autoscale,
 }
@@ -3654,6 +4052,7 @@ MODE_HEADLINES = {
     "kvquant": ("kvquant_capacity_ratio", "x"),
     "fleet": ("fleet_affinity_ttft_p50_speedup", "x"),
     "chaos": ("chaos_exactly_once", "bool"),
+    "chaostrain": ("chaostrain_exactly_once", "bool"),
     "disagg": ("disagg_decode_itl_p99_speedup", "x"),
     "autoscale": ("autoscale_replica_seconds_ratio", "x"),
 }
@@ -4270,6 +4669,18 @@ def main() -> None:
                 bench_grpo(
                     model, n_prompts=2, group_size=2, prompt_len=16,
                     new_tokens=16, warmup_steps=1, steps=2, mb_tokens=256,
+                )
+            )
+        if want("chaostrain"):
+            # 4-step deterministic GRPO loop (greedy decode, shuffle-free
+            # loader, batch-level adv norm) killed at each seeded trainer
+            # seam at step 2, resumed from the committed recovery point and
+            # checked against the unfaulted oracle; plus the torn-newest
+            # checkpoint leg recovering from the predecessor
+            decode.update(
+                bench_chaostrain(
+                    model, n_prompts=2, group_size=2, prompt_len=16,
+                    new_tokens=16, steps=4, mb_tokens=256,
                 )
             )
         metric = "trainer_mfu_cpu_smoke"

@@ -43,7 +43,7 @@ from areal_tpu.dataset import (
 from areal_tpu.engine.ppo.actor import JaxPPOActor
 from areal_tpu.utils import seeding, stats_tracker
 from areal_tpu.utils.evaluator import Evaluator
-from areal_tpu.utils.recover import RecoverHandler
+from areal_tpu.utils.recover import RecoverHandler, ledger_wal_path
 from areal_tpu.utils.saver import Saver
 from areal_tpu.utils.stats_logger import StatsLogger
 from areal_tpu.workflow.rlvr import RLVRWorkflow
@@ -280,6 +280,11 @@ def main(args):
     stats_logger = StatsLogger(config.stats_logger, ft_spec)
     evaluator = Evaluator(config.evaluator, ft_spec)
     recover_handler = RecoverHandler(config.recover, ft_spec)
+    # exactly-once sample accounting: journal consumed batches to a WAL
+    # colocated with the recovery state; load() rolls it back to the
+    # committed seq and restores the staleness cap from consumed counts
+    if hasattr(rollout, "attach_ledger_wal"):
+        rollout.attach_ledger_wal(ledger_wal_path(config.recover))
     recover_info = recover_handler.load(
         actor,
         saver,
@@ -354,6 +359,7 @@ def main(args):
                 evaluator,
                 train_dataloader,
                 tokenizer=tokenizer,
+                rollout=rollout,
             )
 
         with stats_tracker.record_timing("eval"):

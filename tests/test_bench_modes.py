@@ -84,6 +84,20 @@ def test_chaos_mode_is_pinned():
     assert bench.MODE_HEADLINES["chaos"] == ("chaos_exactly_once", "bool")
 
 
+def test_chaostrain_mode_is_pinned():
+    """ISSUE 14: the trainer-side chaos bench must stay reachable as
+    `--mode chaostrain` with its exactly-once headline — the acceptance
+    proof for crash-atomic recovery + the sample ledger (seeded kills at
+    every trainer seam, oracle-matched resume, torn-newest fallback)
+    lives behind this entry point."""
+    bench = _load_bench()
+    assert "chaostrain" in bench.BENCH_MODE_FNS
+    assert bench.BENCH_MODE_FNS["chaostrain"] is bench.bench_chaostrain
+    assert bench.MODE_HEADLINES["chaostrain"] == (
+        "chaostrain_exactly_once", "bool",
+    )
+
+
 def test_disagg_mode_is_pinned():
     """ISSUE 10: the disaggregated prefill/decode bench must stay
     reachable as `--mode disagg` with its decode-ITL headline — the

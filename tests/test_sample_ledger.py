@@ -1,0 +1,132 @@
+"""SampleLedger / SampleWAL: exactly-once sample accounting units
+(ISSUE 14 tentpole part 2)."""
+
+import json
+import os
+
+from areal_tpu.core.sample_ledger import SampleLedger, SampleWAL
+
+
+def test_rid_issuance_monotonic():
+    led = SampleLedger()
+    assert [led.new_rid() for _ in range(5)] == [0, 1, 2, 3, 4]
+
+
+def test_accept_consume_lifecycle():
+    led = SampleLedger()
+    rids = [led.new_rid() for _ in range(4)]
+    for r in rids:
+        assert led.on_accepted(r, version=0)
+    assert led.pending_count() == 4
+    assert led.consumed_count() == 0
+    led.on_consumed(rids, version=0)
+    assert led.pending_count() == 0
+    assert led.consumed_count() == 4
+    for r in rids:
+        assert led.is_consumed(r)
+
+
+def test_duplicate_accept_is_deduped():
+    """A trajectory re-arriving for an already-consumed (or already
+    pending) rid must be rejected — the double-train path."""
+    led = SampleLedger()
+    rid = led.new_rid()
+    assert led.on_accepted(rid, 0)
+    assert not led.on_accepted(rid, 0)  # still pending
+    led.on_consumed([rid], 0)
+    assert not led.on_accepted(rid, 1)  # consumed long ago
+    assert led.deduped_total() == 2
+
+
+def test_external_rid_advances_issuance():
+    led = SampleLedger()
+    assert led.on_accepted(100, 0)
+    assert led.new_rid() == 101
+
+
+def test_state_dict_excludes_pending():
+    """Accepted-but-unconsumed trajectories die with the process — they
+    must NOT be restored (the executor recomputes accepted := consumed)."""
+    led = SampleLedger()
+    a, b = led.new_rid(), led.new_rid()
+    led.on_accepted(a, 0)
+    led.on_accepted(b, 0)
+    led.on_consumed([a], 0)
+    st = led.state_dict()
+    assert st["consumed"] == [a]
+    assert st["next_rid"] == 2
+    led2 = SampleLedger()
+    led2.load_state_dict(st)
+    assert led2.consumed_count() == 1
+    assert led2.pending_count() == 0
+    # b was pending: after restore it is NOT consumed, so regeneration is
+    # accepted normally (no false dedup)
+    assert led2.on_accepted(b, 1)
+
+
+def test_wal_append_replay(tmp_path):
+    wal = SampleWAL(str(tmp_path / "ledger.wal"))
+    wal.append(1, 0, [3, 1, 2])
+    wal.append(2, 1, [4, 5])
+    entries = wal.replay()
+    assert [e["seq"] for e in entries] == [1, 2]
+    assert entries[0]["rids"] == [1, 2, 3]  # stored sorted
+    assert entries[1]["version"] == 1
+
+
+def test_wal_drops_torn_trailing_line(tmp_path):
+    path = str(tmp_path / "ledger.wal")
+    wal = SampleWAL(path)
+    wal.append(1, 0, [1])
+    with open(path, "a") as f:
+        f.write('{"seq": 2, "version"')  # crash mid-append
+    assert [e["seq"] for e in wal.replay()] == [1]
+
+
+def test_wal_rollback_truncates_uncommitted(tmp_path):
+    path = str(tmp_path / "ledger.wal")
+    wal = SampleWAL(path)
+    for seq in (1, 2, 3):
+        wal.append(seq, seq - 1, [seq * 10])
+    assert wal.rollback_to(1) == 2
+    assert [e["seq"] for e in wal.replay()] == [1]
+    # idempotent
+    assert wal.rollback_to(1) == 0
+
+
+def test_ledger_restore_rolls_wal_back(tmp_path):
+    """The committed checkpoint carries wal_seq; entries journaled after it
+    (the wait()-to-dump window) are rolled back on restore, so their
+    regenerated samples re-journal without duplicate entries."""
+    path = str(tmp_path / "ledger.wal")
+    led = SampleLedger()
+    led.attach_wal(SampleWAL(path))
+    r0, r1 = led.new_rid(), led.new_rid()
+    led.on_accepted(r0, 0)
+    led.on_consumed([r0], 0)
+    committed = led.state_dict()  # checkpoint commits here (wal_seq=1)
+    led.on_accepted(r1, 0)
+    led.on_consumed([r1], 0)  # journaled but never committed
+    assert len(SampleWAL(path).replay()) == 2
+
+    led2 = SampleLedger()
+    led2.attach_wal(SampleWAL(path))
+    led2.load_state_dict(committed)
+    entries = SampleWAL(path).replay()
+    assert [e["seq"] for e in entries] == [1]
+    # the regenerated r1 consumes again under a fresh seq with no collision
+    assert led2.on_accepted(r1, 0)
+    led2.on_consumed([r1], 0)
+    entries = SampleWAL(path).replay()
+    assert [e["seq"] for e in entries] == [1, 2]
+    rids = [r for e in entries for r in e["rids"]]
+    assert sorted(rids) == sorted([r0, r1])  # each sample exactly once
+
+
+def test_wal_entries_are_json_lines(tmp_path):
+    path = str(tmp_path / "ledger.wal")
+    SampleWAL(path).append(1, 7, [9])
+    with open(path) as f:
+        e = json.loads(f.readline())
+    assert e == dict(seq=1, version=7, rids=[9])
+    assert os.path.getsize(path) > 0

@@ -53,3 +53,40 @@ def test_stats_snapshot():
     m.on_rollout_accepted()
     st = m.get_stats()
     assert (st.submitted, st.accepted, st.running) == (2, 1, 1)
+
+
+# -- checkpointing (ISSUE 14) ------------------------------------------------
+
+
+def test_state_dict_roundtrip():
+    m = StalenessManager(16, 4, 2)
+    for _ in range(6):
+        m.on_rollout_submitted()
+    for _ in range(3):
+        m.on_rollout_accepted()
+    st = m.state_dict()
+    assert st == dict(submitted=6, accepted=3, running=3)
+    m2 = StalenessManager(16, 4, 2)
+    m2.load_state_dict(st)
+    assert m2.state_dict() == st
+    for v in range(4):
+        assert m2.get_capacity(v) == m.get_capacity(v)
+
+
+def test_restored_capacity_arithmetic():
+    """After a trainer restart the executor restores accepted := ledger
+    consumed count and running := 0; the staleness cap must continue the
+    boba² formula from exactly those counters."""
+    m = StalenessManager(
+        max_concurrent_rollouts=100, consumer_batch_size=4, max_staleness=1
+    )
+    # two batches trained+committed before the crash, nothing in flight
+    m.load_state_dict(dict(submitted=8, accepted=8, running=0))
+    # version 2: (1 + 2 + 1) * 4 - (8 + 0) = 8 admissible
+    assert m.get_capacity(2) == 8
+    # version 1: (1 + 1 + 1) * 4 - 8 = 4
+    assert m.get_capacity(1) == 4
+    # running slots count against both caps again after restore
+    for _ in range(4):
+        m.on_rollout_submitted()
+    assert m.get_capacity(1) == 0

@@ -173,10 +173,11 @@ def test_failure_streak_escalates_but_releases_slots(executor):
     """16 consecutive failures must surface a RuntimeError (a systematic
     failure, e.g. a crashed decode engine) — with every slot released
     first, so recovery after the operator intervenes starts from clean
-    accounting."""
+    accounting. The message must embed the root cause (ISSUE 14
+    satellite), not just point at __cause__."""
     for i in range(20):
         executor.submit({"value": i}, workflow=BoomWorkflow())
-    with pytest.raises(RuntimeError, match="consecutive"):
+    with pytest.raises(RuntimeError, match="rollout died"):
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
             executor._admit_pending()
@@ -210,3 +211,89 @@ def test_cancelled_episode_not_counted_as_failure():
         assert ex._consecutive_failures == streak_before
     finally:
         pass
+
+
+# -- sample ledger (ISSUE 14) ------------------------------------------------
+
+
+def test_batches_are_stamped_and_journaled(executor):
+    """Accepted trajectories carry (rollout_id, rollout_version); wait()
+    journals exactly the consumed identities."""
+    executor.set_version(0)
+    data = [dict(value=i) for i in range(4)]
+    batch = executor.rollout_batch(data, workflow=EchoWorkflow())
+    assert sorted(batch["rollout_id"].tolist()) == [0, 1, 2, 3]
+    assert batch["rollout_version"].tolist() == [0, 0, 0, 0]
+    assert executor.ledger.consumed_count() == 4
+    assert executor.ledger.pending_count() == 0
+
+
+def test_already_consumed_rid_is_deduped(executor):
+    """A duplicate arriving for a consumed rollout id (a still-running
+    replica delivering after a trainer restart) must be rejected, not
+    trained twice."""
+    executor.submit(dict(value=1), workflow=EchoWorkflow(), rollout_id=7)
+    batch = executor.wait(1, timeout=10)
+    assert batch["rollout_id"].tolist() == [7]
+    assert executor.ledger.consumed_count() == 1
+    # the duplicate: same rid, fresh submission
+    executor.submit(dict(value=1), workflow=EchoWorkflow(), rollout_id=7)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        executor._admit_pending()
+        executor._collect()
+        st = executor.get_stats()
+        if st.running == 0 and executor.ledger.deduped_total() >= 1:
+            break
+        time.sleep(0.02)
+    assert executor.ledger.deduped_total() == 1
+    assert executor.ledger.consumed_count() == 1
+    assert len(executor._result_cache) == 0
+    assert executor.get_stats().running == 0
+
+
+def test_executor_state_roundtrip_restores_capacity(tmp_path):
+    """load_state_dict: accepted := consumed count, running := 0 — a
+    restarted executor's staleness cap continues from the committed
+    consumption, not from counters inflated by died-in-flight work."""
+    cfg = InferenceEngineConfig(
+        max_concurrent_rollouts=16,
+        consumer_batch_size=4,
+        max_head_offpolicyness=2,
+        check_trajectory_format=True,
+    )
+    ex = WorkflowExecutor(cfg, FakeEngine())
+    ex.initialize()
+    try:
+        ex.attach_ledger_wal(str(tmp_path / "ledger.wal"))
+        ex.rollout_batch(
+            [dict(value=i) for i in range(4)], workflow=EchoWorkflow()
+        )
+        # two more accepted but never consumed: they die with the process
+        ex.submit(dict(value=9), workflow=EchoWorkflow())
+        ex.submit(dict(value=10), workflow=EchoWorkflow())
+        deadline = time.monotonic() + 10
+        while len(ex._result_cache) < 2 and time.monotonic() < deadline:
+            ex._admit_pending()
+            ex._collect()
+            time.sleep(0.02)
+        assert len(ex._result_cache) == 2
+        state = ex.state_dict()
+    finally:
+        ex.destroy()
+
+    ex2 = WorkflowExecutor(cfg, FakeEngine())
+    ex2.initialize()
+    try:
+        ex2.attach_ledger_wal(str(tmp_path / "ledger.wal"))
+        ex2.load_state_dict(state)
+        st = ex2.get_stats()
+        assert st.accepted == 4  # consumed count, not the raw 6
+        assert st.running == 0
+        assert ex2._result_cache == []
+        # fresh rids continue after every previously issued id
+        assert ex2.ledger.new_rid() == 6
+        # capacity at version 0: min(16 - 0, (2+0+1)*4 - 4) = 8
+        assert ex2.staleness_manager.get_capacity(0) == 8
+    finally:
+        ex2.destroy()
