@@ -446,6 +446,10 @@ class AllocationMode:
         *,
         microbatch_tokens: int = 8192,
         remat: bool = True,
+        fsdp: bool = True,
+        zero1: bool = False,
+        pipeline_schedule: str = "1f1b",
+        virtual_pp: int = 1,
         decode_slots: int = 64,
         decode_context: int = 32768,
         decode_pool_tokens: int | None = None,
@@ -473,6 +477,10 @@ class AllocationMode:
                 sp=self.train.cp_size,
                 microbatch_tokens=microbatch_tokens,
                 remat=remat,
+                fsdp=fsdp,
+                zero1=zero1,
+                pipeline_schedule=pipeline_schedule,
+                virtual_pp=virtual_pp,
             )
             try:
                 hbm.check_fit(est, device_kind, utilization=utilization)

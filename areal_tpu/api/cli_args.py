@@ -152,8 +152,37 @@ class JaxEngineConfig:
     # Pipeline schedule under pp>1: "1f1b" interleaves each micro-batch's
     # backward right behind its forward (live activation stash capped at
     # 2*pp-1 per stage, so bigger M — smaller bubble — fits in fixed HBM);
-    # "gpipe" is the all-forward-then-all-backward reference/fallback path.
+    # "1f1b_interleaved" additionally splits each rank into
+    # `virtual_pp_size` non-contiguous virtual stages (Megatron's
+    # interleaved schedule), shrinking the bubble ~1/v at a stash bound of
+    # v*(2*pp-1); "gpipe" is the all-forward-then-all-backward
+    # reference/fallback path.
     pipeline_schedule: str = "1f1b"
+    # Virtual pipeline stages per pp rank (interleaved 1F1B). 1 = one
+    # contiguous stage per rank. Values > 1 require
+    # pipeline_schedule "1f1b_interleaved" or "gpipe" and
+    # num_hidden_layers % (pp * virtual_pp_size) == 0; the engine then
+    # stores the scanned layer stack in chunk-major order (layer
+    # round-robin across ranks) so chunk dispatch is a pure reshape.
+    virtual_pp_size: int = 1
+    # ZeRO-1: shard AdamW moments and the optimizer update over the dp
+    # axis (reduce-scatter grads -> sharded update -> all-gather params,
+    # expressed as shardings so XLA emits the collectives). Frees
+    # 8 bytes/param of replicated fp32 moment state per dp rank; bitwise
+    # identical to the replicated update (reduction order unchanged —
+    # sharding only partitions the elementwise moment math).
+    zero1_optimizer: bool = True
+    # Hybrid ICI/DCN mesh: number of accelerator slices (pods) the trainer
+    # spans. 1 = single-slice mesh (plain build_mesh). > 1 places the axes
+    # named in mesh_dcn_axes across slice boundaries so only their traffic
+    # (the pp stage-boundary activation hop, the dp gradient reduce)
+    # crosses the slower DCN; axis order inside a slice is unchanged.
+    mesh_num_slices: int = 1
+    # Which mesh axes cross slice boundaries when mesh_num_slices > 1, in
+    # mesh order. Product of their DCN factors must equal mesh_num_slices;
+    # "pp" (outermost, least traffic) is the default, optionally with an
+    # outer "dp" split.
+    mesh_dcn_axes: list[str] = field(default_factory=lambda: ["pp"])
     # Zig-zag context-parallel layout: shard the packed token axis as paired
     # chunks (i, 2n-1-i) so every ring-attention shard does equal causal
     # work. Exact (a pure relabeling, inverted on outputs); applies only

@@ -155,6 +155,101 @@ def make_optimizer(
     return opt, schedule
 
 
+def zero1_extend_sharding(
+    sharding: jax.sharding.NamedSharding,
+    shape: tuple[int, ...],
+    mesh: jax.sharding.Mesh,
+) -> jax.sharding.NamedSharding:
+    """ZeRO-1 spec for an optimizer-state / gradient leaf: additionally
+    shard over the dp axis (arXiv:2004.13336 — each dp rank owns 1/dp of
+    the moments and of the update computation).
+
+    Leaves whose sharding already uses dp anywhere (fsdp "embed" rule) are
+    left alone — a mesh axis may shard at most one dim. Otherwise dp is
+    appended to the first dim it divides evenly (on top of whatever axes
+    already shard that dim); leaves too small to split stay as they are
+    (scalars, tiny norm vectors on awkward meshes).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    dp = mesh.shape.get(mesh_lib.AXIS_DP, 1)
+    if dp <= 1 or not shape:
+        return sharding
+    spec = tuple(sharding.spec)
+    used: set[str] = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        used.update((entry,) if isinstance(entry, str) else entry)
+    if mesh_lib.AXIS_DP in used:
+        return sharding
+    new_spec = list(spec) + [None] * (len(shape) - len(spec))
+    for i, dim in enumerate(shape):
+        entry = new_spec[i]
+        group = (
+            ()
+            if entry is None
+            else ((entry,) if isinstance(entry, str) else tuple(entry))
+        )
+        existing = 1
+        for a in group:
+            existing *= mesh.shape.get(a, 1)
+        if dim % (existing * dp) == 0:
+            new_spec[i] = group + (mesh_lib.AXIS_DP,) if group else mesh_lib.AXIS_DP
+            return NamedSharding(mesh, PartitionSpec(*new_spec))
+    return sharding
+
+
+def opt_state_sharding(
+    optimizer: optax.GradientTransformation,
+    trainable_params,
+    trainable_shardings,
+    mesh: jax.sharding.Mesh,
+    *,
+    zero1: bool = False,
+):
+    """Shard optimizer moments like their parameters (plus ZeRO-1 dp split).
+
+    optax states embed *copies of the param tree* (ScaleByAdamState.mu/nu
+    etc.), so every moment leaf's key path ends with the key path of the
+    param it mirrors. Matching on that path suffix is exact — unlike shape
+    matching, two distinct params with equal shapes (e.g. gate and up
+    projections) can never swap shardings. Leaves whose path matches no
+    param (step counters) are replicated.
+
+    This is THE one builder for opt-state shardings — `initialize`,
+    `_get_apply_update`, orbax restore and the plan check all go through
+    the engine's cached `_opt_state_shardings()` wrapper around it, so a
+    schedule switch or a restore can never silently re-replicate moments.
+
+    With `zero1`, every moment leaf is additionally dp-sharded
+    (`zero1_extend_sharding`): grads arrive reduce-scattered, the update
+    math runs on 1/dp of the state per rank, and the param out_shardings
+    all-gather the result — XLA emits the collectives from the shardings
+    alone, the update code is unchanged.
+    """
+    shape = jax.eval_shape(optimizer.init, trainable_params)
+    param_paths = {
+        tuple(str(k) for k in path): shard
+        for path, shard in jax.tree_util.tree_leaves_with_path(
+            trainable_shardings
+        )
+    }
+    repl = mesh_lib.replicated(mesh)
+
+    def assign(path, leaf):
+        keys = tuple(str(k) for k in path)
+        for i in range(len(keys)):
+            hit = param_paths.get(keys[i:])
+            if hit is not None:
+                if zero1:
+                    return zero1_extend_sharding(hit, leaf.shape, mesh)
+                return hit
+        return repl
+
+    return jax.tree_util.tree_map_with_path(assign, shape)
+
+
 def fused_lm_loss_enabled(engine) -> bool:
     """Whether `engine` wants hidden_loss-tagged (fused vocab-chunked head)
     loss functions — the one probe shared by the SFT engine and PPO actor."""
@@ -287,7 +382,18 @@ class JaxTrainEngine(TrainEngine):
 
         enable_compilation_cache()
         self.parallel_strategy = parallel_strategy
-        self.mesh = mesh_lib.build_mesh(parallel_strategy)
+        num_slices = int(getattr(self.config.jax, "mesh_num_slices", 1))
+        if num_slices > 1:
+            self.mesh = mesh_lib.build_hybrid_mesh(
+                parallel_strategy,
+                num_slices=num_slices,
+                dcn_axes=tuple(
+                    getattr(self.config.jax, "mesh_dcn_axes", None)
+                    or (mesh_lib.AXIS_PP,)
+                ),
+            )
+        else:
+            self.mesh = mesh_lib.build_mesh(parallel_strategy)
         mesh_lib.set_current_mesh(self.mesh)
         logger.info(
             f"mesh built: {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}"
@@ -344,6 +450,7 @@ class JaxTrainEngine(TrainEngine):
             host_params["lora"] = init_lora_params(
                 self.model_config, jax.random.PRNGKey(2)
             )
+        host_params = self._to_engine_layout(host_params)
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(jnp.asarray(x), s),
             host_params,
@@ -367,16 +474,26 @@ class JaxTrainEngine(TrainEngine):
         initialization and the abstract plan check, so the two can never
         drift on the sharding layout)."""
         pp_enabled = self.mesh.shape.get(mesh_lib.AXIS_PP, 1) > 1
+        v = self._virtual_pp
         if pp_enabled:
             assert self.model_config.scan_layers, (
                 "pipeline parallelism (pp>1) requires scan_layers=True: the "
                 "stacked [L, ...] layer dim is what shards over the pp axis"
             )
             pp = self.mesh.shape[mesh_lib.AXIS_PP]
-            assert self.model_config.num_hidden_layers % pp == 0, (
+            assert self.model_config.num_hidden_layers % (pp * v) == 0, (
                 f"num_hidden_layers={self.model_config.num_hidden_layers} "
-                f"must divide evenly into pp={pp} stages"
+                f"must divide evenly into pp={pp} x virtual_pp_size={v} "
+                f"chunks"
             )
+        if v > 1:
+            schedule = getattr(self.config.jax, "pipeline_schedule", "1f1b")
+            if schedule == "1f1b":
+                raise ValueError(
+                    "virtual_pp_size>1 requires pipeline_schedule="
+                    "'1f1b_interleaved' (or 'gpipe'); plain '1f1b' has one "
+                    "contiguous stage per rank"
+                )
         rules = mesh_lib.default_rules(
             fsdp=bool(self.config.jax.fsdp_axes), pp=pp_enabled
         )
@@ -458,10 +575,11 @@ class JaxTrainEngine(TrainEngine):
                 )
             }
             acc = jax.tree.map(
-                lambda s: jax.ShapeDtypeStruct(
-                    s.shape, grad_dtype, sharding=s.sharding
+                lambda s, sh: jax.ShapeDtypeStruct(
+                    s.shape, grad_dtype, sharding=sh
                 ),
                 self._trainable_sub(abstract),
+                self._grad_shardings(),
             )
             weight = jax.ShapeDtypeStruct((), jnp.float32)
             grad_compiled = (
@@ -469,6 +587,36 @@ class JaxTrainEngine(TrainEngine):
             ).compile()
 
             report = {"grad_step": _memory_analysis_dict(grad_compiled)}
+            if self._pp_size > 1:
+                # The schedule actually used at pp>1 (gpipe / 1f1b /
+                # interleaved) compiles too — a plan that only proves the
+                # plain grad step would miss stash-layout or hybrid-mesh
+                # failures in the pipelined program.
+                n_mb = 2 * self._pp_size
+                stacked_sh = jax.sharding.NamedSharding(
+                    self.mesh,
+                    jax.sharding.PartitionSpec(
+                        None, (mesh_lib.AXIS_DP, mesh_lib.AXIS_SP)
+                    ),
+                )
+                stacked = {
+                    k: jax.ShapeDtypeStruct(
+                        (n_mb, mb_tokens), jnp.int32, sharding=stacked_sh
+                    )
+                    for k in (
+                        "input_ids",
+                        "position_ids",
+                        "segment_ids",
+                        "loss_mask",
+                    )
+                }
+                weights = jax.ShapeDtypeStruct((n_mb,), jnp.float32)
+                pp_compiled = (
+                    self._get_pipelined_grad_step(loss_fn).lower(
+                        abstract, stacked, weights
+                    )
+                ).compile()
+                report["pipelined_step"] = _memory_analysis_dict(pp_compiled)
             if self.optimizer is not None:
                 opt_abstract = jax.eval_shape(
                     self.optimizer.init, self._trainable_sub(abstract)
@@ -517,44 +665,52 @@ class JaxTrainEngine(TrainEngine):
 
     def _export_params(self):
         """Params for save/push: lora deltas folded into the base kernels
-        (consumers — HF export, decode engines — serve plain kernels)."""
+        (consumers — HF export, decode engines — serve plain kernels) and
+        layers restored to model order (consumers never see the engine's
+        interleaved at-rest layout)."""
         if self._lora:
-            return merge_lora(self.params, self.model_config)
-        return self.params
+            return self._to_model_layout(
+                merge_lora(self.params, self.model_config)
+            )
+        return self._to_model_layout(self.params)
+
+    @property
+    def _zero1(self) -> bool:
+        """ZeRO-1 active: dp-shard moments + the optimizer update."""
+        return (
+            bool(getattr(self.config.jax, "zero1_optimizer", False))
+            and self.mesh is not None
+            and self.mesh.shape.get(mesh_lib.AXIS_DP, 1) > 1
+        )
 
     def _opt_state_shardings(self):
-        """Shard optimizer moments exactly like their parameters.
-
-        optax states embed *copies of the param tree* (ScaleByAdamState.mu/nu
-        etc.), so every moment leaf's key path ends with the key path of the
-        param it mirrors. Matching on that path suffix is exact — unlike
-        shape matching, two distinct params with equal shapes (e.g. gate and
-        up projections) can never swap shardings. Leaves whose path matches
-        no param (step counters) are replicated.
-        """
+        """Cached wrapper around the module-level `opt_state_sharding`
+        builder (the single source for moment shardings — initialize,
+        apply_update, orbax restore and the plan check all resolve here, so
+        none can drift into silently re-replicated moments)."""
         if self._opt_shardings is not None:
             return self._opt_shardings
-        shape = jax.eval_shape(
-            self.optimizer.init, self._trainable_sub(self.params)
+        self._opt_shardings = opt_state_sharding(
+            self.optimizer,
+            self._trainable_sub(self.params),
+            self._trainable_sub(self._param_shardings),
+            self.mesh,
+            zero1=self._zero1,
         )
-        param_paths = {
-            tuple(str(k) for k in path): shard
-            for path, shard in jax.tree_util.tree_leaves_with_path(
-                self._trainable_sub(self._param_shardings)
-            )
-        }
-        replicated = mesh_lib.replicated(self.mesh)
-
-        def assign(path, leaf):
-            keys = tuple(str(k) for k in path)
-            for i in range(len(keys)):
-                hit = param_paths.get(keys[i:])
-                if hit is not None:
-                    return hit
-            return replicated
-
-        self._opt_shardings = jax.tree_util.tree_map_with_path(assign, shape)
         return self._opt_shardings
+
+    def _grad_shardings(self):
+        """Output shardings for optimizer-ready gradients: the param
+        shardings, dp-extended under ZeRO-1 so the backward's grad psum
+        fuses into a reduce-scatter and the update consumes 1/dp per rank."""
+        param_sh = self._trainable_sub(self._param_shardings)
+        if not self._zero1:
+            return param_sh
+        return jax.tree.map(
+            lambda s, p: zero1_extend_sharding(s, p.shape, self.mesh),
+            param_sh,
+            self._trainable_sub(self.params),
+        )
 
     def destroy(self):
         self.params = None
@@ -678,6 +834,7 @@ class JaxTrainEngine(TrainEngine):
             host_params["lora"] = init_lora_params(
                 self.model_config, jax.random.PRNGKey(2)
             )
+        host_params = self._to_engine_layout(host_params)
         self.params = jax.tree.map(
             lambda x, s: jax.device_put(jnp.asarray(x), s),
             host_params,
@@ -724,7 +881,12 @@ class JaxTrainEngine(TrainEngine):
         if jax.process_index() == 0:
             with open(os.path.join(path, "train_meta.json"), "w") as f:
                 _json.dump(
-                    dict(step_count=self._step_count, version=self._version), f
+                    dict(
+                        step_count=self._step_count,
+                        version=self._version,
+                        layer_layout=self._layer_layout_tag(),
+                    ),
+                    f,
                 )
 
     def _orbax_restore(
@@ -733,6 +895,20 @@ class JaxTrainEngine(TrainEngine):
         import json as _json
 
         ckptr = self._checkpointer()
+        meta_path = os.path.join(path, "train_meta.json")
+        if with_params and os.path.exists(meta_path):
+            with open(meta_path) as f:
+                stored = _json.load(f).get("layer_layout", "model")
+            if stored != self._layer_layout_tag():
+                # orbax trees are restored positionally — loading a
+                # model-order checkpoint into an interleaved engine (or
+                # vice versa, or a different pp×v) would silently scramble
+                # the layer stack
+                raise ValueError(
+                    f"checkpoint layer layout {stored!r} does not match the "
+                    f"engine's {self._layer_layout_tag()!r} (pipeline_"
+                    f"schedule/virtual_pp_size changed since the save?)"
+                )
         state = self._ckpt_state(with_params, with_optim)
         shardings = {}
         if with_params:
@@ -843,7 +1019,11 @@ class JaxTrainEngine(TrainEngine):
             )
         delta = self._lora and getattr(self.config, "weight_sync_delta", True)
         if delta:
-            casted = self._push_cast_fn({"lora": self.params["lora"]})
+            # adapters go on the wire in MODEL layer order — decode servers
+            # fold base + scale·A@B by model layer index
+            casted = self._push_cast_fn(
+                self._to_model_layout({"lora": self.params["lora"]})
+            )
             lora_scale = self.model_config.lora_alpha / max(
                 self.model_config.lora_rank, 1
             )
@@ -972,6 +1152,66 @@ class JaxTrainEngine(TrainEngine):
     def _pp_size(self) -> int:
         return self.mesh.shape.get(mesh_lib.AXIS_PP, 1) if self.mesh else 1
 
+    @property
+    def _virtual_pp(self) -> int:
+        return max(int(getattr(self.config.jax, "virtual_pp_size", 1) or 1), 1)
+
+    def _layer_perm(self) -> list[int] | None:
+        """Chunk-major interleaved storage permutation for the scanned layer
+        stack, or None when the engine stores layers in model order (no
+        virtual stages). With v>1 the engine keeps `layers` (and `lora`)
+        PERMUTED at rest so the schedule's [L]→[pp,v,Lc] reshape is pure
+        metadata — the same permute-at-entry pattern as cp_zigzag."""
+        v = self._virtual_pp
+        if v <= 1 or self._pp_size <= 1:
+            return None
+        from areal_tpu.parallel.pipeline import interleave_layer_indices
+
+        return interleave_layer_indices(
+            self.model_config.num_hidden_layers, self._pp_size, v
+        )
+
+    def _layer_layout_tag(self) -> str:
+        """Checkpoint guard string for the at-rest layer order."""
+        if self._layer_perm() is None:
+            return "model"
+        return f"interleaved-pp{self._pp_size}-v{self._virtual_pp}"
+
+    def _to_engine_layout(self, host_params):
+        """Model layer order → the engine's at-rest (chunk-major) order;
+        identity when no interleaving is active."""
+        perm = self._layer_perm()
+        if perm is None:
+            return host_params
+        idx = np.asarray(perm)
+        out = dict(host_params)
+        for k in ("layers", "lora"):
+            if k in out:
+                out[k] = jax.tree.map(lambda x: x[idx], out[k])
+        return out
+
+    def _to_model_layout(self, params):
+        """Engine at-rest order → model layer order (export/save/push)."""
+        perm = self._layer_perm()
+        if perm is None:
+            return params
+        from areal_tpu.parallel.pipeline import (
+            inverse_interleave_layer_indices,
+        )
+
+        inv = jnp.asarray(
+            inverse_interleave_layer_indices(
+                self.model_config.num_hidden_layers,
+                self._pp_size,
+                self._virtual_pp,
+            )
+        )
+        out = dict(params)
+        for k in ("layers", "lora"):
+            if k in out:
+                out[k] = jax.tree.map(lambda x: jnp.take(x, inv, axis=0), out[k])
+        return out
+
     def _stack_mbs(self, mbs: list[dict[str, Any]]) -> dict[str, jax.Array]:
         """Pad every packed micro-batch to a common bucket and stack into
         [M, T] device arrays — the microbatch stream of the pipeline.
@@ -1033,9 +1273,13 @@ class JaxTrainEngine(TrainEngine):
           the live activation stash is capped at 2·pp-1 stage inputs
           instead of growing with M; bigger M (smaller bubble) fits in
           fixed HBM.
+        - "1f1b_interleaved": same memory discipline, but each rank runs
+          `virtual_pp_size` non-contiguous virtual stages
+          (pipeline_1f1b_interleaved_grads) — bubble shrinks ~1/v, stash
+          bound v·(2·pp-1); grads bitwise-equal to "1f1b".
         - "gpipe": the all-forward-then-all-backward reference path
           (autodiff through the trunk scan); numerically the oracle the
-          1f1b path is tested against.
+          1f1b paths are tested against.
         """
         schedule = getattr(self.config.jax, "pipeline_schedule", "1f1b")
         from areal_tpu.parallel.pipeline import PIPELINE_SCHEDULES
@@ -1045,14 +1289,20 @@ class JaxTrainEngine(TrainEngine):
                 f"jax.pipeline_schedule={schedule!r} not in "
                 f"{PIPELINE_SCHEDULES}"
             )
-        key = ("pp", schedule, id(loss_fn))
+        virtual = self._virtual_pp
+        if virtual > 1 and schedule == "1f1b":
+            raise ValueError(
+                "virtual_pp_size>1 requires pipeline_schedule="
+                "'1f1b_interleaved' (or 'gpipe')"
+            )
+        key = ("pp", schedule, virtual, id(loss_fn))
         if key in self._grad_step_cache:
             return self._grad_step_cache[key]
         from areal_tpu.models.qwen2 import forward_pipelined
 
         model_cfg = self.model_config
         mesh = self.mesh
-        param_sh = self._trainable_sub(self._param_shardings)
+        grad_sh = self._grad_shardings()
         use_aux = bool(
             model_cfg.num_experts and model_cfg.router_aux_loss_coef > 0
         )
@@ -1061,13 +1311,15 @@ class JaxTrainEngine(TrainEngine):
         aux_mode = self._returns_aux(loss_fn)
         lora_mode = self._lora
 
-        if schedule == "1f1b":
+        if schedule in ("1f1b", "1f1b_interleaved"):
             from areal_tpu.models.qwen2 import forward_pipelined_grads
 
             if aux_mode:
                 per_mb = lambda out, mb: loss_fn(out, mb)  # noqa: E731
             else:
                 per_mb = lambda out, mb: (loss_fn(out, mb), {})  # noqa: E731
+
+            vpp = virtual if schedule == "1f1b_interleaved" else 1
 
             def pip_1f1b_step(params, stacked, weights):
                 if lora_mode:
@@ -1088,8 +1340,9 @@ class JaxTrainEngine(TrainEngine):
                     weights,
                     head_mode="hidden" if hidden_mode else "logits",
                     lora_mode=lora_mode,
+                    virtual_pp=vpp,
                 )
-                grads = jax.lax.with_sharding_constraint(grads, param_sh)
+                grads = jax.lax.with_sharding_constraint(grads, grad_sh)
                 return losses, stats, grads
 
             fn = jax.jit(
@@ -1097,7 +1350,7 @@ class JaxTrainEngine(TrainEngine):
                 out_shardings=(
                     mesh_lib.replicated(self.mesh),
                     mesh_lib.replicated(self.mesh),
-                    param_sh,
+                    grad_sh,
                 ),
             )
             self._grad_step_cache[key] = fn
@@ -1124,6 +1377,7 @@ class JaxTrainEngine(TrainEngine):
                 mb_data=stacked,
                 with_aux=use_aux,
                 head_mode="hidden" if hidden_mode else "logits",
+                virtual_pp=virtual,
             )
             per_mb, aux = out if use_aux else (out, jnp.float32(0.0))
             if aux_mode:
@@ -1146,7 +1400,7 @@ class JaxTrainEngine(TrainEngine):
             (_, (losses, stats)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(trainable, frozen, stacked, weights)
-            grads = jax.lax.with_sharding_constraint(grads, param_sh)
+            grads = jax.lax.with_sharding_constraint(grads, grad_sh)
             return losses, stats, grads
 
         fn = jax.jit(
@@ -1154,7 +1408,7 @@ class JaxTrainEngine(TrainEngine):
             out_shardings=(
                 mesh_lib.replicated(self.mesh),
                 mesh_lib.replicated(self.mesh),
-                param_sh,
+                grad_sh,
             ),
         )
         self._grad_step_cache[key] = fn
@@ -1175,6 +1429,10 @@ class JaxTrainEngine(TrainEngine):
             params = (
                 {**frozen, "lora": trainable} if lora_mode else trainable
             )
+            # engine-layout (interleaved) layer storage → model order for
+            # the plain forward; differentiating through the gather puts
+            # the grads back into engine layout automatically
+            params = self._to_model_layout(params)
             with_aux = bool(
                 model_cfg.num_experts and model_cfg.router_aux_loss_coef > 0
             )
@@ -1196,7 +1454,7 @@ class JaxTrainEngine(TrainEngine):
                 loss = loss + model_cfg.router_aux_loss_coef * aux
             return loss, stats
 
-        param_sh = self._trainable_sub(self._param_shardings)
+        grad_sh = self._grad_shardings()
 
         def grad_step(params, acc, weight, mb):
             if lora_mode:
@@ -1213,7 +1471,7 @@ class JaxTrainEngine(TrainEngine):
             # left free, XLA may lay the backward's psum outputs out
             # differently from the donated accumulator and fall back to
             # "involuntary full rematerialization" reshards on every step.
-            grads = jax.lax.with_sharding_constraint(grads, param_sh)
+            grads = jax.lax.with_sharding_constraint(grads, grad_sh)
             acc = jax.tree.map(
                 lambda a, g: a + g.astype(grad_dtype) * weight, acc, grads
             )
@@ -1225,7 +1483,7 @@ class JaxTrainEngine(TrainEngine):
             out_shardings=(
                 mesh_lib.replicated(self.mesh),
                 mesh_lib.replicated(self.mesh),
-                param_sh,
+                grad_sh,
             ),
         )
         self._grad_step_cache[key] = fn
@@ -1275,7 +1533,7 @@ class JaxTrainEngine(TrainEngine):
                 lambda p: jax.tree.map(
                     lambda x: jnp.zeros(x.shape, grad_dtype), p
                 ),
-                out_shardings=self._trainable_sub(self._param_shardings),
+                out_shardings=self._grad_shardings(),
             )
         return self._zero_grads_fn(self._trainable_sub(self.params))
 
@@ -1428,6 +1686,7 @@ class JaxTrainEngine(TrainEngine):
             aux_mode = self._returns_aux(loss_fn)
 
             def eval_step(params, mb):
+                params = self._to_model_layout(params)
                 x = model_forward(
                     params,
                     mb["input_ids"],
@@ -1499,6 +1758,7 @@ class JaxTrainEngine(TrainEngine):
                         per_mb_fn=per_mb_fn,
                         mb_data=stacked,
                         head_mode="hidden" if hidden_mode else "logits",
+                        virtual_pp=self._virtual_pp,
                     )
 
                 self._fwd_cache[key] = jax.jit(fwd_pp)
@@ -1524,6 +1784,7 @@ class JaxTrainEngine(TrainEngine):
             hidden_mode = self._wants_hidden(post_hook)
 
             def fwd_step(params, mb):
+                params = self._to_model_layout(params)
                 x = model_forward(
                     params,
                     mb["input_ids"],

@@ -1437,7 +1437,16 @@ def _pp_embed(params: dict, input_ids: jax.Array, position_ids: jax.Array,
 
 def _pp_stage_fn(cfg: ModelConfig):
     """One pipeline stage: a scan over the stage-local [L/pp, ...] layers.
-    aux_t = (position_ids, segment_ids) for the stage's current microbatch."""
+    aux_t = (position_ids, segment_ids) for the stage's current microbatch.
+
+    Bitwise note: `1f1b_interleaved` promises grads bitwise-equal to
+    `1f1b`, which makes a v>1 virtual chunk (a trip-count-1 layer scan
+    that XLA inlines and fuses into the schedule) run the SAME per-layer
+    backward as a longer scan (an isolated loop body). That holds only
+    under `cfg.remat`: jax.checkpoint makes each layer's backward a
+    self-contained recompute region that XLA compiles identically in
+    either fusion context. Without remat the granularities drift at the
+    last bit (~1e-7) and the schedules are merely allclose."""
     layer_fn = _maybe_remat(decoder_layer, cfg)
 
     def stage_fn(layers_local, h, aux_t):
@@ -1493,6 +1502,7 @@ def forward_pipelined(
     *,
     with_aux: bool = False,
     head_mode: str = "logits",
+    virtual_pp: int = 1,
 ):
     """Pipelined packed forward over M stacked microbatches (GPipe trunk).
 
@@ -1531,6 +1541,7 @@ def forward_pipelined(
             combine_layers_with_lora(params, cfg),
             x,
             (position_ids, segment_ids),
+            virtual=virtual_pp,
         )
 
     def head_scan(_, inp):
@@ -1557,6 +1568,7 @@ def forward_pipelined_grads(
     *,
     head_mode: str = "logits",
     lora_mode: bool = False,
+    virtual_pp: int = 1,
 ):
     """Pipelined loss AND gradients under the 1F1B schedule.
 
@@ -1579,7 +1591,10 @@ def forward_pipelined_grads(
     `grads` shaped like `trainable`.
     """
     from areal_tpu.parallel import mesh as mesh_lib
-    from areal_tpu.parallel.pipeline import pipeline_1f1b_grads
+    from areal_tpu.parallel.pipeline import (
+        pipeline_1f1b_grads,
+        pipeline_1f1b_interleaved_grads,
+    )
 
     assert cfg.scan_layers, "pipeline parallelism requires scan_layers=True"
 
@@ -1615,19 +1630,41 @@ def forward_pipelined_grads(
         if (cfg.num_experts and cfg.router_aux_loss_coef > 0)
         else 0.0
     )
+    # With virtual_pp > 1 the stacked layers (and their grads) are in the
+    # engine's chunk-major interleaved storage layout; layers_vjp composes
+    # on the same layout, so nothing here needs to know the permutation.
     with mesh_lib.mesh_scope(None):
-        losses, stats, aux_total, g_layers, g_head, g_xs = pipeline_1f1b_grads(
-            mesh,
-            _pp_stage_fn(cfg),
-            head_loss,
-            layers,
-            head_params,
-            xs,
-            (position_ids, segment_ids),
-            mb_data,
-            weights,
-            aux_coef=aux_coef,
-        )
+        if virtual_pp > 1:
+            losses, stats, aux_total, g_layers, g_head, g_xs = (
+                pipeline_1f1b_interleaved_grads(
+                    mesh,
+                    _pp_stage_fn(cfg),
+                    head_loss,
+                    layers,
+                    head_params,
+                    xs,
+                    (position_ids, segment_ids),
+                    mb_data,
+                    weights,
+                    virtual=virtual_pp,
+                    aux_coef=aux_coef,
+                )
+            )
+        else:
+            losses, stats, aux_total, g_layers, g_head, g_xs = (
+                pipeline_1f1b_grads(
+                    mesh,
+                    _pp_stage_fn(cfg),
+                    head_loss,
+                    layers,
+                    head_params,
+                    xs,
+                    (position_ids, segment_ids),
+                    mb_data,
+                    weights,
+                    aux_coef=aux_coef,
+                )
+            )
 
     grads = jax.tree.map(
         lambda a, b, c: a + b + c,

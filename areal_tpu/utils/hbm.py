@@ -16,6 +16,14 @@ Per chip, a training step holds
                 T_local x (3d + 2ff/tp + 2*nH*hd/tp) x act_bytes
   logits        fused vocab-chunked head: T_local x chunk x 4;
                 unfused: T_local x V x 4  (f32 logits)
+  pp stash      1f1b keeps (2*pp-1) stage inputs alive between a
+                microbatch's forward and backward; the interleaved
+                schedule v*(2*pp-1) virtual-chunk inputs — each entry
+                T_local x d x act_bytes
+
+With ZeRO-1 (`zero1=True`, `fsdp=False`) the f32 AdamW moments divide by
+dp even though params/grads replicate; the per-chip bytes that sharding
+frees are surfaced as `opt_freed_bytes` / `zero1_freed_gib`.
 
 where T_local = per-chip microbatch tokens (dp and sp shard the token
 axis; pp processes one microbatch per stage at a time). Without remat the
@@ -118,6 +126,14 @@ class HBMEstimate:
     activation_bytes: int
     logits_bytes: int
     kv_bytes: int = 0
+    # pipeline stash: the 1f1b schedules keep stage (or virtual-chunk)
+    # inputs alive between forward and backward — 2*pp-1 entries for plain
+    # 1f1b, v*(2*pp-1) for the interleaved schedule
+    stash_bytes: int = 0
+    # informational: bytes the ZeRO-1 dp-sharded optimizer update freed
+    # per chip vs a dp-replicated opt state (already subtracted from
+    # opt_bytes; NOT part of total_bytes)
+    opt_freed_bytes: int = 0
 
     @property
     def total_bytes(self) -> int:
@@ -128,18 +144,23 @@ class HBMEstimate:
             + self.activation_bytes
             + self.logits_bytes
             + self.kv_bytes
+            + self.stash_bytes
         )
 
     def breakdown(self) -> dict:
-        return {
+        out = {
             "params_gib": round(self.params_bytes / GiB, 3),
             "grads_gib": round(self.grads_bytes / GiB, 3),
             "opt_gib": round(self.opt_bytes / GiB, 3),
             "activations_gib": round(self.activation_bytes / GiB, 3),
             "logits_gib": round(self.logits_bytes / GiB, 3),
             "kv_gib": round(self.kv_bytes / GiB, 3),
+            "stash_gib": round(self.stash_bytes / GiB, 3),
             "total_gib": round(self.total_bytes / GiB, 3),
         }
+        if self.opt_freed_bytes:
+            out["zero1_freed_gib"] = round(self.opt_freed_bytes / GiB, 3)
+        return out
 
 
 def estimate_train_hbm(
@@ -154,16 +175,35 @@ def estimate_train_hbm(
     fused_lm_head: bool = True,
     vocab_chunk: int = 8192,
     optimizer: str = "adamw",
+    fsdp: bool = True,
+    zero1: bool = False,
+    pipeline_schedule: str = "1f1b",
+    virtual_pp: int = 1,
 ) -> HBMEstimate:
     """Per-chip peak HBM for one training step of the GSPMD engine.
 
     `microbatch_tokens` is the GLOBAL token count of one microbatch (the
     unit `train_batch` runs per dispatch); dp and sp shard it.
+
+    Sharding regimes: `fsdp=True` dp-shards params, grads AND opt state
+    (the ZeRO-3-ish default the estimator has always priced). With
+    `fsdp=False`, params/grads replicate over dp; `zero1=True` then still
+    dp-shards the f32 AdamW moments (jax.zero1_optimizer's reduce-scatter
+    / sharded-update / all-gather step) — `opt_freed_bytes` records what
+    that sharding saved per chip vs a replicated opt state.
+
+    Pipelining: for pp>1 the 1f1b schedules stash stage inputs between a
+    microbatch's forward and its backward — 2*pp-1 entries for "1f1b",
+    `virtual_pp`*(2*pp-1) *chunk* inputs for "1f1b_interleaved" (each 1/v
+    the layers but a full [T_local, d] activation, so the stash bytes grow
+    ~v times while the bubble shrinks ~1/v: that trade is exactly what
+    bench --mode ppsched measures).
     """
     n = param_count(model_cfg)
     pbytes = _dtype_bytes(getattr(model_cfg, "param_dtype", "float32"))
     abytes = _dtype_bytes(getattr(model_cfg, "dtype", "bfloat16"))
-    shard = dp * tp * pp
+    shard = (dp if fsdp else 1) * tp * pp
+    opt_shard = (dp if (fsdp or zero1) else 1) * tp * pp
     d = model_cfg.hidden_size
     nH = model_cfg.num_attention_heads
     hd = d // nH
@@ -186,13 +226,23 @@ def estimate_train_hbm(
         logits = t_local * min(vocab_chunk, model_cfg.vocab_size) * 4
     else:
         logits = t_local * model_cfg.vocab_size * 4
+    stash = 0
+    if pp > 1 and pipeline_schedule in ("1f1b", "1f1b_interleaved"):
+        v = virtual_pp if pipeline_schedule == "1f1b_interleaved" else 1
+        stash = v * (2 * pp - 1) * t_local * d * abytes
     opt_mult = 2 if optimizer == "adamw" else 0  # f32 mu + nu
+    opt = opt_mult * n * 4 // opt_shard
+    opt_freed = 0
+    if zero1 and not fsdp and dp > 1:
+        opt_freed = opt_mult * n * 4 // (tp * pp) - opt
     return HBMEstimate(
         params_bytes=n * pbytes // shard,
         grads_bytes=n * pbytes // shard,
-        opt_bytes=opt_mult * n * 4 // shard,
+        opt_bytes=opt,
         activation_bytes=act,
         logits_bytes=logits,
+        stash_bytes=stash,
+        opt_freed_bytes=opt_freed,
     )
 
 

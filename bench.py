@@ -3218,12 +3218,97 @@ def bench_weightsync(model, n_pushes, chunk_mb, prompt_len, new_tokens):
     )
 
 
+def _pp_bubble_sim(pp, v, n_mbs, t_f, t_b, schedule="1f1b"):
+    """Event-driven earliest-start execution of a pipeline timetable on pp
+    independent ranks — the MPMD rendering the hybrid ICI/DCN mesh deploys
+    (each slice runs its own stage stream; only activation/cotangent hops
+    cross the DCN boundary). Jobs run in the schedule's per-rank order but
+    start as soon as their cross-rank dependencies land, so the returned
+    idle fraction is the timetable's intrinsic bubble. The lockstep SPMD
+    scan that renders the same timetable inside ONE slice pads every round
+    to the global round clock (its wall time is reported separately as
+    `pp_*_step_s`); the simulated bubble is what the interleaving buys on
+    the multi-slice deployment: ~(pp-1)/(v*M + pp-1) vs (pp-1)/(M + pp-1).
+
+    t_f / t_b are per-CHUNK forward/backward costs (a chunk is 1/v of a
+    rank's layers); the returned fraction is scale-invariant in them.
+    """
+    C = pp * v
+    delta = C - 1
+    rounds = (
+        delta
+        + ((n_mbs - 1) // pp) * C
+        + (v - 1) * pp
+        + (n_mbs - 1) % pp
+        + pp
+    )
+    free = [0.0] * pp
+    done_f: dict = {}
+    done_b: dict = {}
+
+    def run_f(s, m, vc):
+        c = vc * pp + s
+        dep = done_f[(m, c - 1)] if c else 0.0
+        end = max(free[s], dep) + t_f
+        free[s] = done_f[(m, c)] = end
+
+    def run_b(s, m, vc, barrier=0.0):
+        c = vc * pp + s
+        dep = done_b[(m, c + 1)] if c < C - 1 else done_f[(m, C - 1)]
+        end = max(free[s], dep, done_f[(m, c)], barrier) + t_b
+        free[s] = done_b[(m, c)] = end
+
+    if schedule == "gpipe":
+        # all forwards in microbatch order, then all backwards in reverse
+        # microbatch order, after a global barrier (the autodiff of the
+        # round scan replays residuals only once every forward is done)
+        for r in range(n_mbs + C - 1):
+            for s in range(pp):
+                for vc in range(v):
+                    n = r - (vc * pp + s)
+                    if 0 <= n < n_mbs:
+                        run_f(s, n, vc)
+        barrier = max(done_f.values())
+        for r in range(n_mbs + C - 1):
+            for s in reversed(range(pp)):
+                for vc in reversed(range(v)):
+                    n = r - ((C - 1) - (vc * pp + s))
+                    if 0 <= n < n_mbs:
+                        run_b(s, n_mbs - 1 - n, vc, barrier)
+    else:  # the (interleaved) 1F1B timetable, same n-counter decode as
+        # parallel/pipeline.py's round scan
+        for r in range(rounds):
+            for s in range(pp):
+                n = r - s
+                if n >= 0:
+                    m = (n // C) * pp + n % pp
+                    if m < n_mbs:
+                        run_f(s, m, (n // pp) % v)
+            for s in range(pp):
+                nb = r - delta - (pp - 1 - s)
+                if nb >= 0:
+                    m = (nb // C) * pp + nb % pp
+                    if m < n_mbs:
+                        run_b(s, m, v - 1 - ((nb // pp) % v))
+    makespan = max(done_b.values())
+    busy = n_mbs * C * (t_f + t_b)
+    return 1.0 - busy / (pp * makespan)
+
+
 def bench_pp_schedules(model, pp, n_mbs, seq_len, warmup, iters):
     """Pipeline-schedule micro-bench: the SAME stacked micro-batch stream
-    through the pp>1 trunk under "gpipe" vs "1f1b", reporting per-step wall
-    time and the compiled program's temp (activation) memory — the stash
-    delta the 1F1B schedule exists for (gpipe residuals grow with M; 1f1b
-    is capped at 2·pp-1 stage inputs)."""
+    through the pp>1 trunk under "gpipe" vs "1f1b" vs "1f1b_interleaved"
+    (v=1 and v=2), reporting per-leg wall time, the compiled program's
+    temp (activation) memory, and the timetable's bubble fraction
+    (`_pp_bubble_sim` with the leg's measured per-chunk cost). Two deltas
+    matter: gpipe-vs-1f1b is the stash bound (gpipe residuals grow with M;
+    1f1b is capped at 2·pp-1 stage inputs), and v=2-vs-v=1 is the
+    interleaving trade — bubble shrinks ~1/v AND the per-round backward
+    touches half the layers, so the transient vjp residual footprint
+    (the temp-memory term that dominates past a few layers per stage)
+    drops even as the stash grows to v·(2·pp-1) chunk inputs."""
+    import dataclasses as _dc
+
     import jax
     import jax.numpy as jnp
 
@@ -3244,24 +3329,14 @@ def bench_pp_schedules(model, pp, n_mbs, seq_len, warmup, iters):
     if ndev < pp or ndev % pp:
         return {"ppsched_skipped": f"{ndev} devices incompatible with pp={pp}"}
 
-    cfg = TrainEngineConfig(
-        experiment_name="bench",
-        trial_name="ppsched",
-        path="",
-        init_from_scratch=True,
-        dtype=model.dtype,
-        mb_spec=MicroBatchSpec(max_tokens_per_mb=seq_len),
-        optimizer=OptimizerConfig(lr=1e-4),
-        gradient_checkpointing=model.remat,
-    )
-    eng = JaxLMEngine(cfg)
-    eng.model_config = model
-    eng.create_process_group(
-        ParallelStrategy(
-            pipeline_parallel_size=pp, data_parallel_size=ndev // pp
-        )
-    )
-    eng.initialize(None, FinetuneSpec(1, 1000, 1))
+    # every leg needs L divisible by pp*v (v up to 2) and enough depth per
+    # virtual chunk that the residual-vs-stash trade is visible
+    v_max = 2
+    L = model.num_hidden_layers
+    if L < 2 * pp * v_max or L % (pp * v_max):
+        L = max(L, 2 * pp * v_max)
+        L += -L % (pp * v_max)
+        model = _dc.replace(model, num_hidden_layers=L)
 
     rng = np.random.RandomState(0)
     stacked = {
@@ -3278,8 +3353,35 @@ def bench_pp_schedules(model, pp, n_mbs, seq_len, warmup, iters):
     weights = jnp.ones((n_mbs,), jnp.float32)
 
     out = {"pp_size": pp, "pp_n_mbs": n_mbs, "pp_seq_len": seq_len}
-    for sched in ("gpipe", "1f1b"):
-        eng.config.jax.pipeline_schedule = sched
+    legs = (
+        ("gpipe", "gpipe", 1),
+        ("1f1b", "1f1b", 1),
+        ("1f1b_interleaved_v1", "1f1b_interleaved", 1),
+        ("1f1b_interleaved_v2", "1f1b_interleaved", 2),
+    )
+    for tag, sched, virt in legs:
+        cfg = TrainEngineConfig(
+            experiment_name="bench",
+            trial_name="ppsched",
+            path="",
+            init_from_scratch=True,
+            dtype=model.dtype,
+            mb_spec=MicroBatchSpec(max_tokens_per_mb=seq_len),
+            optimizer=OptimizerConfig(lr=1e-4),
+            gradient_checkpointing=model.remat,
+        )
+        cfg.jax.pipeline_schedule = sched
+        cfg.jax.virtual_pp_size = virt
+        # the interleaved engine stores layers chunk-major, so each leg
+        # gets a fresh engine (params re-initialized in its own layout)
+        eng = JaxLMEngine(cfg)
+        eng.model_config = model
+        eng.create_process_group(
+            ParallelStrategy(
+                pipeline_parallel_size=pp, data_parallel_size=ndev // pp
+            )
+        )
+        eng.initialize(None, FinetuneSpec(1, 1000, 1))
         fn = eng._get_pipelined_grad_step(compute_packed_sft_loss)
         compiled = fn.lower(eng.params, stacked, weights).compile()
         mem = _memory_analysis_dict(compiled)
@@ -3288,13 +3390,25 @@ def bench_pp_schedules(model, pp, n_mbs, seq_len, warmup, iters):
         t0 = time.perf_counter()
         for _ in range(iters):
             jax.block_until_ready(fn(eng.params, stacked, weights))
-        out[f"pp_{sched}_step_s"] = (time.perf_counter() - t0) / iters
-        out[f"pp_{sched}_temp_bytes"] = mem.get("temp_size_in_bytes", 0)
-    eng.destroy()
+        step_s = (time.perf_counter() - t0) / iters
+        eng.destroy()
+        out[f"pp_{tag}_step_s"] = step_s
+        out[f"pp_{tag}_temp_bytes"] = mem.get("temp_size_in_bytes", 0)
+        t_chunk = step_s / (2 * n_mbs * virt)  # measured per-chunk cost
+        out[f"pp_{tag}_bubble_frac"] = _pp_bubble_sim(
+            pp, virt, n_mbs, t_chunk, t_chunk, schedule=sched
+        )
     if out.get("pp_gpipe_temp_bytes"):
         out["pp_temp_ratio_gpipe_over_1f1b"] = out["pp_gpipe_temp_bytes"] / max(
             out["pp_1f1b_temp_bytes"], 1
         )
+    v1, v2 = "pp_1f1b_interleaved_v1", "pp_1f1b_interleaved_v2"
+    out["pp_bubble_ratio_v1_over_v2"] = out[f"{v1}_bubble_frac"] / max(
+        out[f"{v2}_bubble_frac"], 1e-9
+    )
+    out["pp_temp_ratio_v1_over_v2"] = out[f"{v1}_temp_bytes"] / max(
+        out[f"{v2}_temp_bytes"], 1
+    )
     return out
 
 
@@ -4045,7 +4159,7 @@ MODE_HEADLINES = {
     "pagedattn": ("paged_over_ws_speedup", "x"),
     "prefix": ("prefix_share_speedup", "x"),
     "grpo": ("grpo_samples_per_sec_per_chip", "samples/s/chip"),
-    "ppsched": ("pp_temp_ratio_gpipe_over_1f1b", "x"),
+    "ppsched": ("pp_bubble_ratio_v1_over_v2", "x"),
     "weightsync": ("weightsync_commit_pause_s", "s"),
     "specdecode": ("spec_over_off_speedup", "x"),
     "kvoffload": ("kvoffload_resume_ttft_speedup", "x"),

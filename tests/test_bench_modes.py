@@ -149,3 +149,35 @@ def test_argparse_choices_accept_every_mode():
     p.add_argument("--mode", choices=list(bench.BENCH_MODES))
     for m in bench.BENCH_MODES:
         assert p.parse_args(["--mode", m]).mode == m
+
+
+def test_ppsched_mode_is_pinned():
+    """ISSUE 15: the pipeline-schedule bench must stay reachable as
+    `--mode ppsched` with the interleaved-vs-1f1b legs. The headline is
+    the v1/v2 bubble ratio — the whole point of virtual stages."""
+    bench = _load_bench()
+    assert "ppsched" in bench.BENCH_MODE_FNS
+    assert bench.BENCH_MODE_FNS["ppsched"] is bench.bench_pp_schedules
+    assert bench.MODE_HEADLINES["ppsched"] == (
+        "pp_bubble_ratio_v1_over_v2", "x",
+    )
+
+
+def test_ppsched_bubble_sim_interleaving_wins():
+    """The timetable simulator behind the ppsched bubble numbers must
+    reproduce the Megatron closed forms — bubble = (pp-1)/(v*M + pp-1)
+    for the interleaved 1F1B family — so v=2 strictly beats v=1 and the
+    win grows with pp. gpipe matches 1F1B on bubble (its loss is the
+    stash, which the temp-memory legs price)."""
+    bench = _load_bench()
+    sim = bench._pp_bubble_sim
+    for pp, M in ((2, 8), (4, 8), (4, 16)):
+        vals = {
+            v: sim(pp, v, M, 1.0 / v, 1.0 / v) for v in (1, 2)
+        }
+        for v in (1, 2):
+            expect = (pp - 1) / (v * M + pp - 1)
+            assert abs(vals[v] - expect) < 1e-9, (pp, v, M)
+        assert vals[2] < vals[1], (pp, M)
+    g = sim(2, 1, 8, 1.0, 1.0, schedule="gpipe")
+    assert abs(g - sim(2, 1, 8, 1.0, 1.0)) < 1e-9

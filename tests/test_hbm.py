@@ -126,6 +126,50 @@ def test_alloc_mode_check_hbm_integration():
         )
 
 
+def test_zero1_opt_state_pricing():
+    """ZeRO-1 (params replicated, moments dp-sharded) must price the opt
+    state at 1/dp of the replicated bill and surface the freed bytes."""
+    rep = hbm.estimate_train_hbm(
+        QWEN25_7B, dp=8, tp=4, microbatch_tokens=8192, fsdp=False
+    )
+    z1 = hbm.estimate_train_hbm(
+        QWEN25_7B, dp=8, tp=4, microbatch_tokens=8192, fsdp=False, zero1=True
+    )
+    # params/grads identical (still replicated over dp) ...
+    assert z1.params_bytes == rep.params_bytes
+    assert z1.grads_bytes == rep.grads_bytes
+    # ... but the f32 moments divide by dp, and the delta is reported
+    assert rep.opt_bytes == 8 * z1.opt_bytes
+    assert z1.opt_freed_bytes == rep.opt_bytes - z1.opt_bytes
+    assert "zero1_freed_gib" in z1.breakdown()
+    assert "zero1_freed_gib" not in rep.breakdown()
+    # the fsdp default (dp-sharded everything) is unchanged by the flag
+    fs = hbm.estimate_train_hbm(QWEN25_7B, dp=8, tp=4, microbatch_tokens=8192)
+    assert fs.opt_bytes == z1.opt_bytes and fs.opt_freed_bytes == 0
+
+
+def test_interleaved_stash_pricing():
+    """The 1f1b stash prices (2*pp-1) stage inputs; interleaved multiplies
+    by v: v*(2*pp-1) virtual-chunk inputs, each a full [T_local, d] slab."""
+    kw = dict(dp=2, tp=2, pp=2, microbatch_tokens=8192)
+    plain = hbm.estimate_train_hbm(QWEN25_7B, **kw)
+    inter = hbm.estimate_train_hbm(
+        QWEN25_7B, pipeline_schedule="1f1b_interleaved", virtual_pp=2, **kw
+    )
+    gpipe = hbm.estimate_train_hbm(
+        QWEN25_7B, pipeline_schedule="gpipe", **kw
+    )
+    t_local = 8192 // 2
+    entry = t_local * QWEN25_7B.hidden_size * 2  # bf16
+    assert plain.stash_bytes == 3 * entry  # 2*pp-1 = 3
+    assert inter.stash_bytes == 2 * plain.stash_bytes
+    assert gpipe.stash_bytes == 0
+    assert inter.total_bytes - plain.total_bytes == plain.stash_bytes
+    # no pipeline, no stash
+    flat = hbm.estimate_train_hbm(QWEN25_7B, dp=4, microbatch_tokens=8192)
+    assert flat.stash_bytes == 0 and "stash_gib" in flat.breakdown()
+
+
 def test_device_kind_spellings():
     """GKE-style v5e spellings must not fall through to the v5p row."""
     for kind in ("TPU v5 lite", "tpu-v5-lite-podslice", "v5litepod", "V5E"):
