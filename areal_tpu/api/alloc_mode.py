@@ -453,6 +453,7 @@ class AllocationMode:
         decode_slots: int = 64,
         decode_context: int = 32768,
         decode_pool_tokens: int | None = None,
+        decode_weight_dtype: str = "fp",
         utilization: float = 0.9,
     ) -> dict:
         """Validate that this allocation's train AND gen halves fit the
@@ -494,6 +495,7 @@ class AllocationMode:
                 slots=decode_slots,
                 context_length=decode_context,
                 pool_tokens=decode_pool_tokens,
+                weight_dtype=decode_weight_dtype,
             )
             try:
                 hbm.check_fit(est, device_kind, utilization=utilization)

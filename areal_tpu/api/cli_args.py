@@ -325,6 +325,25 @@ class JaxDecodeConfig:
     #     Drift (logprob delta, spec accept-rate shift) is measured by
     #     `bench.py --mode kvquant`, not assumed zero.
     kv_dtype: str = "fp"  # "fp" | "int8"
+    # Weight serving dtype for the dense transformer matmul kernels
+    # (models/qwen2.py q/k/v/o + dense mlp; MoE, embed, lm_head, norms,
+    # biases and LoRA adapters always stay fp):
+    #   "fp" (default): kernels stored and served in `dtype` — the
+    #     pre-quantization behavior, bit for bit, and the numerics oracle
+    #     int8 drift is measured against.
+    #   "int8": kernels stored as per-output-channel symmetric absmax
+    #     int8 + f32 scales (ops/quant.py). Quantized ONCE at the push
+    #     producer (the trainer keeps fp32 masters; engine/jax_engine.py
+    #     ships `.../q` + `.../scale` leaves over DCN, halving wire bytes
+    #     and the commit pause) or locally on full-tree installs, and
+    #     dequantized inside the fused dequant-matmul
+    #     (ops/quant_matmul.py) right after each weight tile's HBM→VMEM
+    #     DMA — decode chunks read half the weight bytes and the freed
+    #     HBM goes to the KV pool (utils/hbm.py prices it). Drift vs the
+    #     fp oracle is measured by `bench.py --mode wquant`, not assumed
+    #     zero. The LoRA delta push stays fp and requantizes the folded
+    #     kernels at install.
+    weight_dtype: str = "fp"  # "fp" | "int8"
     # Replica role in a disaggregated fleet (launcher/decode_server.py):
     #   "unified" (default): one replica does both prefill and decode.
     #   "prefill": compute-bound role — runs prompt prefills only (via

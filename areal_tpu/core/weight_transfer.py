@@ -192,6 +192,33 @@ def pack_buckets(
         yield flush()
 
 
+def raw_wire_nbytes(name: str, nbytes: int, dtype: str) -> int:
+    """bf16-equivalent wire cost of one tensor (or tensor part): what the
+    bytes WOULD have been had the push shipped fp kernels. A producer-
+    quantized kernel's `.../q` leaf replaces a bf16 tensor of the same
+    element count (2 bytes vs its 1-byte int8), and its `.../scale`
+    sibling would not exist on the fp wire at all; everything else ships
+    identically. raw/sent is the weight-sync compression ratio surfaced
+    by client get_metrics() and the servers' /metrics.weight_sync."""
+    leaf = name.rsplit("/", 1)[-1]
+    if leaf == "q" and dtype == "int8":
+        return nbytes * 2
+    if leaf == "scale" and dtype == "float32":
+        return 0
+    return nbytes
+
+
+def frame_raw_nbytes(payload: bytes) -> int:
+    """Sum raw_wire_nbytes over one framed bucket's manifest (parts of a
+    split tensor each count their own share). Assumes the frame already
+    passed unpack_bucket_parts' torn-frame checks."""
+    (mlen,) = struct.unpack_from("<Q", payload, 0)
+    manifest = json.loads(payload[8 : 8 + mlen].decode())
+    return sum(
+        raw_wire_nbytes(s["name"], s["nbytes"], s["dtype"]) for s in manifest
+    )
+
+
 def unpack_bucket_parts(payload: bytes) -> list[tuple[dict, bytes]]:
     """One frame → [(spec, raw_bytes)] — parts of possibly-split tensors.
 

@@ -416,6 +416,9 @@ class JaxDecodeEngine(InferenceEngine):
         self._k_scale = None
         self._v_scale = None
         self._kv_quant = False
+        # int8 weight serving (ISSUE 16): dense matmul kernels live as
+        # {"q","scale"} pytree leaves; False serves the fp oracle path
+        self._w_quant = False
         self._slot_lengths = None  # np [R]
         self._slots: list[_Slot | None] = []
         # Interrupted requests keep their KV parked in the slot so a resume
@@ -614,6 +617,20 @@ class JaxDecodeEngine(InferenceEngine):
             self.params = jax.tree.map(jnp.asarray, host)
             self._maybe_load_vision_tower(self.config.model_path)
         self._maybe_repeat_kv_heads()
+        from areal_tpu.models.qwen2 import WEIGHT_DTYPES, quantize_weights
+
+        if self.config.weight_dtype not in WEIGHT_DTYPES:
+            raise ValueError(
+                f"weight_dtype={self.config.weight_dtype!r} not in "
+                f"{WEIGHT_DTYPES}"
+            )
+        self._w_quant = self.config.weight_dtype == "int8"
+        if self._w_quant:
+            # quantize AFTER the kv-head repeat (per-output-channel scales
+            # commute with the repeat, but the fp tree is the canonical
+            # input) and BEFORE _build_mesh/device_put so the sharding
+            # tree is built against the quantized structure
+            self.params = quantize_weights(self.params)
         cfg = self.model_config
         if (
             cfg.pos_embed == "learned"
@@ -1132,8 +1149,23 @@ class JaxDecodeEngine(InferenceEngine):
             out = dict(attn)
             for key in ("k_kernel", "v_kernel", "k_bias", "v_bias"):
                 if key in out:
-                    # kv-head dim is axis -2 in every layout (scan or not)
-                    out[key] = jnp.repeat(jnp.asarray(out[key]), r, axis=-2)
+                    w = out[key]
+                    if isinstance(w, dict):
+                        # quantized kernel: per-output-channel quantization
+                        # commutes with the head repeat, and BOTH the int8
+                        # data and the scales carry the kv-head dim at
+                        # axis -2 ([L?, H, nKV, hd] / [L?, nKV, hd])
+                        out[key] = {
+                            "q": jnp.repeat(
+                                jnp.asarray(w["q"]), r, axis=-2
+                            ),
+                            "scale": jnp.repeat(
+                                jnp.asarray(w["scale"]), r, axis=-2
+                            ),
+                        }
+                    else:
+                        # kv-head dim is axis -2 in every layout
+                        out[key] = jnp.repeat(jnp.asarray(w), r, axis=-2)
             return out
 
         params = dict(params)
@@ -1158,8 +1190,13 @@ class JaxDecodeEngine(InferenceEngine):
             return named
         out = {}
         for path, arr in named.items():
-            leaf = path.rsplit("/", 1)[-1]
-            if leaf in ("k_kernel", "v_kernel", "k_bias", "v_bias"):
+            parts = path.rsplit("/", 2)
+            leaf = parts[-1]
+            # quantized wire names end ".../k_kernel/q" or
+            # ".../k_kernel/scale" — both the int8 data and the scales
+            # repeat along the kv-head axis (-2 in either tensor)
+            kernel = parts[-2] if leaf in ("q", "scale") and len(parts) > 1 else leaf
+            if kernel in ("k_kernel", "v_kernel", "k_bias", "v_bias"):
                 arr = np.repeat(np.asarray(arr), r, axis=-2)
             out[path] = arr
         return out
@@ -1201,6 +1238,14 @@ class JaxDecodeEngine(InferenceEngine):
                 for k, v in rules
             )
         axes = param_logical_axes(self.model_config)
+        if self._w_quant:
+            # mirror the {"q","scale"} structure so the sharding tree maps
+            # 1:1 onto the quantized params (scale keeps the kernel's
+            # output axes — the contraction axes it reduced away are
+            # exactly the ones dropped from its logical-axes tuple)
+            from areal_tpu.models.qwen2 import quantize_weight_axes
+
+            axes = quantize_weight_axes(axes)
         self._param_shardings = jax.tree.map(
             lambda a: mesh_lib.named_sharding(self.mesh, a, rules),
             axes,
@@ -3750,7 +3795,10 @@ class JaxDecodeEngine(InferenceEngine):
                 # the first quantized wave never eats a compile; skips name
                 # the dtype so an operator can tell WHICH pool variant will
                 # stall
-                kvd = f"{self.config.kv_layout}/{self.config.kv_dtype}"
+                kvd = (
+                    f"{self.config.kv_layout}/{self.config.kv_dtype}"
+                    f"/w:{self.config.weight_dtype}"
+                )
                 for b in buckets:
                     nb = -(-b // self._alloc.block_size)
                     for use_topp in classes:
@@ -4247,6 +4295,13 @@ class JaxDecodeEngine(InferenceEngine):
                 # layout onto the decode mesh's layout. Trainer weights are
                 # UNREPEATED — re-apply the GQA kv-head repeat first.
                 params = self._repeat_kv_tree(params)
+                if self._w_quant:
+                    # colocated trainers hand over fp master weights —
+                    # quantize on install (idempotent if already {"q",
+                    # "scale"}), matching the quantized sharding tree
+                    from areal_tpu.models.qwen2 import quantize_weights
+
+                    params = quantize_weights(params)
                 if self._param_shardings is not None:
                     self.params = jax.tree.map(
                         lambda x, s: jax.device_put(jnp.asarray(x), s),
@@ -4290,7 +4345,13 @@ class JaxDecodeEngine(InferenceEngine):
         for each target, so every later delta folds onto the original base
         — applying onto a previously-merged kernel would accumulate stale
         deltas. Mirrors models/qwen2.merge_lora's einsums (stacked [L, ...]
-        scan layout, which LoRA training requires)."""
+        scan layout, which LoRA training requires).
+
+        Quantized engines (weight_dtype="int8") snapshot the pristine
+        {"q","scale"} leaf, dequantize it to f32 for the fold, and
+        REQUANTIZE the merged kernel — fold-then-requantize, so the only
+        quantization error in the served kernel is one absmax round of the
+        true merged weights, never a round-trip of a round-trip."""
         if self.model_config is not None and not self.model_config.scan_layers:
             raise ValueError(
                 "lora delta push requires a scan-layers param layout"
@@ -4317,10 +4378,12 @@ class JaxDecodeEngine(InferenceEngine):
             if base is None:
                 base = self.params["layers"][sub][leaf]
                 self._lora_base[base_path] = base
+            quantized = isinstance(base, dict)
+            kshape = base["q"].shape if quantized else base.shape
             a = jnp.asarray(ab["a"], jnp.float32)
             b = jnp.asarray(ab["b"], jnp.float32)
             if leaf == "o_kernel":
-                delta = jnp.einsum("lir,lrh->lih", a, b).reshape(base.shape)
+                delta = jnp.einsum("lir,lrh->lih", a, b).reshape(kshape)
             elif leaf in ("q_kernel", "k_kernel", "v_kernel"):
                 delta = jnp.einsum("lhr,lrnd->lhnd", a, b)
                 if self._kv_repeat > 1 and leaf in ("k_kernel", "v_kernel"):
@@ -4328,9 +4391,29 @@ class JaxDecodeEngine(InferenceEngine):
                     delta = jnp.repeat(delta, self._kv_repeat, axis=-2)
             else:
                 delta = jnp.einsum("lir,lro->lio", a, b)
-            out[base_path] = (
-                base.astype(jnp.float32) + scale * delta
-            ).astype(base.dtype)
+            if quantized:
+                from areal_tpu.models.qwen2 import wq_contraction_axes
+                from areal_tpu.ops.quant import (
+                    dequantize_absmax,
+                    quantize_absmax,
+                )
+
+                axes = wq_contraction_axes(leaf, stacked=True)
+                merged = (
+                    dequantize_absmax(
+                        base["q"], base["scale"], jnp.float32, axis=axes
+                    )
+                    + scale * delta
+                )
+                q, s = quantize_absmax(merged, axis=axes)
+                # wire-shaped names: set_named walks INTO the {"q","scale"}
+                # node, so the parts install separately (same pause window)
+                out[f"{base_path}/q"] = q
+                out[f"{base_path}/scale"] = s
+            else:
+                out[base_path] = (
+                    base.astype(jnp.float32) + scale * delta
+                ).astype(base.dtype)
         return out
 
     def update_weights_from_tensor(
@@ -4362,10 +4445,16 @@ class JaxDecodeEngine(InferenceEngine):
                 dtype = jnp.dtype(self.config.dtype)
 
                 def cast(new, old):
+                    # quantized engines preserve each leaf's RESIDENT dtype
+                    # (int8 `.../q`, f32 `.../scale`, serve dtype for fp
+                    # leaves) — the producer already quantized, casting to
+                    # the serve dtype would corrupt the int8 payload. fp
+                    # engines keep the original serve-dtype cast bitwise.
+                    tgt = old.dtype if self._w_quant else dtype
                     if isinstance(new, jax.Array):
-                        arr = new.astype(dtype)  # merged delta: on device
+                        arr = new.astype(tgt)  # merged delta: on device
                     else:
-                        arr = jnp.asarray(np.asarray(new), dtype=dtype)
+                        arr = jnp.asarray(np.asarray(new), dtype=tgt)
                     assert arr.shape == old.shape, (arr.shape, old.shape)
                     if isinstance(old, jax.Array) and hasattr(old, "sharding"):
                         arr = jax.device_put(arr, old.sharding)
@@ -4374,14 +4463,32 @@ class JaxDecodeEngine(InferenceEngine):
                 # wire tensors carry the trainer's (unrepeated) kv heads
                 install = self._repeat_kv_named(plain)
                 # a full-tree push overwrites kernels a delta snapshot may
-                # reference — those snapshots are stale, drop them
+                # reference — those snapshots are stale, drop them (a
+                # quantized kernel arrives as `<path>/q` + `<path>/scale`
+                # wire names, but the snapshot is keyed by `<path>`)
                 for k in install:
                     self._lora_base.pop(k, None)
+                    if k.endswith(("/q", "/scale")):
+                        self._lora_base.pop(k.rsplit("/", 1)[0], None)
                 if lora_named:
                     install.update(
                         self._apply_lora_delta(lora_named, float(lora_scale))
                     )
-                self.params = set_named(self.params, install, cast=cast)
+                try:
+                    self.params = set_named(self.params, install, cast=cast)
+                except KeyError as e:
+                    # the usual cause: producer and consumer disagree on
+                    # weight_dtype — quantized kernels live under `/q` +
+                    # `/scale` suffixed names, fp kernels under the bare
+                    # path, so EVERY kernel name misses the target tree
+                    raise KeyError(
+                        f"{e.args[0]} — engine serves weight_dtype="
+                        f"{self.config.weight_dtype!r}; an fp<->int8 "
+                        "producer/consumer mismatch shifts every kernel "
+                        "wire name by the '/q' + '/scale' suffix (set "
+                        "WeightUpdateMeta.weight_dtype to the engine's "
+                        "serving dtype)"
+                    ) from e
                 self._invalidate_parked()
                 if version is not None:
                     self._version = int(version)
@@ -4404,6 +4511,10 @@ class JaxDecodeEngine(InferenceEngine):
                 host = self._repeat_kv_tree(
                     hf_io.load_hf_params(meta.path, load_cfg)
                 )
+                if self._w_quant:
+                    from areal_tpu.models.qwen2 import quantize_weights
+
+                    host = quantize_weights(host)
                 if self._param_shardings is not None:
                     self.params = jax.tree.map(
                         lambda x, s: jax.device_put(jnp.asarray(x), s),
@@ -4630,6 +4741,10 @@ class JaxDecodeEngine(InferenceEngine):
             # f32 scales when quantized): every byte counter here derives
             # from kv_block_nbytes, so none assumes the fp element size
             "kv_dtype": self.config.kv_dtype,
+            # serving dtype of the dense matmul kernels: "int8" means the
+            # param tree holds {"q","scale"} leaves (ISSUE 16) and wire
+            # pushes must arrive producer-quantized
+            "weight_dtype": self.config.weight_dtype,
             "kv_block_nbytes": self._block_nbytes,
             "kv_pool_device_bytes": (
                 self._alloc.n_blocks * self._block_nbytes

@@ -361,6 +361,8 @@ class JaxTrainEngine(TrainEngine):
         self._apply_update_fn = None
         self._zero_grads_fn = None
         self._push_cast_fn = None
+        self._push_quant_fn = None
+        self._push_quant_fn = None  # int8 weight-serving push (ISSUE 16)
         self._ocp_checkpointer = None
         self.rollout_engine: InferenceEngine | None = None
         self.weight_update_meta: WeightUpdateMeta | None = None
@@ -723,6 +725,7 @@ class JaxTrainEngine(TrainEngine):
         self._apply_update_fn = None
         self._zero_grads_fn = None
         self._push_cast_fn = None
+        self._push_quant_fn = None
         # A dead engine must not leave its topology as the process-global
         # ambient mesh: later traces (a differently-sharded decode engine,
         # plain eval forwards) would constrain onto devices their operands
@@ -986,8 +989,20 @@ class JaxTrainEngine(TrainEngine):
         else:
             raise NotImplementedError(f"weight update type {meta.type}")
 
-    def _dcn_payload(self, inflight: int):
+    def _dcn_payload(self, inflight: int, weight_dtype: str = "fp"):
         """(named, lora_scale) for a dcn push.
+
+        weight_dtype="int8" (WeightUpdateMeta.weight_dtype) quantizes the
+        dense matmul kernels ONCE, here at the producer, AFTER the bf16
+        push cast — the int8 grid then snapshots exactly the bf16 values
+        the fp wire would have shipped, so consumer drift vs the fp oracle
+        measures quantization error alone. Each kernel becomes a
+        {"q" int8, "scale" f32} subtree whose leaves flatten to the
+        `.../q` + `.../scale` wire names; wire bytes drop ~2x (int8 data
+        vs bf16, scales are one f32 per output channel). The trainer's
+        fp32 master weights are untouched. LoRA delta pushes stay fp: the
+        `lora/...` subtree has no quantizable kernels, so the quantize
+        pass is a no-op on it by construction.
 
         Under LoRA (+ weight_sync_delta) only the trainable adapter
         subtree goes on the wire (`lora/...` names; servers fold
@@ -1030,6 +1045,18 @@ class JaxTrainEngine(TrainEngine):
         else:
             casted = self._push_cast_fn(self._export_params())
             lora_scale = None
+        if weight_dtype == "int8":
+            if self._push_quant_fn is None:
+                from areal_tpu.models.qwen2 import quantize_weights
+
+                self._push_quant_fn = jax.jit(quantize_weights)
+            casted = self._push_quant_fn(casted)
+        elif weight_dtype != "fp":
+            from areal_tpu.models.qwen2 import WEIGHT_DTYPES
+
+            raise ValueError(
+                f"weight_dtype={weight_dtype!r} not in {WEIGHT_DTYPES}"
+            )
         if jax.process_count() > 1:  # pragma: no cover - multi-host only
             from jax.experimental import multihost_utils
 
@@ -1068,7 +1095,9 @@ class JaxTrainEngine(TrainEngine):
             getattr(engine, "config", None), "weight_sync_inflight_buckets", 2
         )
         chunk_mb = getattr(meta, "weight_chunked_mem_mb", None) or 512
-        named, lora_scale = self._dcn_payload(inflight)
+        named, lora_scale = self._dcn_payload(
+            inflight, getattr(meta, "weight_dtype", "fp")
+        )
         version = self.get_version()
         if jax.process_index() != 0:  # pragma: no cover - multi-host only
             return DcnWeightPush(None, None)  # collective already done

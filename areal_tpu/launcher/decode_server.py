@@ -149,6 +149,9 @@ class DecodeServer:
         self._sync_stats = dict(  # guarded-by: _ctl_lock
             n_pushes=0,
             wire_bytes=0,
+            # bf16-equivalent bytes of the frames received — raw/sent is
+            # the int8 weight-serving compression ratio (ISSUE 16)
+            wire_bytes_raw=0,
             staging_secs=0.0,
             commit_pause_secs=0.0,
             aborted_pushes=0,
@@ -220,6 +223,7 @@ class DecodeServer:
                 "decode_runahead_chunks": self.config.decode_runahead_chunks,
                 "kv_layout": self.config.kv_layout,
                 "kv_dtype": getattr(self.config, "kv_dtype", "fp"),
+                "weight_dtype": getattr(self.config, "weight_dtype", "fp"),
                 "kv_host_pool_mb": self.config.kv_host_pool_mb,
                 "paged_attn_impl": self.config.paged_attn_impl,
                 "spec_decode": self.config.spec_decode,
@@ -323,9 +327,16 @@ class DecodeServer:
             # rather than record a phantom zero load
             raise web.HTTPNotFound(reason="engine exports no metrics")
         out = dict(get())
-        out["weight_sync"] = dict(
-            self._sync_stats, staged_tensors=len(self._weight_staging)
+        ws = dict(self._sync_stats, staged_tensors=len(self._weight_staging))
+        ws["wire_bytes_sent"] = ws["wire_bytes"]
+        # raw/sent: 1.0 on fp pushes, ~2x once the producer ships int8
+        # kernels (weight_transfer.raw_wire_nbytes)
+        ws["weight_sync_compression"] = (
+            round(ws["wire_bytes_raw"] / ws["wire_bytes_sent"], 4)
+            if ws["wire_bytes_sent"]
+            else 1.0
         )
+        out["weight_sync"] = ws
         # rid-dedup observability: table occupancy + duplicate deliveries
         # prevented (the exactly-once evidence bench --mode fleet reads)
         out["idem_entries"] = len(self._idem)
@@ -456,6 +467,11 @@ class DecodeServer:
             self._weight_staging.add_bucket(payload)
             self._staging_last_frame_t = time.monotonic()
             self._sync_stats["wire_bytes"] += len(payload)
+            # after add_bucket: a torn frame raised above, so the manifest
+            # parsed here is the one whose bytes were actually staged
+            from areal_tpu.core.weight_transfer import frame_raw_nbytes
+
+            self._sync_stats["wire_bytes_raw"] += frame_raw_nbytes(payload)
         return web.json_response(
             {"status": "ok", "staged": len(self._weight_staging)}
         )
@@ -1040,6 +1056,7 @@ async def _serve(args: argparse.Namespace) -> None:
         decode_runahead_chunks=args.decode_runahead_chunks,
         kv_layout=args.kv_layout,
         kv_dtype=args.kv_dtype,
+        weight_dtype=args.weight_dtype,
         kv_host_pool_mb=args.kv_host_pool_mb,
         paged_attn_impl=args.paged_attn_impl,
         spec_decode=args.spec_decode,
@@ -1173,6 +1190,19 @@ def main(argv: list[str] | None = None) -> None:
              "bytes as-is (mixed-dtype fleets reject imports as honest "
              "misses). Drift is measured (bench.py --mode kvquant), not "
              "assumed zero",
+    )
+    p.add_argument(
+        "--weight-dtype",
+        default="fp",
+        choices=["fp", "int8"],
+        help="serving dtype of the dense matmul kernels: 'fp' serves "
+             "--dtype verbatim (the numerics oracle); 'int8' serves "
+             "per-output-channel absmax int8 + f32 scales — weight HBM and "
+             "push wire bytes ~halve, decode runs the fused dequant-matmul "
+             "(Pallas on TPU). The trainer's WeightUpdateMeta.weight_dtype "
+             "must match: quantized kernels travel as '.../q' + "
+             "'.../scale' wire leaves. Drift is measured (bench.py --mode "
+             "wquant), not assumed zero",
     )
     p.add_argument(
         "--kv-host-pool-mb",

@@ -801,6 +801,152 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Int8 weight serving (ISSUE 16): per-output-channel symmetric absmax.
+#
+# A quantized kernel leaf is a {"q": int8 (kernel's own shape),
+# "scale": f32 (output dims)} dict — jax treats it as a pytree so scan,
+# donation and sharding carry it untouched, and core/weight_transfer's
+# flatten_named/set_named walk straight through it, which is what yields
+# the `.../q` + `.../scale` wire names the DCN push ships. Only the dense
+# transformer matmul kernels quantize; MoE expert/router/shared kernels,
+# embed, lm_head, norms, biases and LoRA adapters stay fp.
+# ---------------------------------------------------------------------------
+
+# JaxDecodeConfig.weight_dtype values: "fp" serves the config dtype
+# verbatim (the pre-quantization behavior and the numerics oracle),
+# "int8" stores the dense matmul kernels in this scheme.
+WEIGHT_DTYPES = ("fp", "int8")
+
+# contraction axes per UNSTACKED kernel (the scan [L, ...] stack shifts
+# every axis by one); the absmax reduces over these, leaving one scale
+# per output channel so the consumer can fold it in after the matmul
+_WQ_ATTN_AXES = {
+    "q_kernel": (0,),
+    "k_kernel": (0,),
+    "v_kernel": (0,),
+    "o_kernel": (0, 1),
+}
+_WQ_MLP_AXES = {
+    "gate_kernel": (0,),
+    "up_kernel": (0,),
+    "down_kernel": (0,),
+    "fc1_kernel": (0,),
+    "fc2_kernel": (0,),
+}
+
+
+def _map_wq_layer(layer_tree: dict, fn, stacked: bool) -> dict:
+    off = 1 if stacked else 0
+    out = dict(layer_tree)
+    if "attn" in layer_tree:
+        sub = dict(layer_tree["attn"])
+        for leaf, axes in _WQ_ATTN_AXES.items():
+            if leaf in sub:
+                sub[leaf] = fn(sub[leaf], tuple(a + off for a in axes))
+        out["attn"] = sub
+    # MoE layers (marked by their router) stay fp end to end: expert
+    # kernels are ragged-routed, not dense matmuls over every token
+    if "mlp" in layer_tree and "router_kernel" not in layer_tree["mlp"]:
+        sub = dict(layer_tree["mlp"])
+        for leaf, axes in _WQ_MLP_AXES.items():
+            if leaf in sub:
+                sub[leaf] = fn(sub[leaf], tuple(a + off for a in axes))
+        out["mlp"] = sub
+    return out
+
+
+def map_quant_kernels(params: dict, fn) -> dict:
+    """Rebuild the param tree with `fn(leaf, contraction_axes)` applied to
+    every weight-quantizable kernel (both scan-stacked `layers` and
+    per-layer `layers_{i}` forms); everything else passes through."""
+    out = dict(params)
+    if "layers" in params:
+        out["layers"] = _map_wq_layer(params["layers"], fn, stacked=True)
+    for k in params:
+        if k.startswith("layers_"):
+            out[k] = _map_wq_layer(params[k], fn, stacked=False)
+    return out
+
+
+def quantize_weights(params: dict) -> dict:
+    """fp param tree -> tree with dense matmul kernels as {"q", "scale"}.
+
+    Idempotent on already-quantized leaves (they pass through untouched),
+    so install paths can call it unconditionally."""
+    from areal_tpu.ops.quant import quantize_absmax
+
+    def one(w, axes):
+        if isinstance(w, dict):  # already quantized
+            return w
+        q, s = quantize_absmax(w, axis=axes)
+        return {"q": q, "scale": s}
+
+    return map_quant_kernels(params, one)
+
+
+def dequantize_weights(params: dict, dtype) -> dict:
+    """Inverse of quantize_weights (lossy): {"q","scale"} leaves -> fp
+    arrays in `dtype`. Non-quantized leaves pass through."""
+    from areal_tpu.ops.quant import dequantize_absmax
+
+    def one(w, axes):
+        if not isinstance(w, dict):
+            return w
+        return dequantize_absmax(w["q"], w["scale"], dtype, axis=axes)
+
+    return map_quant_kernels(params, one)
+
+
+def quantize_weight_axes(axes_tree: dict) -> dict:
+    """Mirror quantize_weights on a param_logical_axes tree: each
+    quantizable kernel's logical-axes tuple becomes {"q": the tuple,
+    "scale": the tuple minus the contraction axes} so sharding trees keep
+    the same structure as the quantized params."""
+
+    def one(ax, caxes):
+        if isinstance(ax, dict):
+            return ax
+        return {
+            "q": ax,
+            "scale": tuple(a for i, a in enumerate(ax) if i not in caxes),
+        }
+
+    return map_quant_kernels(axes_tree, one)
+
+
+def wq_contraction_axes(leaf: str, stacked: bool) -> tuple[int, ...] | None:
+    """Contraction axes for one kernel leaf name ("q_kernel", ...), or
+    None when that leaf never quantizes. `stacked` shifts for the scan
+    [L, ...] layout — the form engine LoRA folds operate on."""
+    ax = _WQ_ATTN_AXES.get(leaf) or _WQ_MLP_AXES.get(leaf)
+    if ax is None:
+        return None
+    off = 1 if stacked else 0
+    return tuple(a + off for a in ax)
+
+
+def is_weight_quantized(params: dict) -> bool:
+    """True when any dense kernel leaf is a {"q","scale"} dict."""
+    found = []
+    map_quant_kernels(
+        params, lambda w, axes: found.append(isinstance(w, dict)) or w
+    )
+    return any(found)
+
+
+def _w_einsum(eq: str, x: jax.Array, w, n_contract: int) -> jax.Array:
+    """The matmul seam: a bare array runs the original einsum — the
+    weight_dtype="fp" path stays BITWISE identical to pre-quantization
+    streams — while a {"q","scale"} leaf runs the fused dequant-matmul
+    (Pallas on TPU, XLA dequant-then-matmul elsewhere)."""
+    if isinstance(w, dict):
+        from areal_tpu.ops.quant_matmul import quant_einsum
+
+        return quant_einsum(x, w["q"], w["scale"], n_contract)
+    return jnp.einsum(eq, x, w)
+
+
+# ---------------------------------------------------------------------------
 # Forward computation (packed layout)
 # ---------------------------------------------------------------------------
 
@@ -1049,9 +1195,9 @@ def attention(
 ) -> jax.Array:
     """Packed multi-head GQA attention over one 1-D token stream [T, H]."""
     nH, nKV, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim_
-    q = jnp.einsum("th,hnd->tnd", x, layer_p["q_kernel"])
-    k = jnp.einsum("th,hnd->tnd", x, layer_p["k_kernel"])
-    v = jnp.einsum("th,hnd->tnd", x, layer_p["v_kernel"])
+    q = _w_einsum("th,hnd->tnd", x, layer_p["q_kernel"], 1)
+    k = _w_einsum("th,hnd->tnd", x, layer_p["k_kernel"], 1)
+    v = _w_einsum("th,hnd->tnd", x, layer_p["v_kernel"], 1)
     if cfg.lora_rank:
         q = _with_lora(layer_p, "q_kernel", q, x, cfg)
         k = _with_lora(layer_p, "k_kernel", k, x, cfg)
@@ -1107,7 +1253,7 @@ def attention(
         out = jnp.einsum("kgts,skd->tkgd", probs, v)
         out = out.reshape(T, nH, hd)
     out = _cstr(out, "tokens", "act_heads", None)
-    proj = jnp.einsum("tnd,ndh->th", out, layer_p["o_kernel"])
+    proj = _w_einsum("tnd,ndh->th", out, layer_p["o_kernel"], 2)
     if cfg.lora_rank:
         d = _lora_delta(
             layer_p, "o_kernel", out.reshape(T, nH * hd), cfg
@@ -1129,18 +1275,18 @@ def _with_lora(layer_p, leaf, y, x, cfg):
 def mlp(layer_p: dict, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     act = act_fn(cfg)
     if cfg.mlp_style == "fc":
-        h1 = jnp.einsum("th,hm->tm", x, layer_p["fc1_kernel"])
+        h1 = _w_einsum("th,hm->tm", x, layer_p["fc1_kernel"], 1)
         h1 = _with_lora(layer_p, "fc1_kernel", h1, x, cfg)
         h = _cstr(act(h1 + layer_p["fc1_bias"]), "tokens", "act_mlp")
-        out = jnp.einsum("tm,mh->th", h, layer_p["fc2_kernel"])
+        out = _w_einsum("tm,mh->th", h, layer_p["fc2_kernel"], 1)
         out = _with_lora(layer_p, "fc2_kernel", out, h, cfg)
         return _cstr(out + layer_p["fc2_bias"], "tokens", "act_embed")
-    gate = jnp.einsum("th,hm->tm", x, layer_p["gate_kernel"])
+    gate = _w_einsum("th,hm->tm", x, layer_p["gate_kernel"], 1)
     gate = _with_lora(layer_p, "gate_kernel", gate, x, cfg)
-    up = jnp.einsum("th,hm->tm", x, layer_p["up_kernel"])
+    up = _w_einsum("th,hm->tm", x, layer_p["up_kernel"], 1)
     up = _with_lora(layer_p, "up_kernel", up, x, cfg)
     h = _cstr(act(gate) * up, "tokens", "act_mlp")
-    out = jnp.einsum("tm,mh->th", h, layer_p["down_kernel"])
+    out = _w_einsum("tm,mh->th", h, layer_p["down_kernel"], 1)
     out = _with_lora(layer_p, "down_kernel", out, h, cfg)
     return _cstr(out, "tokens", "act_embed")
 
@@ -1698,9 +1844,9 @@ def segment_ids_from_cu_seqlens(cu_seqlens: np.ndarray, total: int) -> np.ndarra
 def _project_qkv(layer_p: dict, x: jax.Array, cos, sin, cfg: ModelConfig):
     """Shared QKV projection + norm + rope. x: [..., H] with leading dims
     matching cos/sin's leading dims."""
-    q = jnp.einsum("...h,hnd->...nd", x, layer_p["q_kernel"])
-    k = jnp.einsum("...h,hnd->...nd", x, layer_p["k_kernel"])
-    v = jnp.einsum("...h,hnd->...nd", x, layer_p["v_kernel"])
+    q = _w_einsum("...h,hnd->...nd", x, layer_p["q_kernel"], 1)
+    k = _w_einsum("...h,hnd->...nd", x, layer_p["k_kernel"], 1)
+    v = _w_einsum("...h,hnd->...nd", x, layer_p["v_kernel"], 1)
     if cfg.qkv_bias:
         q = q + layer_p["q_bias"]
         k = k + layer_p["k_bias"]
@@ -1815,7 +1961,7 @@ def prefill(
         scores = jnp.where(mask[None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         attn_out = jnp.einsum("kgts,skd->tkgd", probs, vv).reshape(T, nH, hd)
-        proj = jnp.einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"])
+        proj = _w_einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"], 2)
         if cfg.attn_out_bias:
             proj = proj + layer_p["attn"]["o_bias"]
         x = x + proj
@@ -1968,7 +2114,7 @@ def decode_step(
         attn_out = jnp.einsum(
             "rkgs,rskd->rkgd", probs, vc.astype(x.dtype)
         ).reshape(R, nH, hd)
-        proj = jnp.einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"])
+        proj = _w_einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"], 2)
         if cfg.attn_out_bias:
             proj = proj + layer_p["attn"]["o_bias"]
         x = x + proj
@@ -2104,7 +2250,7 @@ def decode_step_paged(
         attn_out = paged_attention(
             q.reshape(R, nH, hd), kp, vp, block_tables, valid, impl=attn_impl
         )
-        proj = jnp.einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"])
+        proj = _w_einsum("rnd,ndh->rh", attn_out, layer_p["attn"]["o_kernel"], 2)
         if cfg.attn_out_bias:
             proj = proj + layer_p["attn"]["o_bias"]
         x = x + proj
@@ -2225,7 +2371,7 @@ def verify_step(
             q.reshape(R, W, nH, hd), kc.astype(q.dtype), vc.astype(q.dtype),
             valid,
         ).reshape(R * W, nH, hd)
-        proj = jnp.einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"])
+        proj = _w_einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"], 2)
         if cfg.attn_out_bias:
             proj = proj + layer_p["attn"]["o_bias"]
         x = x + proj
@@ -2355,7 +2501,7 @@ def verify_step_paged(
             q.reshape(R, W, nH, hd), kp, vp, block_tables, valid,
             impl=attn_impl,
         ).reshape(R * W, nH, hd)
-        proj = jnp.einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"])
+        proj = _w_einsum("tnd,ndh->th", attn_out, layer_p["attn"]["o_kernel"], 2)
         if cfg.attn_out_bias:
             proj = proj + layer_p["attn"]["o_bias"]
         x = x + proj

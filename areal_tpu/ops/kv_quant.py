@@ -39,12 +39,16 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from areal_tpu.ops.quant import (  # noqa: F401 — INT8_QMAX re-exported
+    INT8_QMAX,
+    dequantize_absmax,
+    quantize_absmax,
+)
+
 # JaxDecodeConfig.kv_dtype values: "fp" stores kv_cache_dtype verbatim
 # (the pre-quantization behavior and the numerics oracle), "int8" stores
 # the paged pool in this module's scheme.
 KV_DTYPES = ("fp", "int8")
-
-INT8_QMAX = 127.0
 
 
 def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -52,17 +56,14 @@ def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
 
     The reduction axis is the trailing head_dim: one scale per (token row,
     kv head). All-zero rows get scale 1.0 so the dequantized row is an
-    exact zero instead of 0/0."""
-    xf = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(xf), axis=-1)
-    scale = jnp.where(amax > 0.0, amax / INT8_QMAX, 1.0)
-    q = jnp.clip(jnp.round(xf / scale[..., None]), -INT8_QMAX, INT8_QMAX)
-    return q.astype(jnp.int8), scale.astype(jnp.float32)
+    exact zero instead of 0/0. Delegates to the shared axis-generic scheme
+    in ops/quant.py (ISSUE 16 hoist) — same op sequence, bit-identical."""
+    return quantize_absmax(x, axis=-1)
 
 
 def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
     """(int8 [..., hd], f32 [...]) -> fp [..., hd] in `dtype`."""
-    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+    return dequantize_absmax(q, scale, dtype, axis=-1)
 
 
 def split_pool(pool):

@@ -1,0 +1,69 @@
+"""Symmetric absmax int8 quantization — the ONE scheme, axis-generic.
+
+Hoisted out of ops/kv_quant.py (ISSUE 16) so the weight-serving path and
+the KV-pool path cannot drift: both quantize with the same grid, the same
+all-zero-row rule, and the same dequantization, differing only in which
+axes the absmax reduces over.
+
+    scale = max(|x|, axes) / 127        (1.0 when the slice is all zero,
+                                         so dequantization is always finite)
+    q     = round(x / scale) clipped to [-127, 127], int8
+    dehat = q * scale
+
+KV quantization reduces over the trailing head_dim (one scale per token
+row per kv head); weight quantization reduces over a kernel's CONTRACTION
+axes (one scale per output channel), which is what lets the consumer fold
+the scale back in after the matmul accumulates in f32:
+
+    einsum(x, q) * scale  ==  einsum(x, q * scale)      [exactly, in f32]
+
+Worst-case round-trip error per element is scale/2 = amax/254 (round-to-
+nearest on a symmetric grid); tests/test_kv_quant.py pins the KV bound and
+tests/test_weight_quant.py the weight bound.
+
+The op sequence here is byte-for-byte the one ops/kv_quant.py shipped in
+PR 11 (f32 upcast -> abs -> amax -> where -> round -> clip -> int8 cast),
+specialized only in the reduction axes — existing int8 KV pools, exported
+sessions and host-tier spills stay bit-identical across the hoist.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+INT8_QMAX = 127.0
+
+
+def _as_axes(axis) -> tuple[int, ...]:
+    return (axis,) if isinstance(axis, int) else tuple(axis)
+
+
+def quantize_absmax(
+    x: jnp.ndarray, axis: int | tuple[int, ...] = -1
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """fp array -> (int8 values, f32 scales reduced over `axis`).
+
+    `axis` is the reduction axis/axes of the absmax: those dimensions are
+    dropped from the scale tensor. All-zero slices get scale 1.0 so the
+    dequantized slice is an exact zero instead of 0/0.
+    """
+    axes = _as_axes(axis)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axes)
+    scale = jnp.where(amax > 0.0, amax / INT8_QMAX, 1.0)
+    q = jnp.clip(
+        jnp.round(xf / jnp.expand_dims(scale, axes)), -INT8_QMAX, INT8_QMAX
+    )
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_absmax(
+    q: jnp.ndarray,
+    scale: jnp.ndarray,
+    dtype,
+    axis: int | tuple[int, ...] = -1,
+) -> jnp.ndarray:
+    """(int8, f32 scales) -> fp array in `dtype`; `axis` as in quantize."""
+    return (
+        q.astype(jnp.float32) * jnp.expand_dims(scale, _as_axes(axis))
+    ).astype(dtype)

@@ -118,6 +118,27 @@ def param_count(cfg) -> int:
     return L * per_layer + embed + head + d  # + final norm
 
 
+def wq_elem_counts(cfg) -> tuple[int, int]:
+    """(quantizable kernel elements, scale elements) for int8 weight
+    serving, mirroring models/qwen2's layer map (_WQ_ATTN_AXES /
+    _WQ_MLP_AXES): the dense attn + mlp matmul kernels quantize with one
+    f32 scale per output channel; MoE mlp subtrees (router-marked) stay
+    fp — their attn kernels still quantize — as do embed, lm_head, norms,
+    biases and LoRA adapters."""
+    d = cfg.hidden_size
+    nH = cfg.num_attention_heads
+    nKV = cfg.num_key_value_heads
+    hd = d // nH
+    L = cfg.num_hidden_layers
+    q = d * (nH + 2 * nKV) * hd + nH * hd * d  # q/k/v + o kernels
+    s = (nH + 2 * nKV) * hd + d  # one scale per output channel
+    if not (getattr(cfg, "num_experts", 0) or 0):
+        ff = cfg.intermediate_size
+        q += 3 * d * ff  # gate + up + down
+        s += 2 * ff + d
+    return L * q, L * s
+
+
 @dataclass
 class HBMEstimate:
     params_bytes: int
@@ -134,6 +155,10 @@ class HBMEstimate:
     # per chip vs a dp-replicated opt state (already subtracted from
     # opt_bytes; NOT part of total_bytes)
     opt_freed_bytes: int = 0
+    # informational: bytes int8 weight serving freed per chip vs the fp
+    # kernels (already subtracted from params_bytes; NOT part of
+    # total_bytes) — headroom a fixed HBM budget can hand to the KV pool
+    weight_freed_bytes: int = 0
 
     @property
     def total_bytes(self) -> int:
@@ -160,6 +185,8 @@ class HBMEstimate:
         }
         if self.opt_freed_bytes:
             out["zero1_freed_gib"] = round(self.opt_freed_bytes / GiB, 3)
+        if self.weight_freed_bytes:
+            out["wquant_freed_gib"] = round(self.weight_freed_bytes / GiB, 3)
         return out
 
 
@@ -254,11 +281,18 @@ def estimate_decode_hbm(
     slots: int = 64,
     context_length: int = 32768,
     kv_cache_dtype: str = "bfloat16",
+    weight_dtype: str = "fp",
 ) -> HBMEstimate:
     """Per-chip HBM for a decode server: tp-sharded params + paged KV pool.
 
     `pool_tokens=None` models dense provisioning (slots x context) — the
     difference vs a sized pool is exactly what the paged cache buys.
+
+    `weight_dtype="int8"` (JaxDecodeConfig.weight_dtype) prices the dense
+    matmul kernels at 1 byte/element plus one f32 scale per output channel
+    instead of param_dtype; the per-chip bytes that frees vs fp serving
+    surface as `wquant_freed_gib` in breakdown() — at a fixed HBM budget
+    that headroom goes to a larger resident KV pool (bench --mode wquant).
     """
     n = param_count(model_cfg)
     pbytes = _dtype_bytes(getattr(model_cfg, "param_dtype", "bfloat16"))
@@ -269,13 +303,23 @@ def estimate_decode_hbm(
     if pool_tokens is None:
         pool_tokens = slots * context_length
     kv = 2 * model_cfg.num_hidden_layers * pool_tokens * nKV * hd * kvb // tp
+    params_bytes = n * pbytes // tp
+    weight_freed = 0
+    if weight_dtype == "int8":
+        nq, ns = wq_elem_counts(model_cfg)
+        quantized = ((n - nq) * pbytes + nq * 1 + ns * 4) // tp
+        weight_freed = params_bytes - quantized
+        params_bytes = quantized
+    elif weight_dtype != "fp":
+        raise ValueError(f"weight_dtype={weight_dtype!r} not in ('fp', 'int8')")
     return HBMEstimate(
-        params_bytes=n * pbytes // tp,
+        params_bytes=params_bytes,
         grads_bytes=0,
         opt_bytes=0,
         activation_bytes=0,
         logits_bytes=0,
         kv_bytes=kv,
+        weight_freed_bytes=weight_freed,
     )
 
 

@@ -986,6 +986,259 @@ def bench_kvquant(model, n_sessions, prompt_len, new_tokens, max_running,
     )
 
 
+def bench_wquant(model, n_sessions, prompt_len, new_tokens, max_running,
+                 pool_mb=0.5, chunk=None, n_push=3):
+    """Int8 weight serving vs fp at a FIXED HBM budget (ISSUE 16).
+
+    Three legs, every engine paged, kv_dtype fp throughout so the weight
+    knob is the ONLY difference:
+
+    1. **Capacity + throughput at fixed bytes**: both engines get a KV
+       pool budget of `pool_mb` PLUS whatever their weight_dtype left of
+       the fp weight footprint — int8 kernels (1 byte + one f32 scale per
+       output channel) free ~half the dense-kernel bytes, and at a fixed
+       HBM budget that headroom IS extra resident KV. Reports pool
+       tokens, resident-session capacity, end-to-end tok/s and decode
+       ITL for both. The int8 engine runs FIRST so the warm-XLA-process
+       advantage goes to the fp baseline. NOTE the decode speedup claim
+       (fused dequant-matmul reads half the weight HBM per chunk) is a
+       TPU-bandwidth effect; the CPU smoke's XLA fallback pays dequant
+       FLOPs instead, so tok_s_ratio here is a floor.
+    2. **Wire bytes + commit pause**: the same full tree is framed
+       (pack_buckets) as the producer ships it — bf16-cast fp kernels vs
+       producer-quantized int8 + f32 scales — and pushed through
+       update_weights_from_tensor n_push times per dtype; reports the
+       framed wire bytes and the mean install pause, both ~2x smaller
+       quantized.
+    3. **Drift, measured not assumed**: greedy + sampled streams vs the
+       fp oracle (token match fraction, max |logprob delta| over the
+       matched prefix). Same random-weights caveat as bench_kvquant: the
+       CPU smoke's near-uniform logits are the drift worst case.
+    """
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core.weight_transfer import flatten_named, pack_buckets
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.models.qwen2 import init_params, quantize_weights
+
+    params = init_params(model, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(23)
+    prompts = [
+        rng.randint(1, model.vocab_size, (prompt_len,)).tolist()
+        for _ in range(n_sessions)
+    ]
+    g = GenerationHyperparameters(
+        max_new_tokens=new_tokens, temperature=1.0, top_p=1.0
+    )
+    L = model.num_hidden_layers
+    nkv = model.num_key_value_heads
+    hd = model.head_dim_
+    kv_tok_bytes = 2 * L * nkv * hd * np.dtype(model.dtype).itemsize
+
+    # the wire trees, exactly as the producer ships them: bf16 cast, then
+    # (for int8) producer quantization — jax_engine._dcn_payload's order
+    bf16 = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x,
+        params,
+    )
+    wire = {
+        "fp": flatten_named(bf16),
+        "int8": flatten_named(quantize_weights(bf16)),
+    }
+    weight_bytes = {
+        dt: sum(a.nbytes for a in named.values())
+        for dt, named in wire.items()
+    }
+    freed = {
+        "fp": 0,
+        "int8": weight_bytes["fp"] - weight_bytes["int8"],
+    }
+    # the ~2x story measured over the kernels that actually quantize
+    # (embed/lm_head/norms stay fp and dominate tiny smoke models)
+    kern_i8 = kern_fp = 0
+    for name, a in wire["int8"].items():
+        if name.endswith("/q"):
+            base = name[: -len("/q")]
+            kern_fp += wire["fp"][base].nbytes
+            kern_i8 += a.nbytes + wire["int8"][base + "/scale"].nbytes
+
+    def mk(dt, *, pool_tokens=None, host_mb=0.0, R=max_running):
+        dcfg = JaxDecodeConfig(
+            context_length=prompt_len + new_tokens + 128,
+            max_running_requests=R,
+            new_tokens_per_chunk=chunk or min(128, new_tokens),
+            kv_layout="paged",
+            weight_dtype=dt,
+            kv_pool_tokens=pool_tokens,
+            kv_host_pool_mb=host_mb,
+            dtype=model.dtype,
+            kv_cache_dtype=model.dtype,
+        )
+        eng = JaxDecodeEngine(
+            dcfg, InferenceEngineConfig(max_concurrent_rollouts=n_sessions)
+        )
+        eng.set_model(params, model)
+        eng.initialize()
+        return eng
+
+    sess_len = prompt_len + new_tokens
+
+    def throughput(dt: str) -> dict:
+        # fixed budget: pool_mb + whatever this dtype freed of the fp
+        # weight footprint goes to resident KV
+        pool_tokens = int(
+            (pool_mb * 1024 * 1024 + freed[dt]) // kv_tok_bytes
+        )
+        eng = mk(dt, pool_tokens=pool_tokens, host_mb=max(64.0, pool_mb * 4))
+        try:
+            eng.prewarm(prompt_len=prompt_len, gconfig=g, include_fork=False)
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=n_sessions) as pool:
+                rs = list(
+                    pool.map(
+                        lambda p: eng.generate(
+                            ModelRequest(input_ids=p, gconfig=g),
+                            timeout=1800,
+                        ),
+                        prompts,
+                    )
+                )
+            wall = time.perf_counter() - t0
+            m = eng.get_metrics()
+            toks = sum(len(r.output_tokens) for r in rs)
+            return dict(
+                pool_tokens=m["kv_pool_tokens_total"],
+                resident_sessions=m["kv_pool_tokens_total"] // sess_len,
+                tok_s=toks / wall if wall > 0 else 0.0,
+                itl_p50_ms=float(m.get("itl_p50_ms", 0.0) or 0.0),
+                preemptions=m["preemptions_total"],
+            )
+        finally:
+            eng.destroy()
+
+    def push_pause(dt: str) -> float:
+        eng = mk(dt, R=4)
+        try:
+            # untimed warm push compiles/primes nothing timed below
+            eng.update_weights_from_tensor(wire[dt], version=1)
+            t0 = time.perf_counter()
+            for i in range(n_push):
+                eng.update_weights_from_tensor(wire[dt], version=i + 2)
+                jax.block_until_ready(eng.params)
+            return (time.perf_counter() - t0) / n_push
+        finally:
+            eng.destroy()
+
+    def streams(dt: str, gg, n=4) -> list:
+        eng = mk(dt, R=max_running)
+        try:
+            with ThreadPoolExecutor(max_workers=n) as pool:
+                return list(
+                    pool.map(
+                        lambda p: eng.generate(
+                            ModelRequest(input_ids=p, gconfig=gg),
+                            timeout=1800,
+                        ),
+                        prompts[:n],
+                    )
+                )
+        finally:
+            eng.destroy()
+
+    # int8 first: warm-process advantage goes to the fp baseline
+    q = throughput("int8")
+    f = throughput("fp")
+    framed_bytes = {
+        dt: sum(len(b) for b in pack_buckets(named, chunk_mb=512))
+        for dt, named in wire.items()
+    }
+    pause_i8 = push_pause("int8")
+    pause_fp = push_pause("fp")
+
+    drift = {}
+    for name, gg in (
+        ("greedy", dataclasses.replace(g, greedy=True)),
+        ("sampled", dataclasses.replace(g, temperature=0.8, top_p=0.9)),
+    ):
+        fp_rs = streams("fp", gg)
+        i8_rs = streams("int8", gg)
+        matched = total = 0
+        max_dlp = 0.0
+        for rf, ri in zip(fp_rs, i8_rs):
+            total += max(len(rf.output_tokens), 1)
+            for a, b, la, lb in zip(
+                rf.output_tokens, ri.output_tokens,
+                rf.output_logprobs, ri.output_logprobs,
+            ):
+                if a != b:
+                    break
+                matched += 1
+                max_dlp = max(max_dlp, abs(la - lb))
+        drift[f"wquant_{name}_token_match_frac"] = (
+            round(matched / total, 4) if total else 0.0
+        )
+        drift[f"wquant_{name}_max_logprob_delta_matched"] = round(
+            max_dlp, 6
+        )
+
+    return dict(
+        wquant_pool_mb=pool_mb,
+        wquant_fp_weight_bytes=weight_bytes["fp"],
+        wquant_int8_weight_bytes=weight_bytes["int8"],
+        wquant_weight_freed_bytes=freed["int8"],
+        wquant_fp_pool_tokens=f["pool_tokens"],
+        wquant_int8_pool_tokens=q["pool_tokens"],
+        wquant_fp_resident_sessions=f["resident_sessions"],
+        wquant_int8_resident_sessions=q["resident_sessions"],
+        wquant_capacity_ratio=(
+            round(q["pool_tokens"] / f["pool_tokens"], 4)
+            if f["pool_tokens"]
+            else 0.0
+        ),
+        wquant_fp_tok_s=round(f["tok_s"], 2),
+        wquant_int8_tok_s=round(q["tok_s"], 2),
+        wquant_tok_s_ratio=(
+            round(q["tok_s"] / f["tok_s"], 4) if f["tok_s"] > 0 else 0.0
+        ),
+        wquant_fp_itl_p50_ms=round(f["itl_p50_ms"], 3),
+        wquant_int8_itl_p50_ms=round(q["itl_p50_ms"], 3),
+        wquant_fp_preemptions=f["preemptions"],
+        wquant_int8_preemptions=q["preemptions"],
+        wquant_fp_wire_bytes=framed_bytes["fp"],
+        wquant_int8_wire_bytes=framed_bytes["int8"],
+        # headline: framed push bytes, fp over int8 (~2x: int8 data + one
+        # f32 scale per output channel vs bf16 kernels)
+        wquant_wire_bytes_ratio=(
+            round(framed_bytes["fp"] / framed_bytes["int8"], 4)
+            if framed_bytes["int8"]
+            else 0.0
+        ),
+        wquant_kernel_wire_bytes_ratio=(
+            round(kern_fp / kern_i8, 4) if kern_i8 else 0.0
+        ),
+        wquant_fp_commit_pause_s=round(pause_fp, 4),
+        wquant_int8_commit_pause_s=round(pause_i8, 4),
+        wquant_commit_pause_ratio=(
+            round(pause_fp / pause_i8, 4) if pause_i8 > 0 else 0.0
+        ),
+        wquant_sessions=n_sessions,
+        wquant_prompt_len=prompt_len,
+        wquant_new_tokens=new_tokens,
+        **drift,
+    )
+
+
 def bench_fleet(model, n_replicas, n_groups, group_size, prompt_len,
                 new_tokens, max_running, chunk=None, turns=2):
     """Fleet router bench (ISSUE 8): prefix-affinity routing vs
@@ -3215,6 +3468,9 @@ def bench_weightsync(model, n_pushes, chunk_mb, prompt_len, new_tokens):
         weightsync_mb_per_s=wire_bytes / 1024 / 1024 / max(transfer_s, 1e-9),
         weightsync_tokens_during_staging=float(tokens_during_staging)
         / n_pushes,
+        # raw(bf16-equivalent)/sent over the staged frames: 1.0 for fp
+        # pushes, ~2x once the producer ships int8 + f32 scales (ISSUE 16)
+        weightsync_wire_compression=m.get("weight_sync_compression", 1.0),
     )
 
 
@@ -4146,6 +4402,7 @@ BENCH_MODE_FNS = {
     "specdecode": bench_spec_compare,
     "kvoffload": bench_kvoffload,
     "kvquant": bench_kvquant,
+    "wquant": bench_wquant,
     "fleet": bench_fleet,
     "chaos": bench_chaos,
     "chaostrain": bench_chaostrain,
@@ -4164,6 +4421,7 @@ MODE_HEADLINES = {
     "specdecode": ("spec_over_off_speedup", "x"),
     "kvoffload": ("kvoffload_resume_ttft_speedup", "x"),
     "kvquant": ("kvquant_capacity_ratio", "x"),
+    "wquant": ("wquant_wire_bytes_ratio", "x"),
     "fleet": ("fleet_affinity_ttft_p50_speedup", "x"),
     "chaos": ("chaos_exactly_once", "bool"),
     "chaostrain": ("chaostrain_exactly_once", "bool"),
@@ -4516,6 +4774,21 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("wquant"):
+            decode.update(
+                _retry_transport(
+                    # same session mix as kvquant: pool_mb sized so the
+                    # bf16-weight engine pressures its pool while int8's
+                    # freed weight HBM keeps the working set resident
+                    lambda: bench_wquant(
+                        model, n_sessions=96, prompt_len=512,
+                        new_tokens=256, max_running=64, pool_mb=300.0,
+                    ),
+                    what="bench_wquant",
+                    attempts=3,
+                    base_delay=15.0,
+                )
+            )
         if want("fleet"):
             decode.update(
                 _retry_transport(
@@ -4715,6 +4988,16 @@ def main() -> None:
                 bench_kvquant(
                     model, n_sessions=8, prompt_len=256, new_tokens=64,
                     max_running=4, pool_mb=0.7, chunk=8,
+                )
+            )
+        if want("wquant"):
+            # tiny-model weights are small vs the pool, so the smoke
+            # mostly proves mechanics (wire ratio, commit pause, drift);
+            # the capacity headroom story is the TPU leg's job
+            decode.update(
+                bench_wquant(
+                    model, n_sessions=8, prompt_len=256, new_tokens=64,
+                    max_running=4, pool_mb=0.7, chunk=8, n_push=2,
                 )
             )
         if want("fleet"):

@@ -125,6 +125,20 @@ def test_autoscale_mode_is_pinned():
     )
 
 
+def test_wquant_mode_is_pinned():
+    """ISSUE 16: the int8 weight-serving bench must stay reachable as
+    `--mode wquant` with its wire-bytes-ratio headline — the acceptance
+    proof for producer-side weight quantization (freed HBM -> resident
+    KV capacity, decode tok/s, push wire bytes + commit pause ~2x
+    smaller, drift vs the fp oracle) lives behind this entry point."""
+    bench = _load_bench()
+    assert "wquant" in bench.BENCH_MODE_FNS
+    assert bench.BENCH_MODE_FNS["wquant"] is bench.bench_wquant
+    assert bench.MODE_HEADLINES["wquant"] == (
+        "wquant_wire_bytes_ratio", "x",
+    )
+
+
 def test_every_dev_mode_has_a_headline_metric():
     bench = _load_bench()
     # dev modes = everything but "all" and "train" (those emit the trainer
