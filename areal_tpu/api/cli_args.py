@@ -464,6 +464,28 @@ class JaxDecodeConfig:
     # incomplete push on reconnect (remote_inf_engine.stage_weights).
     # 0 disables the reaper.
     weight_staging_ttl_s: float = 600.0
+    # -- fleet KV fabric (core/kv_fabric.py; ISSUE 17) -------------------
+    # Content-addressed prefix blocks: every complete pool block gets a
+    # chained blake2b key of (token block, parent key, weight_version,
+    # kv_dtype). Enables (1) intra-replica dedup — `_admit` forks from
+    # ANY resident block run with matching content, regardless of which
+    # rid produced it; (2) block-level host-tier lookups beside the
+    # rid-exact resume path; (3) peer fetch — on a router hint, the
+    # server pulls a sibling's matching block run over the /kv_recv +
+    # /kv_commit migration wire instead of re-prefilling. Deduped and
+    # fetched streams are bit-identical to the re-prefill oracle (same
+    # tokens + same weights => same KV bytes; sampling keys are
+    # per-request, not per-block). False restores pre-fabric behavior.
+    kv_fabric: bool = True
+    # cap on content keys published in the /metrics digest (newest-chain
+    # first); bounds the health-poll payload, not the index itself
+    kv_fabric_digest_max: int = 512
+    # minimum matched COMPLETE blocks before a fabric dedup/fetch fires
+    # (tiny matches aren't worth a fork + suffix dispatch)
+    kv_fabric_min_blocks: int = 1
+    # deadline for one peer block fetch (the /kv_fetch round-trip incl.
+    # the pushed frames); on expiry the request degrades to local prefill
+    kv_fabric_fetch_timeout_s: float = 30.0
 
 
 @dataclass
@@ -564,6 +586,18 @@ class RouterConfig:
     # LRU-bounds the qid and prefix maps independently of the TTL.
     route_ttl_s: float = 600.0
     route_max_entries: int = 65536
+    # -- fleet KV fabric ------------------------------------------------
+    # Aggregate the replicas' content-key digests (published through the
+    # existing /metrics poll) into a fleet block index: scheduling prices
+    # remote-fetch vs local-prefill in the marginal-cost model and ships
+    # a {peer, keys} hint so the chosen server fetches the matching block
+    # run from the sibling instead of re-prefilling. False restores
+    # pre-fabric scheduling (and stops shipping hints).
+    kv_fabric: bool = True
+    # relative cost of fetching one remote-held prefix token vs
+    # prefilling it locally (0 = fetch is free, 1 = no better than
+    # prefill); scales the marginal-cost discount for sibling-held blocks
+    kv_fabric_fetch_cost_factor: float = 0.25
 
 
 @dataclass
@@ -631,6 +665,17 @@ class SupervisorConfig:
     # |observed prefill work share - provisioned prefill replica share|
     # must exceed this band before a flip is planned (mix-shift hysteresis)
     rerole_band: float = 0.25
+    # -- fleet KV fabric ------------------------------------------------
+    # Cheap drain: before draining a victim, aggregate the survivors'
+    # content-key digests (router pressure snapshots) and pass them as
+    # `refetchable`; sessions whose blocks the fleet already holds export
+    # META-ONLY (identity + sampling key, no KV bytes — siblings re-fetch
+    # or the resume re-prefills). Warm start: a freshly spawned replica
+    # is told to pre-fetch the fleet's hottest block runs (/warm_start)
+    # before it takes traffic. False disables both fabric integrations.
+    kv_fabric: bool = True
+    # max sessions a cold replica pulls per surviving peer at warm start
+    warm_start_sessions: int = 4
 
 
 @dataclass

@@ -1,0 +1,124 @@
+"""Content-addressed KV block keys for the fleet-global KV fabric
+(ISSUE 17; ROADMAP item 4 — the fleet-wide generalization of the
+SGLang-HiCache single-node tier).
+
+Every complete KV block gets a 64-bit content key:
+
+    key_i = blake2b(key_{i-1} || tokens[i*B:(i+1)*B] || weight_version
+                    || kv_dtype)[:8]
+
+The chaining makes keys POSITION-BINDING: key_i equality between two
+token sequences implies their entire first (i+1) blocks are identical,
+so "this key is resident" means "the whole prefix up to here is
+resident" — a matched run never needs per-block token comparison. The
+weight_version / kv_dtype salts give the staleness contract for free: a
+weight flip or a dtype mismatch changes every key, so stale blocks age
+out as honest misses instead of being served.
+
+Keys are blake2b (not Python ``hash``): deterministic across processes
+and machines, which is the whole point — a replica's digest must mean
+the same thing to the router and to every sibling.
+
+This module is deliberately jax-free (numpy + hashlib only) so the
+router and supervisor import it without dragging in the device stack.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import logging
+import struct
+from typing import Iterable, Sequence
+
+import numpy as np
+
+logger = logging.getLogger("areal_tpu.kv_fabric")
+
+# root parent for block 0 of every chain (any fixed 64-bit constant)
+CHAIN_ROOT = 0x9E3779B97F4A7C15
+
+# hard cap on digest size (keys) regardless of caller-supplied limits —
+# a digest rides inside /metrics JSON and must stay compact
+DIGEST_HARD_CAP = 4096
+
+
+def content_key(
+    parent: int,
+    token_block: Sequence[int],
+    weight_version: int,
+    kv_dtype: str,
+) -> int:
+    """64-bit content key of one block, chained on its parent's key."""
+    h = hashlib.blake2b(digest_size=8)
+    h.update(struct.pack("<Qq", parent & 0xFFFFFFFFFFFFFFFF, int(weight_version)))
+    h.update(kv_dtype.encode())
+    h.update(np.asarray(token_block, dtype=np.uint32).tobytes())
+    return int.from_bytes(h.digest(), "little")
+
+
+def chain_keys(
+    tokens: Sequence[int],
+    block_size: int,
+    weight_version: int,
+    kv_dtype: str,
+    max_blocks: int = 0,
+) -> list[int]:
+    """Chained content keys for every COMPLETE block of `tokens`.
+
+    The trailing partial block (if any) is never keyed — it is not a
+    transferable unit (its pool rows are shared with whatever the owner
+    writes next) and the suffix-prefill path recomputes it anyway.
+    `max_blocks` > 0 caps the chain length (router-side hint hashing).
+    """
+    bs = max(1, int(block_size))
+    nb = len(tokens) // bs
+    if max_blocks > 0:
+        nb = min(nb, max_blocks)
+    keys: list[int] = []
+    parent = CHAIN_ROOT
+    for i in range(nb):
+        parent = content_key(
+            parent, tokens[i * bs : (i + 1) * bs], weight_version, kv_dtype
+        )
+        keys.append(parent)
+    return keys
+
+
+def longest_run(chain: Sequence[int], resident: "set[int] | dict") -> int:
+    """Longest matched prefix run: the largest n such that chain[n-1] is
+    resident. Chaining means matching key n-1 implies blocks 0..n-1 all
+    match — intermediate membership need not be checked."""
+    for n in range(len(chain), 0, -1):
+        if chain[n - 1] in resident:
+            return n
+    return 0
+
+
+def encode_digest(keys: Iterable[int], cap: int = 1024) -> str:
+    """Pack keys into a compact base64 digest (little-endian uint64s).
+
+    Order is caller-meaningful only for hint payloads (a chain run);
+    replica digests are just membership sets. Truncates at `cap` keys
+    (and at DIGEST_HARD_CAP unconditionally)."""
+    cap = min(int(cap), DIGEST_HARD_CAP) if cap > 0 else DIGEST_HARD_CAP
+    arr = np.fromiter(
+        (int(k) & 0xFFFFFFFFFFFFFFFF for k in keys), dtype=np.uint64
+    )[:cap]
+    return base64.b64encode(arr.tobytes()).decode("ascii")
+
+
+def decode_digest(digest: str) -> list[int]:
+    """Inverse of encode_digest; malformed input decodes to []."""
+    if not digest or not isinstance(digest, str):
+        return []
+    try:
+        raw = base64.b64decode(digest.encode("ascii"), validate=True)
+    except Exception as e:  # noqa: BLE001 — a garbled digest is an empty one
+        # peers may be mid-upgrade or corrupt; an unreadable digest just
+        # means "no resident blocks advertised", never an error path
+        logger.debug(f"malformed fabric digest ignored: {e!r}")
+        return []
+    if len(raw) % 8:
+        return []
+    return [int(k) for k in np.frombuffer(raw, dtype=np.uint64)]

@@ -559,6 +559,12 @@ class RemoteInfEngine(InferenceEngine):
                     ),
                 )
                 payload = self.backend.build_generate_payload(work)
+                if sched and sched.get("kv_fabric") and not acc_tokens:
+                    # fleet KV fabric hint: a sibling holds this prompt's
+                    # prefix blocks — the decode server prefetches them
+                    # over the migration wire instead of re-prefilling.
+                    # First submission only; resumes already have live KV.
+                    payload["kv_fabric"] = sched["kv_fabric"]
                 if prefill_url and prefill_url != addr:
                     # first submission only: later resume iterations
                     # continue from KV the decode replica already parks

@@ -398,13 +398,27 @@ def pack_kv_session(
     missing = [f for f in _KV_META_REQUIRED if f not in meta]
     if missing:
         raise ValueError(f"kv session meta missing fields: {missing}")
+    rid = str(meta["rid"])
+    if meta.get("meta_only"):
+        # cheap-drain shape (fleet KV fabric): identity + sampling key
+        # only — the fleet holds the blocks, so the wire carries none
+        if k is not None or v is not None or ks is not None:
+            raise ValueError(
+                f"meta-only kv session {rid!r} must not carry blocks"
+            )
+        mjson = np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode(), dtype=np.uint8
+        )
+        yield from pack_buckets(
+            [(f"{KV_META_PREFIX}{rid}", mjson)], chunk_mb=chunk_mb
+        )
+        return
     if (str(meta.get("kv_dtype", "fp")) == "int8") != (ks is not None):
         raise ValueError(
             "kv session scales must travel iff meta kv_dtype == 'int8' "
             f"(kv_dtype={meta.get('kv_dtype', 'fp')!r}, "
             f"scales={'present' if ks is not None else 'absent'})"
         )
-    rid = str(meta["rid"])
     mjson = np.frombuffer(
         json.dumps(meta, sort_keys=True).encode(), dtype=np.uint8
     )
@@ -436,14 +450,18 @@ def unpack_kv_sessions(
     data_keys = {n for n in staged if n.startswith(KV_DATA_PREFIX)}
     for mk in meta_keys:
         rid = mk[len(KV_META_PREFIX):]
-        kk = f"{KV_DATA_PREFIX}{rid}/k"
-        vk = f"{KV_DATA_PREFIX}{rid}/v"
-        if kk not in staged or vk not in staged:
-            raise ValueError(f"kv session {rid!r} incomplete: missing blocks")
         meta = json.loads(np.asarray(staged[mk], dtype=np.uint8).tobytes())
         missing = [f for f in _KV_META_REQUIRED if f not in meta]
         if missing or str(meta["rid"]) != rid:
             raise ValueError(f"kv session {rid!r} metadata malformed")
+        if meta.get("meta_only"):
+            # cheap-drain session: metadata IS the whole payload
+            out.append((meta, None, None, None))
+            continue
+        kk = f"{KV_DATA_PREFIX}{rid}/k"
+        vk = f"{KV_DATA_PREFIX}{rid}/v"
+        if kk not in staged or vk not in staged:
+            raise ValueError(f"kv session {rid!r} incomplete: missing blocks")
         sk = f"{KV_DATA_PREFIX}{rid}/ks"
         sv = f"{KV_DATA_PREFIX}{rid}/vs"
         scales = None

@@ -78,6 +78,7 @@ from areal_tpu.api.cli_args import (
     JaxDecodeConfig,
 )
 from areal_tpu.api.engine_api import InferenceEngine
+from areal_tpu.core import kv_fabric
 from areal_tpu.api.io_struct import (
     FinetuneSpec,
     ModelRequest,
@@ -131,6 +132,12 @@ _GUARDED_BY = {
     "JaxDecodeEngine._parked_tokens": "_sched_lock",
     "JaxDecodeEngine._prefix_lookup": "_sched_lock",
     "JaxDecodeEngine._slot_prefix": "_sched_lock",
+    # fleet-KV-fabric device index (content key -> donor slot + depth):
+    # mutated wherever the prefix registry is — scheduler admission,
+    # export_session (which holds _sched_lock on the HTTP thread), and
+    # the pause-fenced weight-install invalidation
+    "JaxDecodeEngine._fabric_dev": "_sched_lock",
+    "JaxDecodeEngine._slot_fabric_keys": "_sched_lock",
     "JaxDecodeEngine._patch_slots": "_sched_lock",
     "JaxDecodeEngine._ctl_cache": "_sched_lock",
     "JaxDecodeEngine._ctl_dirty": "_sched_lock",
@@ -174,6 +181,11 @@ _GUARDED_BY = {
     "JaxDecodeEngine._migrated_out_bytes": "_metrics_lock",
     "JaxDecodeEngine._n_migrate_version_rejects": "_metrics_lock",
     "JaxDecodeEngine._n_migrate_dtype_rejects": "_metrics_lock",
+    # fleet-KV-fabric wire accounting: written by import_session /
+    # export_session on the HTTP thread, snapshotted by get_metrics
+    "JaxDecodeEngine._fabric_fetch_bytes": "_metrics_lock",
+    "JaxDecodeEngine._n_fabric_sessions_in": "_metrics_lock",
+    "JaxDecodeEngine._n_meta_only_exports": "_metrics_lock",
     # device buffers swapped under _weight_lock at every mutation site
     # that can race a dispatched chunk
     "JaxDecodeEngine._k_cache": "_weight_lock",
@@ -441,6 +453,31 @@ class JaxDecodeEngine(InferenceEngine):
         # are overwritten (new prefill/fork) or weights change.
         self._prefix_lookup: dict[tuple[int, ...], int] = {}
         self._slot_prefix: list[tuple[int, ...] | None] = []
+        # -- fleet KV fabric (content-addressed block reuse) ------------
+        # Device-side content index over the SAME registrations as
+        # _prefix_lookup, but at pool-block granularity with chained
+        # blake2b keys (core/kv_fabric): key -> (donor slot, depth) where
+        # depth = number of complete blocks the key's chain covers. Lets
+        # _admit match the longest common block run with ANY resident
+        # prefix even when the registrations diverge past it (the
+        # whole-tuple compare of _find_shared_prefix misses those), and
+        # feeds the /metrics digest siblings fetch against.
+        self._fabric_on = bool(getattr(config, "kv_fabric", True))
+        self._fabric_dev: dict[int, tuple[int, int]] = {}
+        self._slot_fabric_keys: dict[int, list[int]] = {}
+        # fabric attribution, split from the rid-resume host hit rate
+        # (scheduler-only writers; get_metrics snapshots racily like the
+        # other admission counters). "remote" = the serving bytes arrived
+        # over the fabric wire (rid "fabric-*"), "local" = deduped from
+        # blocks another local rid produced.
+        self._n_fabric_local_hits = 0
+        self._n_fabric_remote_hits = 0
+        self._fabric_local_tokens_avoided = 0
+        self._fabric_remote_tokens_avoided = 0
+        # wire accounting (HTTP thread; under _metrics_lock)
+        self._fabric_fetch_bytes = 0
+        self._n_fabric_sessions_in = 0
+        self._n_meta_only_exports = 0
         # counters surfaced via get_metrics(): prefill vs prefix-sharing mix
         self._n_prefills = 0
         self._n_prefix_forks = 0
@@ -1950,6 +1987,7 @@ class JaxDecodeEngine(InferenceEngine):
                 copy_async = getattr(arr, "copy_to_host_async", None)
                 if copy_async is not None:
                     copy_async()
+            rd = int(self._slot_rope_delta[slot])
             entry = HostKVEntry(
                 rid=rid,
                 k=hk,
@@ -1960,9 +1998,22 @@ class JaxDecodeEngine(InferenceEngine):
                 nb=nb,
                 covered=int(covered),
                 tokens=list(tokens),
-                rope_delta=int(self._slot_rope_delta[slot]),
+                rope_delta=rd,
                 base_key=np.array(self._slot_keys[slot]),
                 weight_version=int(self._version),
+                # fabric index keys over the COMPLETE blocks (vision
+                # entries excluded: their KV depends on pixel data the
+                # token chain cannot see)
+                block_keys=(
+                    tuple(kv_fabric.chain_keys(
+                        tokens,
+                        self._alloc.block_size,
+                        int(self._version),
+                        str(self.config.kv_dtype),
+                    ))
+                    if self._fabric_on and rd == 0
+                    else ()
+                ),
                 ts=time.monotonic(),
                 pending=True,
             )
@@ -2165,6 +2216,149 @@ class JaxDecodeEngine(InferenceEngine):
                 return slot
         return None
 
+    def _fabric_floor_blocks(self) -> int:
+        """Minimum run length (in blocks) either fabric rung fires at:
+        the module's shared-prefix floor (below it a fresh prefill beats
+        fork + suffix) or the config knob, whichever is larger."""
+        bs = self._alloc.block_size
+        return max(
+            -(-_MIN_SHARED_PREFIX // bs),
+            max(1, int(getattr(self.config, "kv_fabric_min_blocks", 1))),
+        )
+
+    def _fabric_dev_match(
+        self, chain: list[int], covered: int
+    ) -> tuple[int, int] | None:
+        """Device dedup rung: longest content-keyed run some resident
+        slot's registered blocks can donate -> (donor_slot, prefix_len).
+        Chained keys are position-binding, so a key hit at chain[n-1]
+        means the donor's first n blocks hold exactly this request's
+        first n*B tokens — even when the two registrations diverge past
+        the run (the whole-tuple compare of _find_shared_prefix misses
+        those)."""
+        bs = self._alloc.block_size
+        floor = self._fabric_floor_blocks()
+        for n in range(len(chain), floor - 1, -1):
+            plen = n * bs
+            if plen >= covered:
+                # the partial path needs a nonzero suffix to prefill
+                continue
+            hit = self._fabric_dev.get(chain[n - 1])
+            if hit is None:
+                continue
+            slot, depth = hit
+            keys = self._slot_fabric_keys.get(slot)
+            # depth must agree with the chain position (anything else is
+            # a 64-bit collision between different-length prefixes)
+            if (
+                keys is None
+                or depth != n
+                or len(keys) < n
+                or keys[n - 1] != chain[n - 1]
+            ):
+                continue
+            return slot, plen
+        return None
+
+    def _claim_meta_identity(self, item: _Slot) -> None:
+        """A meta-only drained session (cheap drain over the KV fabric)
+        carries identity, not KV: reclaim the original sampling base key
+        so the resumed stream keeps sampling fold_in(original_key,
+        position) — then fall through the normal admission ladder (fabric
+        fetch or an honest re-prefill rebuilds the blocks)."""
+        if self._host_store is None:
+            return
+        try:
+            with self._host_lock:
+                e = self._host_store.peek(item.rid)
+                if e is None or not e.meta_only:
+                    return
+                e = self._host_store.take(item.rid)
+        except Exception as e:  # noqa: BLE001 — degrade, never wedge
+            # injected swap-in fault / torn claim: the resume proceeds as
+            # a fresh request (re-prefill, fresh key) — degraded, never
+            # wedged
+            logger.warning(f"meta-only claim of {item.rid} failed: {e!r}")
+            return
+        if e is not None and item.base_key is None:
+            item.base_key = np.array(e.base_key, dtype=np.uint32)
+
+    def _promote_fabric_blocks(
+        self, item: _Slot, slot_idx: int, chain: list[int], covered: int
+    ) -> int:
+        """Fleet-KV-fabric host rung: seed `slot_idx` with the longest
+        content-keyed block run the host tier holds — offloaded locally
+        by ANY rid, or fetched from a sibling replica over the migration
+        wire — and return the seeded prefix length in tokens (0 = no
+        usable run). The caller re-enters the partial-prefix machinery
+        for the suffix (the fork is a no-op when donor == self). Raises
+        PoolDry when the device pool cannot back the run even after
+        reclaim. Bit-identity: equal content keys mean equal (tokens,
+        weight_version, kv_dtype), and the entry's bytes are the exact
+        bytes a local prefill would have written, so the suffix prefill
+        reads them verbatim. The entry is NOT consumed — it keeps serving
+        later matches (peek semantics, unlike the rid-resume take)."""
+        if self._host_store is None or not chain:
+            return 0
+        bs = self._alloc.block_size
+        floor = self._fabric_floor_blocks()
+        # keep a nonzero suffix: the run may cover at most covered-1 toks
+        max_n = min(len(chain), (covered - 1) // bs)
+        if max_n < floor:
+            return 0
+        with self._host_lock:
+            m = self._host_store.match_blocks(
+                chain[:max_n], min_blocks=floor
+            )
+        if m is None:
+            return 0
+        entry, n = m
+        plen = n * bs
+        t0 = time.monotonic()
+        try:
+            self._unregister_prefix(slot_idx)
+            self._alloc.free_slot(slot_idx)
+            self._slot_lengths[slot_idx] = 0
+            if not self._ensure_tokens(slot_idx, plen):
+                raise PoolDry("no device blocks for fabric promotion")
+            fn = self._get_host_upload_fn()
+            hk = jnp.asarray(np.asarray(entry.k)[:, :n])
+            hv = jnp.asarray(np.asarray(entry.v)[:, :n])
+            if entry.ks is not None:
+                hk = (hk, jnp.asarray(np.asarray(entry.ks)[:, :n]))
+                hv = (hv, jnp.asarray(np.asarray(entry.vs)[:, :n]))
+            with self._weight_lock:
+                kq, vq = self._kv_operands()
+                self._set_kv_operands(*fn(
+                    kq,
+                    vq,
+                    jnp.asarray(self._alloc.row(slot_idx, n)),
+                    hk,
+                    hv,
+                ))
+        except PoolDry:
+            raise
+        except Exception as e:  # noqa: BLE001 — degrade, never wedge
+            # upload died (unreadable host bytes, injected fault): treat
+            # as a fabric miss — the request pays the prefill the fabric
+            # would have skipped, bit-identically
+            self._n_promote_failures += 1
+            logger.warning(f"fabric block promotion failed: {e!r}")
+            return 0
+        self._slot_rope_delta[slot_idx] = 0
+        self._register_prefix(slot_idx, [int(t) for t in entry.tokens[:plen]])
+        if entry.rid.startswith("fabric-"):
+            self._n_fabric_remote_hits += 1
+            self._fabric_remote_tokens_avoided += plen
+        else:
+            self._n_fabric_local_hits += 1
+            self._fabric_local_tokens_avoided += plen
+        dt = time.monotonic() - t0
+        with self._metrics_lock:
+            self._ttft_transfer_ms.append(dt * 1000.0)
+            self._transfer_secs_total += dt
+        return plen
+
     # -- prefix-KV registry --------------------------------------------
     def _unregister_prefix(self, slot_idx: int) -> None:
         key = self._slot_prefix[slot_idx]
@@ -2172,6 +2366,9 @@ class JaxDecodeEngine(InferenceEngine):
             self._slot_prefix[slot_idx] = None
             if self._prefix_lookup.get(key) == slot_idx:
                 self._prefix_lookup.pop(key, None)
+        for fk in self._slot_fabric_keys.pop(slot_idx, ()):
+            if self._fabric_dev.get(fk, (None, 0))[0] == slot_idx:
+                del self._fabric_dev[fk]
 
     def _register_prefix(self, slot_idx: int, covered: list[int]) -> None:
         self._unregister_prefix(slot_idx)
@@ -2180,6 +2377,30 @@ class JaxDecodeEngine(InferenceEngine):
         key = tuple(covered)
         self._slot_prefix[slot_idx] = key
         self._prefix_lookup[key] = slot_idx
+        # mirror the registration into the fabric's content index —
+        # complete blocks only; vision slots (rope_delta != 0) are
+        # excluded because their KV depends on pixel data the token
+        # chain cannot see
+        if (
+            self._fabric_on
+            and self._alloc is not None
+            and (
+                self._slot_rope_delta is None
+                or int(self._slot_rope_delta[slot_idx]) == 0
+            )
+        ):
+            fks = kv_fabric.chain_keys(
+                covered,
+                self._alloc.block_size,
+                int(self._version),
+                str(self.config.kv_dtype),
+            )
+            if fks:
+                self._slot_fabric_keys[slot_idx] = fks
+                for i, fk in enumerate(fks):
+                    # first writer wins: identical keys mean identical
+                    # bytes, any one resident copy serves
+                    self._fabric_dev.setdefault(fk, (slot_idx, i + 1))
 
     def _invalidate_prefixes(self) -> None:
         """Weight installs recompute nothing in place: any KV produced by
@@ -2193,6 +2414,10 @@ class JaxDecodeEngine(InferenceEngine):
                 self._slot_lengths[i] = 0
         self._prefix_lookup.clear()
         self._slot_prefix = [None] * len(self._slot_prefix)
+        # content keys are salted with the weight version, so post-install
+        # chains could never match these — clear rather than leak
+        self._fabric_dev.clear()
+        self._slot_fabric_keys.clear()
 
     # -- scheduler ------------------------------------------------------
     def _free_slots(self) -> list[int]:
@@ -2378,6 +2603,12 @@ class JaxDecodeEngine(InferenceEngine):
                 if P > 1
                 else 0
             )
+            # Meta-only drained sessions (cheap drain over the KV fabric)
+            # surrender their sampling identity here, then fall through
+            # the ladder like a fresh request — fabric blocks or an
+            # honest prefill rebuild the KV.
+            if P > 1:
+                self._claim_meta_identity(item)
             # Host-tier peek FIRST: an exact offloaded match means this
             # resume needs neither prefill work nor a donor fork — the
             # original KV bytes come back from host RAM (bit-identical,
@@ -2400,10 +2631,29 @@ class JaxDecodeEngine(InferenceEngine):
             # re-submit shared history + a short new suffix). Fork the
             # shared rows, prefill only the suffix.
             partial = None
+            partial_fabric = False
             covered_t = tuple(prompt[:-1]) if P > 1 else ()
             is_wave_dup = (
                 P > 1 and not item.image_data and covered_t in wave_primaries
             )
+            # content chain of the covered prefix (fleet KV fabric):
+            # consulted by the device dedup rung below and the host-tier
+            # block rung at slot-assignment time
+            req_chain: list[int] = []
+            if (
+                self._fabric_on
+                and donor is None
+                and P > 1
+                and not item.image_data
+                and not is_wave_dup
+                and not host_hit
+            ):
+                req_chain = kv_fabric.chain_keys(
+                    prompt[:-1],
+                    self._alloc.block_size,
+                    int(self._version),
+                    str(self.config.kv_dtype),
+                )
             if (
                 donor is None
                 and P > 1
@@ -2412,6 +2662,12 @@ class JaxDecodeEngine(InferenceEngine):
                 and not host_hit
             ):
                 found = self._find_shared_prefix(covered_t)
+                if found is None and req_chain:
+                    # fabric dedup rung: longest common block-aligned run
+                    # with ANY resident registration, even one whose tail
+                    # diverges from this prompt
+                    found = self._fabric_dev_match(req_chain, P - 1)
+                    partial_fabric = found is not None
                 if found is not None:
                     donor_slot, plen = found
                     suffix_bucket = min(
@@ -2501,6 +2757,34 @@ class JaxDecodeEngine(InferenceEngine):
                         f"host-KV promotion of {item.rid} failed: {e!r}"
                     )
                     promoted = False
+            if (
+                resumed is None
+                and not promoted
+                and donor is None
+                and partial is None
+                and not is_wave_dup
+                and req_chain
+            ):
+                # fabric host rung: a content-keyed run offloaded by ANY
+                # rid — or fetched from a sibling over the migration wire
+                # — seeds this slot; the suffix re-runs through the
+                # partial machinery below (the fork is a no-op when
+                # donor == self)
+                try:
+                    fplen = self._promote_fabric_blocks(
+                        item, slot_idx, req_chain, P - 1
+                    )
+                except PoolDry:
+                    self._overflow.insert(0, item)
+                    break
+                if fplen > 0:
+                    sb = min(
+                        _pow2_bucket(P - 1 - fplen),
+                        self.config.context_length,
+                    )
+                    if fplen + sb <= self.config.context_length:
+                        partial = (slot_idx, fplen, sb)
+                        partial_fabric = False  # already attributed
             if resumed is None and P > 1 and not promoted and donor is not None:
                 # Prefix-KV hit (the GRPO group case: group_size requests
                 # share one prompt). The donor slot's blocks [0, P-1)
@@ -2540,6 +2824,11 @@ class JaxDecodeEngine(InferenceEngine):
                 prefill_budget -= sb
                 did_prefill = True
                 self._n_suffix_prefills += 1
+                if partial_fabric:
+                    # device dedup rung attribution: blocks another local
+                    # rid produced served this prefix
+                    self._n_fabric_local_hits += 1
+                    self._fabric_local_tokens_avoided += plen
                 # one prefix bucket for BOTH the fork and the suffix fn's
                 # prefix slice, so they can never drift apart
                 pb = min(_pow2_bucket(plen), self.config.context_length)
@@ -4006,11 +4295,41 @@ class JaxDecodeEngine(InferenceEngine):
                     )
         return rids
 
-    def export_session(self, rid: str) -> dict | None:
+    def _refetchable_meta(
+        self,
+        refetchable: "set[int] | None",
+        tokens: list[int],
+        weight_version: int,
+        kv_dtype: str,
+        rope_delta: int,
+    ) -> bool:
+        """Cheap-drain predicate: every COMPLETE block of this session is
+        content-addressed and resident somewhere in the surviving fleet
+        (`refetchable` = union of the survivors' digests), so the session
+        can travel as metadata alone — the importing replica's resume
+        re-fetches the blocks on demand and suffix-prefills the trailing
+        partial block."""
+        if not refetchable or not self._fabric_on or rope_delta != 0:
+            return False
+        keys = kv_fabric.chain_keys(
+            tokens, self._alloc.block_size, weight_version, kv_dtype
+        )
+        return bool(keys) and all(k_ in refetchable for k_ in keys)
+
+    def export_session(
+        self, rid: str, refetchable: "set[int] | None" = None
+    ) -> dict | None:
         """MOVE one session's resumable KV out of this engine: returns
         {"meta": <HostKVEntry contract dict>, "k": np, "v": np} — plus
         "ks"/"vs" scale arrays when the pool is int8 — or None when the
         rid holds no exportable session.
+
+        `refetchable` (cheap drain over the KV fabric): content keys the
+        surviving fleet can serve. A session whose complete blocks are
+        all refetchable exports as metadata alone ({"meta": {...,
+        "meta_only": true}}, no KV bytes on the wire) — the importing
+        replica restores the sampling identity and rebuilds the blocks
+        via fabric fetch or an honest suffix prefill.
 
         Parked sessions: the covering pool blocks are gathered to host
         and the parked entry is dropped — but the blocks stay registered
@@ -4045,6 +4364,34 @@ class JaxDecodeEngine(InferenceEngine):
                         or nb > int(self._alloc.nblocks[slot])
                     ):
                         return None
+                    if self._refetchable_meta(
+                        refetchable,
+                        tokens,
+                        int(self._version),
+                        str(self.config.kv_dtype),
+                        int(self._slot_rope_delta[slot]),
+                    ):
+                        meta = dict(
+                            rid=rid,
+                            covered=int(covered),
+                            tokens=[int(t) for t in tokens],
+                            rope_delta=0,
+                            base_key=[
+                                int(x)
+                                for x in np.asarray(self._slot_keys[slot])
+                            ],
+                            weight_version=int(self._version),
+                            nb=int(nb),
+                            kv_dtype=self.config.kv_dtype,
+                            meta_only=True,
+                        )
+                        self._parked.pop(rid, None)
+                        self._parked_tokens.pop(rid, None)
+                        self._register_prefix(slot, tokens)
+                        with self._metrics_lock:
+                            self._n_migrated_out += 1
+                            self._n_meta_only_exports += 1
+                        return dict(meta=meta)
                     fn = self._get_host_gather_fn()
                     with self._weight_lock:
                         kq, vq = self._kv_operands()
@@ -4099,6 +4446,18 @@ class JaxDecodeEngine(InferenceEngine):
                     nb=int(entry.nb),
                     kv_dtype=str(entry.kv_dtype),
                 )
+                if entry.meta_only or self._refetchable_meta(
+                    refetchable,
+                    [int(t) for t in entry.tokens],
+                    int(entry.weight_version),
+                    str(entry.kv_dtype),
+                    int(entry.rope_delta),
+                ):
+                    meta["meta_only"] = True
+                    with self._metrics_lock:
+                        self._n_migrated_out += 1
+                        self._n_meta_only_exports += 1
+                    return dict(meta=meta)
                 out = dict(
                     meta=meta, k=np.asarray(entry.k), v=np.asarray(entry.v)
                 )
@@ -4119,6 +4478,154 @@ class JaxDecodeEngine(InferenceEngine):
             # never the caller's thread
             logger.warning(f"kv export of {rid} failed: {e!r}")
             return None
+
+    def export_fabric_blocks(
+        self, keys: "list[int] | None" = None, top: int = 0
+    ) -> list[dict]:
+        """Serve the fleet KV fabric: COPY content-keyed block runs out of
+        this replica (unlike export_session's move — nothing local is
+        dropped). Two modes, combinable:
+
+        `keys`: a content chain (block 0 first). The longest run this
+        replica can serve — device-registered blocks first, host tier
+        second — exports as one session whose meta carries fabric=True
+        and a content-derived rid ("fabric-<last key>"). `top`: the k
+        longest resident chains regardless of keys (a cold sibling's
+        warm start).
+
+        Returns a list of session dicts shaped like export_session's
+        output; empty when nothing matches. Safe from the HTTP thread:
+        the whole resolution + gather runs under _sched_lock (and the
+        mesh scope), so a racing weight install cannot tear a chain."""
+        from areal_tpu.ops.kv_quant import split_pool
+
+        if not self._fabric_on or self._alloc is None:
+            return []
+        out: list[dict] = []
+        seen: set[str] = set()
+
+        def resolve_locked(chain: list[int]) -> dict | None:
+            bs = self._alloc.block_size
+            # device rung: longest n with chain[n-1] registered
+            for n in range(len(chain), 0, -1):
+                hit = self._fabric_dev.get(chain[n - 1])
+                if hit is None:
+                    continue
+                slot, depth = hit
+                fks = self._slot_fabric_keys.get(slot)
+                toks = self._slot_prefix[slot]
+                if (
+                    fks is None
+                    or toks is None
+                    or depth != n
+                    or len(fks) < n
+                    or fks[n - 1] != chain[n - 1]
+                    or len(toks) < n * bs
+                ):
+                    continue
+                fn = self._get_host_gather_fn()
+                with self._weight_lock:
+                    kq, vq = self._kv_operands()
+                    hkq, hvq = fn(
+                        kq, vq, jnp.asarray(self._alloc.row(slot, n))
+                    )
+                hk, hks = split_pool(hkq)
+                hv, hvs = split_pool(hvq)
+                meta = dict(
+                    rid=f"fabric-{chain[n - 1] & 0xFFFFFFFFFFFFFFFF:016x}",
+                    covered=n * bs,
+                    tokens=[int(t) for t in toks[: n * bs]],
+                    rope_delta=0,
+                    # fabric sessions are never resumed by rid — the
+                    # sampling identity travels with meta-only sessions,
+                    # not with block runs
+                    base_key=[0, 0],
+                    weight_version=int(self._version),
+                    nb=n,
+                    kv_dtype=str(self.config.kv_dtype),
+                    fabric=True,
+                )
+                sess = dict(meta=meta, k=np.asarray(hk), v=np.asarray(hv))
+                if hks is not None:
+                    sess["ks"] = np.asarray(hks)
+                    sess["vs"] = np.asarray(hvs)
+                return sess
+            # host rung
+            with self._host_lock:
+                store = self._host_store
+                m = (
+                    store.match_blocks(chain)
+                    if store is not None
+                    else None
+                )
+                if m is None:
+                    return None
+                entry, n = m
+                hk = np.asarray(entry.k)[:, :n].copy()
+                hv = np.asarray(entry.v)[:, :n].copy()
+                hks = (
+                    np.asarray(entry.ks)[:, :n].copy()
+                    if entry.ks is not None
+                    else None
+                )
+                hvs = (
+                    np.asarray(entry.vs)[:, :n].copy()
+                    if entry.vs is not None
+                    else None
+                )
+                meta = dict(
+                    rid=f"fabric-{chain[n - 1] & 0xFFFFFFFFFFFFFFFF:016x}",
+                    covered=n * bs,
+                    tokens=[int(t) for t in entry.tokens[: n * bs]],
+                    rope_delta=0,
+                    base_key=[0, 0],
+                    weight_version=int(entry.weight_version),
+                    nb=n,
+                    kv_dtype=str(entry.kv_dtype),
+                    fabric=True,
+                )
+            sess = dict(meta=meta, k=hk, v=hv)
+            if hks is not None:
+                sess["ks"] = hks
+                sess["vs"] = hvs
+            return sess
+
+        try:
+            with mesh_lib.mesh_scope(self.mesh), self._sched_lock:
+                chains: list[list[int]] = []
+                if keys:
+                    chains.append([int(x) for x in keys])
+                if top > 0:
+                    # k longest resident chains: device registrations
+                    # first, then host-tier entries' complete blocks
+                    cand = [
+                        list(fks)
+                        for fks in self._slot_fabric_keys.values()
+                    ]
+                    with self._host_lock:
+                        if self._host_store is not None:
+                            for r in self._host_store.rids():
+                                e = self._host_store.peek(r)
+                                if e is not None and e.block_keys:
+                                    cand.append(list(e.block_keys))
+                    cand.sort(key=len, reverse=True)
+                    chains.extend(cand[: int(top)])
+                budget = max(len(chains), 1)
+                for chain in chains:
+                    if len(out) >= budget:
+                        break
+                    if not chain:
+                        continue
+                    sess = resolve_locked(chain)
+                    if sess is None or sess["meta"]["rid"] in seen:
+                        continue
+                    seen.add(sess["meta"]["rid"])
+                    out.append(sess)
+        except Exception as e:  # noqa: BLE001 — degrade, never wedge
+            # a failed fabric export costs the requester a re-prefill,
+            # never this replica's HTTP thread
+            logger.warning(f"fabric block export failed: {e!r}")
+        return out
 
     def _ensure_host_store_locked(self, block_size: int) -> None:
         """Caller holds _host_lock. A decode-role replica without an
@@ -4165,13 +4672,48 @@ class JaxDecodeEngine(InferenceEngine):
             wv = int(meta.get("weight_version", -1))
             sess_dtype = str(meta.get("kv_dtype", "fp"))
             base_key = np.asarray(meta["base_key"], dtype=np.uint32)
-            k = np.asarray(k)
-            v = np.asarray(v)
+            meta_only = bool(meta.get("meta_only"))
+            if not meta_only:
+                k = np.asarray(k)
+                v = np.asarray(v)
             ks = None if ks is None else np.asarray(ks)
             vs = None if vs is None else np.asarray(vs)
         except (KeyError, TypeError, ValueError):
             return "rejected"
         L, _, bs, nkv, hd = self._k_cache.shape
+        if meta_only:
+            # cheap-drain session (fleet KV fabric): identity only — the
+            # resume claims the sampling base key and rebuilds the blocks
+            # via fabric fetch or an honest prefill. No version/dtype
+            # gate: the identity is weight-independent.
+            if (
+                covered <= 0
+                or len(tokens) != covered
+                or base_key.shape != (2,)
+            ):
+                return "rejected"
+            entry = HostKVEntry(
+                rid=rid,
+                k=None,
+                v=None,
+                kv_dtype=sess_dtype,
+                nb=nb,
+                covered=covered,
+                tokens=tokens,
+                rope_delta=int(meta.get("rope_delta", 0)),
+                base_key=base_key,
+                weight_version=wv,
+                ts=time.monotonic(),
+                pending=False,
+            )
+            with self._host_lock:
+                self._ensure_host_store_locked(bs)
+                ok = self._host_store.put(entry)
+            if not ok:
+                return "rejected"
+            with self._metrics_lock:
+                self._n_migrated_in += 1
+            return "ok"
         if (
             k.shape != (L, nb, bs, nkv, hd)
             or v.shape != k.shape
@@ -4220,6 +4762,7 @@ class JaxDecodeEngine(InferenceEngine):
                 f"{self._version}"
             )
             return "stale_version"
+        rd = int(meta.get("rope_delta", 0))
         entry = HostKVEntry(
             rid=rid,
             k=k,
@@ -4230,9 +4773,21 @@ class JaxDecodeEngine(InferenceEngine):
             nb=nb,
             covered=covered,
             tokens=tokens,
-            rope_delta=int(meta.get("rope_delta", 0)),
+            rope_delta=rd,
             base_key=base_key,
             weight_version=wv,
+            # index the imported blocks into the fabric, so they serve
+            # content-keyed runs to ANY local rid (and re-publish in this
+            # replica's digest). Salted with the SESSION's version: a
+            # stale import never got this far (rejected above), a legacy
+            # wv=-1 simply never matches a current chain.
+            block_keys=(
+                tuple(kv_fabric.chain_keys(
+                    tokens, bs, wv, sess_dtype
+                ))
+                if self._fabric_on and rd == 0
+                else ()
+            ),
             ts=time.monotonic(),
             pending=False,
         )
@@ -4245,8 +4800,15 @@ class JaxDecodeEngine(InferenceEngine):
             a.nbytes for a in (ks, vs) if a is not None
         )
         with self._metrics_lock:
-            self._n_migrated_in += 1
-            self._migrated_in_bytes += nbytes
+            if meta.get("fabric"):
+                # fabric block fetch, not a session migration: attribute
+                # the wire bytes to the fabric so the migration counters
+                # keep meaning whole-session moves
+                self._n_fabric_sessions_in += 1
+                self._fabric_fetch_bytes += nbytes
+            else:
+                self._n_migrated_in += 1
+                self._migrated_in_bytes += nbytes
         return "ok"
 
     # -- weight updates -------------------------------------------------
@@ -4613,11 +5175,30 @@ class JaxDecodeEngine(InferenceEngine):
             migrated_out_bytes = self._migrated_out_bytes
             migrate_version_rejects = self._n_migrate_version_rejects
             migrate_dtype_rejects = self._n_migrate_dtype_rejects
+            fabric_fetch_bytes = self._fabric_fetch_bytes
+            fabric_sessions_in = self._n_fabric_sessions_in
+            meta_only_exports = self._n_meta_only_exports
         # host-KV-tier snapshot (own lock — rank 25, before _metrics at
         # 30): occupancy + swap traffic are the pressure signals the
         # prefix-aware router will route on, next to
         # kv_pool_fragmentation / prefix_cache_hit_rate below
+        # fleet-KV-fabric digest: the content keys this replica can SERVE
+        # (device-registered runs + host-tier blocks), published through
+        # the health poll so siblings fetch instead of re-prefilling.
+        # The device index is read lock-free like _slots above (scheduler
+        # owns the writes; a resize mid-iteration just retries) — taking
+        # _sched_lock here would stall /metrics behind a long prefill.
+        fabric_keys_all: list[int] = []
+        if self._fabric_on:
+            for _ in range(3):
+                try:
+                    fabric_keys_all = list(self._fabric_dev)
+                    break
+                except RuntimeError:
+                    continue
         with self._host_lock:
+            if self._fabric_on and self._host_store is not None:
+                fabric_keys_all.extend(self._host_store.fabric_keys())
             hs = self._host_store
             # NOTE: `if hs` would be False for an EMPTY store (__len__)
             if hs is not None:
@@ -4803,8 +5384,41 @@ class JaxDecodeEngine(InferenceEngine):
             "kv_host_hit_rate": (
                 round(host["hits"] / host_lookups, 6) if host_lookups else 0.0
             ),
-            # prompt+generated tokens whose prefill the host tier skipped
-            "reprefill_tokens_avoided_total": host["avoided"],
+            # -- fleet KV fabric (content-addressed block reuse) -------
+            # hit attribution is deliberately SEPARATE from the
+            # rid-resume counters above: a block-run match must not
+            # inflate kv_host_hit_rate (satellite of ISSUE 17)
+            "kv_fabric_enabled": self._fabric_on,
+            "kv_fabric_local_hits_total": self._n_fabric_local_hits,
+            "kv_fabric_remote_hits_total": self._n_fabric_remote_hits,
+            "kv_fabric_local_tokens_avoided_total": (
+                self._fabric_local_tokens_avoided
+            ),
+            "kv_fabric_remote_tokens_avoided_total": (
+                self._fabric_remote_tokens_avoided
+            ),
+            "kv_fabric_fetch_bytes_total": fabric_fetch_bytes,
+            "kv_fabric_sessions_in_total": fabric_sessions_in,
+            "kv_fabric_meta_only_exports_total": meta_only_exports,
+            "kv_fabric_blocks_resident": len(
+                dict.fromkeys(fabric_keys_all)
+            ),
+            "kv_fabric_digest": (
+                kv_fabric.encode_digest(
+                    dict.fromkeys(fabric_keys_all),
+                    cap=int(getattr(self.config, "kv_fabric_digest_max", 512)),
+                )
+                if self._fabric_on
+                else ""
+            ),
+            # prompt+generated tokens whose prefill was skipped, by ANY
+            # reuse tier: rid-exact host resumes plus fabric block runs
+            # (local dedup + remote fetch)
+            "reprefill_tokens_avoided_total": (
+                host["avoided"]
+                + self._fabric_local_tokens_avoided
+                + self._fabric_remote_tokens_avoided
+            ),
             # dirty-tracked block-table uploads: chunks_dispatched_total -
             # this = steady-state dispatches that skipped the copy+upload
             "block_table_uploads_total": table_uploads,

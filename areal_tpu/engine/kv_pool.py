@@ -219,20 +219,33 @@ class HostKVEntry:
     # which pool scheme produced k/v ("fp" | "int8"); migration rejects a
     # mismatch with the receiving engine as a tombstoned honest miss
     kv_dtype: str = "fp"
+    # Fleet-KV-fabric content keys of the entry's COMPLETE blocks
+    # (core/kv_fabric.chain_keys over `tokens` at the pool block size,
+    # salted with weight_version/kv_dtype). Indexed by the store so
+    # `match_blocks` can serve a matching prefix run to ANY request,
+    # regardless of rid. Empty when the fabric is off (legacy entries).
+    block_keys: tuple[int, ...] = ()
     ts: float = 0.0
     nbytes: int = 0
     pending: bool = field(default=False, repr=False)
 
+    @property
+    def meta_only(self) -> bool:
+        """Identity-only entry (cheap drain): resume metadata without KV
+        bytes — the blocks are re-fetchable from the fleet or recomputed
+        by an honest prefill. Never serves block matches."""
+        return self.k is None
+
     def materialize(self) -> None:
         """Finish the device→host copy (blocks only if still in flight)
         and drop the device references."""
-        if self.pending:
+        if self.pending and self.k is not None:
             self.k = np.asarray(self.k)
             self.v = np.asarray(self.v)
             if self.ks is not None:
                 self.ks = np.asarray(self.ks)
                 self.vs = np.asarray(self.vs)
-            self.pending = False
+        self.pending = False
 
 
 class HostKVStore:
@@ -283,6 +296,11 @@ class HostKVStore:
         # `pending_window` are outstanding (iter_prefetched's shape)
         self._pending: list[str] = []
         self._pending_window = max(int(pending_window), 0)
+        # fleet-KV-fabric block index: content key -> (rid, ordinal) of a
+        # resident entry holding that block. First writer wins (identical
+        # keys mean identical bytes, so any one copy serves); meta-only
+        # entries are never indexed (no bytes to serve).
+        self._block_index: dict[int, tuple[str, int]] = {}
         # counters (engine snapshots under its _host_lock)
         self.swap_out_bytes_total = 0
         self.swap_in_bytes_total = 0
@@ -323,11 +341,24 @@ class HostKVStore:
         while len(self._tombstones) > self._tombstone_cap:
             self._tombstones.popitem(last=False)
 
+    def _unindex(self, e: HostKVEntry) -> None:
+        for key in e.block_keys:
+            owner = self._block_index.get(key)
+            if owner is not None and owner[0] == e.rid:
+                del self._block_index[key]
+
+    def _index(self, e: HostKVEntry) -> None:
+        if e.meta_only:
+            return
+        for i, key in enumerate(e.block_keys):
+            self._block_index.setdefault(key, (e.rid, i))
+
     def _drop(self, rid: str, tombstone: bool) -> None:
         e = self._entries.pop(rid, None)
         if e is None:
             return
         self.bytes_used -= e.nbytes
+        self._unindex(e)
         if rid in self._pending:
             self._pending.remove(rid)
         if tombstone:
@@ -351,7 +382,14 @@ class HostKVStore:
         # D2H offload seam: an abort models the host copy failing — the
         # engine catches it and degrades to drop-and-reprefill
         fault_injection.fire("kv.swap_out", rid=entry.rid)
-        entry.nbytes = entry.nb * self.block_nbytes
+        # meta-only entries (cheap drain) carry identity, not KV: charge a
+        # nominal token-list footprint so they LRU out under pressure
+        # without competing with real block bytes
+        entry.nbytes = (
+            entry.nb * self.block_nbytes
+            if not entry.meta_only
+            else 64 + 4 * len(entry.tokens)
+        )
         if entry.nbytes > self.budget_bytes:
             # tombstoned: this rid's resume will look here and must count
             # as an honest miss (the KV is about to be dropped)
@@ -366,6 +404,7 @@ class HostKVStore:
         self._entries[entry.rid] = entry
         self._entries.move_to_end(entry.rid)
         self.bytes_used += entry.nbytes
+        self._index(entry)
         if entry.pending:
             self._pending.append(entry.rid)
             self._drain_pending(self._pending_window)
@@ -393,6 +432,11 @@ class HostKVStore:
             if rid in self._tombstones:
                 del self._tombstones[rid]
                 self.misses += 1
+            return False
+        if e.meta_only:
+            # identity-only (cheap drain): no bytes to promote — the
+            # engine claims the sampling key separately and rebuilds the
+            # blocks via fabric fetch or an honest re-prefill
             return False
         if (
             weight_version is not None
@@ -425,6 +469,7 @@ class HostKVStore:
         if e is None:
             return None
         self.bytes_used -= e.nbytes
+        self._unindex(e)
         if rid in self._pending:
             self._pending.remove(rid)
         e.materialize()
@@ -439,7 +484,42 @@ class HostKVStore:
         """Undo a `take` whose promotion could not get device blocks."""
         self.bytes_used += entry.nbytes
         self._entries[entry.rid] = entry
+        self._index(entry)
         self._entries.move_to_end(entry.rid, last=False)  # retry soon: MRU-protect others
+
+    # -- fleet KV fabric (content-addressed block lookups) --------------
+    def peek(self, rid: str) -> HostKVEntry | None:
+        """Entry by rid without counters or LRU movement (the engine
+        inspects meta-only drained entries before deciding the ladder)."""
+        return self._entries.get(rid)
+
+    def match_blocks(
+        self, chain: list[int], min_blocks: int = 1
+    ) -> tuple[HostKVEntry, int] | None:
+        """Longest content-keyed prefix run the store can serve: the
+        largest n with chain[n-1] indexed -> (entry, n). Chained keys are
+        position-binding, so a key match at position n-1 implies the
+        entry's first n blocks hold exactly the request's first n*B
+        tokens — no token comparison needed. No hit/miss counting here:
+        fabric attribution is the engine's (a block match must not
+        inflate the rid-resume hit rate)."""
+        for n in range(len(chain), max(0, min_blocks - 1), -1):
+            owner = self._block_index.get(chain[n - 1])
+            if owner is None:
+                continue
+            rid, ordinal = owner
+            e = self._entries.get(rid)
+            # ordinal must agree with the chain position (anything else
+            # is a 64-bit collision between different-length prefixes)
+            if e is None or e.meta_only or ordinal != n - 1 or e.nb < n:
+                continue
+            e.materialize()
+            return e, n
+        return None
+
+    def fabric_keys(self) -> list[int]:
+        """Resident (serveable) content keys, for the /metrics digest."""
+        return list(self._block_index)
 
     # -- lifecycle ------------------------------------------------------
     def flush_pending(self) -> None:
@@ -453,4 +533,5 @@ class HostKVStore:
         for rid in list(self._entries):
             self._drop(rid, tombstone=True)
         self._pending.clear()
+        self._block_index.clear()
         return n

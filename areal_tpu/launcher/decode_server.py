@@ -81,7 +81,7 @@ from areal_tpu.api.cli_args import (
     JaxDecodeConfig,
 )
 from areal_tpu.api.io_struct import ModelRequest, WeightUpdateMeta
-from areal_tpu.core import fault_injection
+from areal_tpu.core import fault_injection, kv_fabric
 from areal_tpu.utils import logging, names
 from areal_tpu.utils import name_resolve
 
@@ -196,6 +196,22 @@ class DecodeServer:
         # double-exporting the same sessions. Claimed with no await after
         # the done-check, so the check-and-set is event-loop-atomic.
         self._drain_inflight: asyncio.Future | None = None
+        # -- fleet KV fabric (ISSUE 17) ---------------------------------
+        # Outbound-fetch dedup: concurrent /generate's carrying the same
+        # router hint await ONE peer fetch instead of each pulling the
+        # same blocks (event-loop-atomic claim, like _idem). Stats merge
+        # into /metrics under "kv_fabric".
+        self._fabric_inflight: dict[str, asyncio.Future] = {}
+        self._fabric_stats = dict(
+            fetch_attempts=0,
+            fetch_sessions=0,
+            fetch_bytes=0,
+            fetch_failures=0,
+            serve_sessions=0,
+            serve_bytes=0,
+            warm_start_sessions=0,
+            warm_start_bytes=0,
+        )
 
     # -- handlers -------------------------------------------------------
     async def _health(self, request: web.Request) -> web.Response:
@@ -279,6 +295,11 @@ class DecodeServer:
                 "t": time.monotonic(),
             }
             self._idem[xid] = ent
+        hint = body.get("kv_fabric")
+        if hint and getattr(self.config, "kv_fabric", True):
+            # router says a sibling holds this prefix: pull the block
+            # runs into the host tier before admission looks for them
+            await self._fabric_prefetch(hint)
         req = ModelRequest(
             rid=body.get("rid") or ModelRequest().rid,
             input_ids=[int(t) for t in body["input_ids"]],
@@ -350,6 +371,13 @@ class DecodeServer:
             self._migrate_stats,
             staging_xids=len(self._kv_staging),
             done_xids=len(self._kv_done),
+        )
+        # fleet KV fabric (server side): prefetches issued/served, bytes
+        # moved, failures (each one a degraded-to-local-prefill), and
+        # warm-start pulls. Engine-side kv_fabric_* counters (hits,
+        # tokens avoided, digest) are already in `out`.
+        out["kv_fabric"] = dict(
+            self._fabric_stats, inflight=len(self._fabric_inflight)
         )
         return web.json_response(out)
 
@@ -624,34 +652,27 @@ class DecodeServer:
             victim, _ = self._kv_staging.popitem(last=False)
             logger.warning(f"kv staging {victim} dropped (map full)")
 
-    async def _migrate_session_out(
-        self, target: str, rid: str, xid: str, retries: int = 2
+    async def _stream_kv(
+        self,
+        target: str,
+        sess: dict[str, Any],
+        rid: str,
+        xid: str,
+        retries: int = 2,
     ) -> dict[str, Any] | None:
-        """Export `rid` and stream it to `target` under delivery id `xid`.
-
-        The export MOVES the session out of this engine first; a transfer
-        that fails past its replay budget therefore degrades to a
-        re-prefill on whichever replica the session resumes on — never a
-        wedged handler. The budget is two full-stream replays (same xid):
-        a mid-transfer sender death and a torn frame are INDEPENDENT
-        failures, and a budget of one means any two of them composing on
-        one session silently downgrades the handoff to a re-prefill.
-        Re-sent frames interval-merge and the commit is idempotent, so
-        however many replays run, the handoff lands exactly once."""
+        """Stream one exported session dict to `target` under delivery id
+        `xid` (frames -> /kv_recv -> /kv_commit). Shared by session
+        migration, fabric block fetches and warm starts — so the
+        `kv.migrate.*` fault seams cover all three. Meta-only sessions
+        (cheap drain) ride the same wire as a single metadata frame."""
         from areal_tpu.core.weight_transfer import pack_kv_session
         from areal_tpu.utils.http import arequest_with_retry
 
-        loop = asyncio.get_running_loop()
-        sess = await loop.run_in_executor(
-            None, self.engine.export_session, rid
-        )
-        if sess is None:
-            return None
         frames = list(
             pack_kv_session(
                 sess["meta"],
-                sess["k"],
-                sess["v"],
+                sess.get("k"),
+                sess.get("v"),
                 ks=sess.get("ks"),
                 vs=sess.get("vs"),
                 chunk_mb=getattr(self.config, "kv_migrate_chunk_mb", 64.0),
@@ -702,6 +723,188 @@ class DecodeServer:
             "the session resumes with a re-prefill"
         )
         return None
+
+    async def _migrate_session_out(
+        self,
+        target: str,
+        rid: str,
+        xid: str,
+        retries: int = 2,
+        refetchable: "set[int] | None" = None,
+    ) -> dict[str, Any] | None:
+        """Export `rid` and stream it to `target` under delivery id `xid`.
+
+        The export MOVES the session out of this engine first; a transfer
+        that fails past its replay budget therefore degrades to a
+        re-prefill on whichever replica the session resumes on — never a
+        wedged handler. The budget is two full-stream replays (same xid):
+        a mid-transfer sender death and a torn frame are INDEPENDENT
+        failures, and a budget of one means any two of them composing on
+        one session silently downgrades the handoff to a re-prefill.
+        Re-sent frames interval-merge and the commit is idempotent, so
+        however many replays run, the handoff lands exactly once.
+
+        `refetchable` (cheap drain): content keys the surviving fleet can
+        serve — sessions fully covered by them export meta-only (no KV
+        bytes on the wire; the resume re-fetches blocks on demand)."""
+        loop = asyncio.get_running_loop()
+        sess = await loop.run_in_executor(
+            None, self.engine.export_session, rid, refetchable
+        )
+        if sess is None:
+            return None
+        out = await self._stream_kv(target, sess, rid, xid, retries=retries)
+        if out is not None:
+            out["meta_only"] = bool(sess["meta"].get("meta_only"))
+        return out
+
+    # -- fleet KV fabric (content-addressed block fetch) ----------------
+    async def _kv_fetch(self, request: web.Request) -> web.Response:
+        """Serve content-keyed block runs to a sibling: resolve the
+        requested chain (or the `top` longest resident chains, for a warm
+        start) and PUSH the matching sessions to `target` over the
+        migration wire. Copy semantics — nothing local is dropped; a
+        failed push degrades to a re-prefill on the requester."""
+        import uuid as _uuid
+
+        body = await request.json()
+        target = str(body.get("target") or "")
+        if not target or target == self.addr:
+            return web.json_response(
+                {"status": "error", "message": "target required"}, status=400
+            )
+        keys = body.get("keys")
+        if isinstance(keys, str):
+            keys = kv_fabric.decode_digest(keys)
+        keys = [int(x) for x in (keys or [])]
+        top = int(body.get("top") or 0)
+        if not keys and top <= 0:
+            return web.json_response(
+                {"status": "error", "message": "keys or top required"},
+                status=400,
+            )
+        loop = asyncio.get_running_loop()
+        sessions = await loop.run_in_executor(
+            None,
+            lambda: self.engine.export_fabric_blocks(
+                keys=keys or None, top=top
+            ),
+        )
+        served = 0
+        nbytes = 0
+        xid_base = str(body.get("xid") or f"fab-{_uuid.uuid4().hex[:12]}")
+        for i, sess in enumerate(sessions):
+            moved = await self._stream_kv(
+                target, sess, sess["meta"]["rid"], f"{xid_base}-{i}"
+            )
+            if moved is not None:
+                served += 1
+                nbytes += moved["bytes"]
+        self._fabric_stats["serve_sessions"] += served
+        self._fabric_stats["serve_bytes"] += nbytes
+        return web.json_response(
+            {
+                "status": "ok",
+                "resolved": len(sessions),
+                "sessions": served,
+                "bytes": nbytes,
+            }
+        )
+
+    async def _fabric_prefetch(self, hint: dict[str, Any]) -> None:
+        """Act on a router hint ({"peer": addr, "keys": digest}) BEFORE
+        the engine sees the request: pull the matching block runs from
+        the peer so admission finds them in the host tier. Concurrent
+        requests carrying the same hint await one fetch (event-loop
+        dedup). Every failure degrades to a local prefill — the stream
+        stays bit-identical, it just pays the prefill the fabric would
+        have skipped."""
+        from areal_tpu.utils.http import arequest_with_retry
+
+        peer = str(hint.get("peer") or "")
+        keys = hint.get("keys")
+        if not peer or not keys or peer == self.addr:
+            return
+        dedup = keys if isinstance(keys, str) else ",".join(map(str, keys))
+        fut = self._fabric_inflight.get(dedup)
+        if fut is not None:
+            try:
+                await asyncio.shield(fut)
+            except Exception as e:  # noqa: BLE001 — the original logs it
+                logger.debug(f"awaited in-flight fabric fetch failed: {e!r}")
+            return
+        fut = asyncio.get_running_loop().create_future()
+        # no await between the get above and this claim: loop-atomic
+        self._fabric_inflight[dedup] = fut
+        self._fabric_stats["fetch_attempts"] += 1
+        try:
+            out = await arequest_with_retry(
+                peer,
+                "/kv_fetch",
+                payload={"keys": keys, "target": self.addr},
+                max_retries=1,
+                timeout=float(
+                    getattr(self.config, "kv_fabric_fetch_timeout_s", 30.0)
+                ),
+            )
+            self._fabric_stats["fetch_sessions"] += int(
+                out.get("sessions") or 0
+            )
+            self._fabric_stats["fetch_bytes"] += int(out.get("bytes") or 0)
+            fut.set_result(out)
+        except Exception as e:  # noqa: BLE001 — degrade, never wedge
+            self._fabric_stats["fetch_failures"] += 1
+            logger.warning(
+                f"fabric prefetch from {peer} failed ({e!r}); "
+                "degrading to local prefill"
+            )
+            fut.set_result(None)
+        finally:
+            self._fabric_inflight.pop(dedup, None)
+
+    async def _warm_start(self, request: web.Request) -> web.Response:
+        """Cold-start warm-up: ask each peer to push its longest resident
+        block runs here before this replica takes traffic. Best-effort —
+        a peer that cannot serve simply contributes nothing."""
+        from areal_tpu.utils.http import arequest_with_retry
+
+        body = await request.json()
+        peers = [
+            p for p in body.get("peers") or [] if p and p != self.addr
+        ]
+        k = int(body.get("max_sessions") or 4)
+        if not peers or k <= 0:
+            return web.json_response(
+                {"status": "error", "message": "peers required"}, status=400
+            )
+        sessions = nbytes = failures = 0
+        for peer in peers:
+            try:
+                out = await arequest_with_retry(
+                    peer,
+                    "/kv_fetch",
+                    payload={"top": k, "target": self.addr},
+                    max_retries=1,
+                    timeout=float(
+                        getattr(self.config, "kv_fabric_fetch_timeout_s", 30.0)
+                    ),
+                )
+                sessions += int(out.get("sessions") or 0)
+                nbytes += int(out.get("bytes") or 0)
+            except Exception as e:  # noqa: BLE001 — best-effort warm-up
+                failures += 1
+                logger.warning(f"warm start from {peer} failed: {e!r}")
+        self._fabric_stats["warm_start_sessions"] += sessions
+        self._fabric_stats["warm_start_bytes"] += nbytes
+        return web.json_response(
+            {
+                "status": "ok",
+                "peers": len(peers),
+                "sessions": sessions,
+                "bytes": nbytes,
+                "failures": failures,
+            }
+        )
 
     async def _prefill(self, request: web.Request) -> web.Response:
         """Prefill-only generation (the prefill role's hot path): run the
@@ -919,25 +1122,42 @@ class DecodeServer:
             )
             if not self._client_paused:
                 self.engine.continue_generation()
+        # fleet fabric cheap drain: blocks the survivors can re-fetch by
+        # content key travel as a single meta-only frame (identity, not
+        # kilobytes of KV) — the supervisor passes the union of survivor
+        # digests as `refetchable`
+        refetchable: set[int] | None = None
+        rf = body.get("refetchable")
+        if rf is not None and getattr(self.config, "kv_fabric", True):
+            if isinstance(rf, str):
+                rf = kv_fabric.decode_digest(rf)
+            refetchable = {int(x) for x in rf}
         rids = self.engine.list_exportable_sessions()
-        drained = failed = 0
+        drained = failed = meta_only = 0
         total_bytes = 0
+        # kwarg only when a digest was supplied: plain drains keep the
+        # pre-fabric `_migrate_session_out(target, rid, xid)` call shape
+        # (overridable seam — see tests/test_fleet.py's slow_migrate)
+        kw = {} if refetchable is None else {"refetchable": refetchable}
         for i, rid in enumerate(rids):
             xid = f"drain-{_uuid.uuid4().hex[:12]}"
             moved = await self._migrate_session_out(
-                targets[i % len(targets)], rid, xid
+                targets[i % len(targets)], rid, xid, **kw
             )
             if moved is None:
                 failed += 1
             else:
                 drained += 1
                 total_bytes += moved["bytes"]
+                if moved.get("meta_only"):
+                    meta_only += 1
         return {
             "status": "ok",
             "aborted": aborted,
             "sessions": len(rids),
             "drained": drained,
             "failed": failed,
+            "meta_only": meta_only,
             "bytes": total_bytes,
         }
 
@@ -982,6 +1202,8 @@ class DecodeServer:
         app.router.add_post("/prefill", self._prefill)
         app.router.add_post("/kv_recv", self._kv_recv)
         app.router.add_post("/kv_commit", self._kv_commit)
+        app.router.add_post("/kv_fetch", self._kv_fetch)
+        app.router.add_post("/warm_start", self._warm_start)
         app.router.add_post("/drain", self._drain)
         app.router.add_post("/set_role", self._set_role)
         return app

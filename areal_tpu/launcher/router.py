@@ -65,7 +65,7 @@ from typing import Any
 from aiohttp import web
 
 from areal_tpu.api.cli_args import RouterConfig
-from areal_tpu.core import fault_injection
+from areal_tpu.core import fault_injection, kv_fabric
 from areal_tpu.utils import logging, name_resolve, names
 from areal_tpu.utils.http import arequest_with_retry
 from areal_tpu.utils.network import find_free_ports, gethostip
@@ -95,6 +95,7 @@ _GUARDED_BY = {
     "DecodeRouter._qid_pending": "_lock",
     "DecodeRouter._qid_touched": "_lock",
     "DecodeRouter._prefix_map": "_lock",
+    "DecodeRouter._fabric_index": "_lock",
     "DecodeRouter._waitq": "_lock",
     "DecodeRouter._counters": "_lock",
     "DecodeRouter._versions": "_lock",
@@ -119,6 +120,15 @@ _PRESSURE_KEYS = (
     "kv_host_pool_enabled",
     "kv_host_pool_occupancy",
     "prefix_cache_hit_rate",
+    # fleet KV fabric: the per-replica block-index digest (content keys of
+    # resident prefix blocks) drives remote-fetch routing hints; the hit /
+    # avoided-token counters are summed fleet-wide on the router's /metrics
+    "kv_dtype",
+    "kv_fabric_digest",
+    "kv_fabric_local_hits_total",
+    "kv_fabric_remote_hits_total",
+    "kv_fabric_fetch_bytes_total",
+    "reprefill_tokens_avoided_total",
     # disaggregation observability: replica role + cross-replica KV
     # migration traffic, surfaced per-replica in the pressure snapshots
     # and summed fleet-wide on the router's /metrics
@@ -200,6 +210,9 @@ class DecodeRouter:
         self._qid_touched: dict[str, float] = {}
         # prefix-hash -> (server, last_used); recency-ordered (LRU + TTL)
         self._prefix_map: "OrderedDict[int, tuple[str, float]]" = OrderedDict()
+        # fleet KV fabric: per-server resident block-key set, decoded from
+        # the kv_fabric_digest each /metrics poll carries
+        self._fabric_index: dict[str, set[int]] = {}
         # bounded FIFO of unschedulable requests (pressure everywhere)
         self._waitq: deque[_Waiter] = deque()
         self._counters: dict[str, int] = dict(
@@ -221,6 +234,8 @@ class DecodeRouter:
             breaker_closes_total=0,
             deadline_sheds_total=0,
             disagg_schedules_total=0,
+            fabric_local_routes_total=0,
+            fabric_remote_hints_total=0,
         )
         # replica role ("unified" | "prefill" | "decode"), learned from
         # each /health poll: a disaggregated fleet schedules prefill by
@@ -379,11 +394,19 @@ class DecodeRouter:
                 ):
                     del self._measured_tokens[s]
                     self._pressure.pop(s, None)
+                    self._fabric_index.pop(s, None)
                 continue
             self._metrics_fail[s] = 0
             self._measured_tokens[s] = load
             if pressure is not None:
                 self._pressure[s] = pressure
+                dig = pressure.get("kv_fabric_digest")
+                if dig:
+                    # stale keys age out with the next digest — a replica
+                    # that evicted a block stops advertising it here
+                    self._fabric_index[s] = set(kv_fabric.decode_digest(dig))
+                else:
+                    self._fabric_index.pop(s, None)
             # subtract only what the measurement could have
             # seen; later routings keep their estimated cost
             self._est_since_poll[s] = max(
@@ -492,6 +515,8 @@ class DecodeRouter:
         # stale measurements must not keep the corpse looking admissible
         self._measured_tokens.pop(dead, None)
         self._pressure.pop(dead, None)
+        # nor can a dead replica serve fabric fetches
+        self._fabric_index.pop(dead, None)
         # death supersedes the breaker: a resurrected replica starts clean
         self._breaker.pop(dead, None)
         if moved or stale:
@@ -568,6 +593,7 @@ class DecodeRouter:
             | set(self._pressure)
             | set(self._breaker)
             | set(self._roles)
+            | set(self._fabric_index)
         )
         for s in tracked - keep:
             for d in (
@@ -581,6 +607,7 @@ class DecodeRouter:
                 self._versions,
                 self._breaker,
                 self._roles,
+                self._fabric_index,
             ):
                 d.pop(s, None)
 
@@ -657,14 +684,60 @@ class DecodeRouter:
         h = self._kv_headroom(s, need)
         return h is None or h >= 0.0
 
-    def _prefix_hashes(self, req: dict[str, Any]) -> list[int]:
-        """Block-bucketed prompt-prefix hashes, longest first."""
+    def _fleet_kv_dtype(self) -> str:
+        """KV dtype the fleet serves under (content-key salt). Replicas of
+        one fleet share a dtype; any pressure snapshot carrying it wins."""
+        for p in self._pressure.values():
+            d = p.get("kv_dtype")
+            if d:
+                return str(d)
+        return "bfloat16"
+
+    def _fabric_chain(self, req: dict[str, Any]) -> list[int]:
+        """Chained content keys of the request's prompt prefix — the SAME
+        keys the engines index their pools under (kv_fabric.chain_keys,
+        salted by weight version + kv dtype), so a router-side match is a
+        statement about real resident KV bytes, not a hash collision or a
+        stale-weights alias."""
         prefix = req.get("input_prefix")
         if not prefix:
             return []
         block = max(1, self.config.prefix_block_tokens)
         nb = min(len(prefix) // block, self.config.prefix_max_blocks)
-        return [hash(tuple(prefix[: b * block])) for b in range(nb, 0, -1)]
+        if nb <= 0:
+            return []
+        return kv_fabric.chain_keys(
+            prefix,
+            block,
+            self.fleet_version,
+            self._fleet_kv_dtype(),
+            max_blocks=nb,
+        )
+
+    def _prefix_hashes(self, req: dict[str, Any]) -> list[int]:
+        """Block-bucketed prompt-prefix content keys, longest first.
+
+        Chained blake2b keys (not Python ``hash``): salted by weight
+        version and kv dtype, so a weight flip retires every stale
+        affinity entry instead of steering the new version's requests at
+        KV computed under the old one, and identical across processes so
+        the affinity map agrees with the replicas' own fabric digests."""
+        return list(reversed(self._fabric_chain(req)))
+
+    def _fabric_best_locked(
+        self, chain: list[int], skip: str | None = None
+    ) -> tuple[str | None, int]:
+        """(server, blocks) of the longest resident run of `chain` across
+        the fleet's advertised fabric digests, excluding `skip`."""
+        best_s: str | None = None
+        best_n = 0
+        for s, keys in self._fabric_index.items():
+            if s == skip or s not in self.servers:
+                continue
+            n = kv_fabric.longest_run(chain, keys)
+            if n > best_n:
+                best_s, best_n = s, n
+        return best_s, best_n
 
     def _role_of(self, s: str) -> str:
         return self._roles.get(s, "unified")
@@ -816,6 +889,30 @@ class DecodeRouter:
             chosen = affine
             discount = saved
             break
+        if chosen is None and hashes and getattr(self.config, "kv_fabric", True):
+            # no affinity entry — but a candidate may hold the blocks
+            # anyway (content-dedup'd from another request line, or
+            # fabric-fetched earlier): route by advertised resident run,
+            # priced with the same marginal-cost override as affinity
+            chain = hashes[::-1]
+            run_of = {
+                s: kv_fabric.longest_run(chain, self._fabric_index[s])
+                for s in candidates
+                if s in self._fabric_index
+            }
+            cand = max(run_of, key=lambda s: run_of[s]) if run_of else None
+            if cand is not None and run_of[cand] > 0:
+                saved = min(
+                    run_of[cand] * block, float(req.get("prompt_len", 0))
+                )
+                if (
+                    self._token_load(cand) + need - saved
+                    <= self.config.affinity_load_factor
+                    * (self._token_load(best) + need)
+                ):
+                    chosen = cand
+                    discount = saved
+                    self._counters["fabric_local_routes_total"] += 1
         if chosen is None:
             chosen = best
         for h in hashes:
@@ -829,6 +926,41 @@ class DecodeRouter:
         if addr is None:
             return None
         qid = req.get("qid")
+        fabric_hint = None
+        if getattr(self.config, "kv_fabric", True):
+            chain = self._fabric_chain(req)
+            if chain:
+                block = max(1, self.config.prefix_block_tokens)
+                local = kv_fabric.longest_run(
+                    chain, self._fabric_index.get(addr, frozenset())
+                )
+                peer, run = self._fabric_best_locked(chain, skip=addr)
+                if peer is not None and run > local:
+                    # marginal-cost model: the peer holds `run - local`
+                    # more blocks than the chosen replica — fetching them
+                    # over the wire costs kv_fabric_fetch_cost_factor of
+                    # prefilling them, so the discount is the residual
+                    factor = min(
+                        max(
+                            float(
+                                getattr(
+                                    self.config,
+                                    "kv_fabric_fetch_cost_factor",
+                                    0.25,
+                                )
+                            ),
+                            0.0,
+                        ),
+                        1.0,
+                    )
+                    saved = (run - local) * block * (1.0 - factor)
+                    prompt_len = float(req.get("prompt_len", 0))
+                    discount = min(discount + saved, prompt_len)
+                    fabric_hint = {
+                        "peer": peer,
+                        "keys": kv_fabric.encode_digest(chain[:run]),
+                    }
+                    self._counters["fabric_remote_hints_total"] += 1
         cost = max(self._request_cost(req) - discount, 0.0)
         self._counters["schedules_total"] += 1
         self._breaker_charge_locked(addr)
@@ -841,6 +973,10 @@ class DecodeRouter:
             self._qid_pending[qid] = self._qid_pending.get(qid, 0) + 1
             self._qid_touched[qid] = time.monotonic()
         out = {"url": addr, "version": self.fleet_version}
+        if fabric_hint is not None:
+            # the decode server pulls these blocks from `peer` over the
+            # migration wire before admission (decode_server._fabric_prefetch)
+            out["kv_fabric"] = fabric_hint
         if prefill_addr is not None:
             # disaggregated fleet: the client runs the prompt on this
             # replica first (/prefill streams the KV to `url`), then
@@ -1037,8 +1173,30 @@ class DecodeRouter:
                 int(p.get("kv_migrated_in_bytes_total", 0) or 0)
                 for p in self._pressure.values()
             )
+
+            # fleet-aggregate KV-fabric effectiveness (the bench's and the
+            # supervisor's primary signal: tokens the fleet did NOT
+            # re-prefill thanks to content-addressed reuse)
+            def _fleet_sum(key: str) -> int:
+                return sum(
+                    int(p.get(key, 0) or 0) for p in self._pressure.values()
+                )
+
             return web.json_response(
                 {
+                    "kv_fabric_local_hits_total": _fleet_sum(
+                        "kv_fabric_local_hits_total"
+                    ),
+                    "kv_fabric_remote_hits_total": _fleet_sum(
+                        "kv_fabric_remote_hits_total"
+                    ),
+                    "kv_fabric_fetch_bytes_total": _fleet_sum(
+                        "kv_fabric_fetch_bytes_total"
+                    ),
+                    "reprefill_tokens_avoided_total": _fleet_sum(
+                        "reprefill_tokens_avoided_total"
+                    ),
+                    "fabric_indexed_servers": len(self._fabric_index),
                     "schedule_policy": self.schedule_policy,
                     "servers": self.servers,
                     "roles": {s: self._role_of(s) for s in self.servers},

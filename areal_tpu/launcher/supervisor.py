@@ -73,7 +73,7 @@ from typing import Any, Callable, Mapping, Protocol
 from aiohttp import web
 
 from areal_tpu.api.cli_args import SupervisorConfig
-from areal_tpu.core import fault_injection
+from areal_tpu.core import fault_injection, kv_fabric
 from areal_tpu.utils import logging, name_resolve, names
 from areal_tpu.utils.http import arequest_with_retry, close_current_session
 
@@ -782,6 +782,39 @@ class FleetSupervisor:
             slot.addr = handle.addr
             slot.fail_count = 0
             slot.health_fails = 0
+            peers = [
+                s.addr
+                for s in self._slots.values()
+                if s.addr and s.addr != handle.addr and s.handle is not None
+            ]
+        if peers and getattr(cfg, "kv_fabric", True):
+            # warm start: pull the siblings' hottest prefix blocks into
+            # the new replica's host tier BEFORE the router sends traffic
+            # (registration below), so its first requests promote instead
+            # of prefilling from scratch. Best-effort — a failed warm-up
+            # just means a cold cache.
+            try:
+                out = await arequest_with_retry(
+                    handle.addr,
+                    "/warm_start",
+                    payload={
+                        "peers": peers,
+                        "max_sessions": int(
+                            getattr(cfg, "warm_start_sessions", 4)
+                        ),
+                    },
+                    timeout=self.config.drain_deadline_s,
+                    max_retries=1,
+                )
+                logger.info(
+                    f"slot {slot.slot_id} warm start: "
+                    f"{out.get('sessions', 0)} sessions, "
+                    f"{out.get('bytes', 0)} bytes from {len(peers)} peers"
+                )
+            except Exception as e:  # noqa: BLE001 — cold start is fine
+                logger.warning(
+                    f"warm start of {handle.addr} failed: {e!r}"
+                )
         self._register(handle.addr)
         logger.info(
             f"slot {slot.slot_id} spawned {handle.addr} role={slot.role}"
@@ -800,10 +833,48 @@ class FleetSupervisor:
             # retried by a later tick's plan; it must not kill the loop
             logger.warning(f"{act.kind} of {slot.addr} failed: {e!r}")
 
+    async def _refetchable_digest(
+        self, survivors: list[str], victim: str | None
+    ) -> str | None:
+        """Union of the survivors' advertised fabric block keys (the
+        kv_fabric_digest in the router's pressure snapshots): sessions
+        whose blocks are all in this set drain as meta-only identity
+        frames — a survivor can re-serve the bytes over /kv_fetch, so
+        streaming them off the victim is pure waste."""
+        router = await self._poll_router()
+        if not router:
+            return None
+        pressure = router.get("pressure") or {}
+        alive = set(survivors)
+        keys: set[int] = set()
+        for s, p in pressure.items():
+            if s == victim or s not in alive:
+                continue
+            dig = (p or {}).get("kv_fabric_digest")
+            if dig:
+                keys |= set(kv_fabric.decode_digest(dig))
+        if not keys:
+            return None
+        return kv_fabric.encode_digest(
+            sorted(keys), cap=kv_fabric.DIGEST_HARD_CAP
+        )
+
     async def _drain(self, slot: _Slot, survivors: list[str]) -> bool:
         """POST /drain bounded by drain_deadline_s. True = COMMITTED
         (every exportable session landed on a survivor); False = aborted
         (timeout/error) — the caller must roll back, not kill."""
+        payload: dict[str, Any] = {"targets": survivors}
+        if getattr(self.config, "kv_fabric", True):
+            try:
+                refetchable = await self._refetchable_digest(
+                    survivors, slot.addr
+                )
+            except Exception as e:  # noqa: BLE001 — cheap-drain is an
+                # optimization; a full-byte drain is always correct
+                logger.debug(f"refetchable digest unavailable: {e!r}")
+                refetchable = None
+            if refetchable:
+                payload["refetchable"] = refetchable
 
         async def _call():
             # the seam sits INSIDE the deadline window so an injected
@@ -814,7 +885,7 @@ class FleetSupervisor:
             return await arequest_with_retry(
                 slot.addr,
                 "/drain",
-                payload={"targets": survivors},
+                payload=payload,
                 timeout=self.config.drain_deadline_s,
                 max_retries=1,
             )

@@ -2178,6 +2178,390 @@ def bench_disagg(model, n_decode_reqs, n_prefill_reqs, prompt_short,
     )
 
 
+def bench_kvfabric(model, prompt_len, head_len, tail_len, new_tokens,
+                   n_dedup, max_running, chunk=8, n_ttft_reps=3,
+                   page_size=None, attn_impl=None, seed=47):
+    """Fleet KV fabric bench (ISSUE 17).
+
+    Leg 1 — INTRA-REPLICA DEDUP: `n_dedup` requests share a `head_len`
+    head but carry DIVERGENT `tail_len` tails, so the rid/tuple-prefix
+    donor paths all miss (request i is never a string-prefix of request
+    j). The content-addressed block index still satisfies the shared
+    head from whichever resident session produced it first. Asserted:
+    the fabric engine's streams are token-identical (greedy) to a
+    fabric-off oracle that pays `n_dedup` full prefills, with
+    `n_dedup-1` local fabric hits and the avoided-token counter covering
+    the shared heads.
+
+    Leg 2 — REMOTE FETCH + WARM START: replica A is hot (several
+    resident prompts), replica B is cold. One request lands on B with
+    the router-style `kv_fabric` hint naming A; B pulls the run over
+    /kv_fetch -> /kv_recv -> /kv_commit and serves with a suffix prefill
+    (remote attribution, fetched bytes counted as fabric — not
+    migration — traffic). B then /warm_start's its pool from A and the
+    timed comparison is TTFT (wall of a 1-new-token /generate) of
+    warm-started prompts vs same-length fresh prompts: the headline
+    `kvfabric_warm_ttft_speedup`. Compile costs are paid by untimed
+    warm-up requests on BOTH paths; timed reps report the median.
+
+    Leg 3 — WEIGHT FLIP MID-TRACE: B installs a new weight version
+    (parked-prefix invalidation + version bump — the real install
+    sequence). A push of A's old-version run is rejected by the
+    version-salted content keys (honest miss, 0 stale-block serves) and
+    the next /generate on B pays an honest full prefill while staying
+    bit-identical to the oracle."""
+    import asyncio
+    import threading
+
+    import jax
+
+    from areal_tpu.api.cli_args import (
+        GenerationHyperparameters,
+        InferenceEngineConfig,
+        JaxDecodeConfig,
+    )
+    from areal_tpu.api.io_struct import ModelRequest
+    from areal_tpu.core import kv_fabric
+    from areal_tpu.engine.jax_decode import JaxDecodeEngine
+    from areal_tpu.launcher.decode_server import DecodeServer
+    from areal_tpu.utils.http import arequest_with_retry, close_current_session
+    from areal_tpu.models.qwen2 import init_params
+
+    params = init_params(model, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(seed)
+    ctx = prompt_len + new_tokens + chunk + 128
+    gcfg = GenerationHyperparameters(max_new_tokens=new_tokens, greedy=True)
+
+    def mk_engine(fabric=True):
+        extra = {}
+        if page_size is not None:
+            extra["page_size"] = page_size
+        if attn_impl is not None:
+            extra["paged_attn_impl"] = attn_impl
+        dcfg = JaxDecodeConfig(
+            context_length=ctx,
+            max_running_requests=max_running,
+            new_tokens_per_chunk=chunk,
+            dtype=model.dtype,
+            kv_cache_dtype=model.dtype,
+            kv_layout="paged",
+            kv_fabric=fabric,
+            kv_migrate_chunk_mb=1.0,
+            random_seed=1,
+            **extra,
+        )
+        eng = JaxDecodeEngine(dcfg, InferenceEngineConfig())
+        eng.set_model(params, model)
+        eng.initialize()
+        return eng, dcfg
+
+    def _tokens(n):
+        return rng.randint(1, model.vocab_size, (n,)).tolist()
+
+    def _chain_of(eng, tokens):
+        return kv_fabric.chain_keys(
+            tokens,
+            eng._alloc.block_size,
+            int(eng._version),
+            str(eng.config.kv_dtype),
+        )
+
+    # ---- leg 1: intra-replica dedup, fabric vs fabric-off oracle ------
+    head = _tokens(head_len)
+    dedup_prompts = [head + _tokens(tail_len) for _ in range(n_dedup)]
+
+    def run_dedup(fabric):
+        eng, _ = mk_engine(fabric=fabric)
+        try:
+            streams = []
+            t0 = time.perf_counter()
+            for i, p in enumerate(dedup_prompts):
+                r = eng.generate(
+                    ModelRequest(rid=f"dd{i}", input_ids=p, gconfig=gcfg),
+                    timeout=300,
+                )
+                streams.append(list(r.output_tokens))
+            wall = time.perf_counter() - t0
+            return streams, eng.get_metrics(), wall
+        finally:
+            eng.destroy()
+
+    oracle_streams, oracle_m, oracle_wall = run_dedup(False)
+    fabric_streams, fabric_m, fabric_wall = run_dedup(True)
+    assert oracle_m["prefills_total"] == n_dedup, (
+        "oracle reused the diverging-tail prompts without the fabric: "
+        f"{oracle_m['prefills_total']} prefills for {n_dedup} requests"
+    )
+    assert fabric_streams == oracle_streams, (
+        "fabric-deduped streams diverged from the re-prefill oracle"
+    )
+    dedup_hits = fabric_m["kv_fabric_local_hits_total"]
+    dedup_avoided = fabric_m["kv_fabric_local_tokens_avoided_total"]
+    assert dedup_hits >= n_dedup - 1, (
+        f"only {dedup_hits} local fabric hits for {n_dedup} shared-head "
+        "requests"
+    )
+    assert dedup_avoided >= (n_dedup - 1) * 64, (
+        f"local dedup avoided only {dedup_avoided} tokens"
+    )
+
+    # ---- legs 2+3: two replicas on the wire ---------------------------
+    n_warm = n_ttft_reps + 1  # one untimed warm-up rep per path
+    hot_prompts = [_tokens(prompt_len) for _ in range(n_warm)]
+    fetch_prompt = _tokens(prompt_len)
+    flip_prompt = _tokens(prompt_len)
+    cold_prompts = [_tokens(prompt_len) for _ in range(n_warm)]
+    ttft_gcfg = dict(max_new_tokens=1, greedy=True)
+
+    ora, _ = mk_engine(fabric=False)
+    try:
+        fetch_oracle = list(
+            ora.generate(
+                ModelRequest(rid="fo", input_ids=fetch_prompt, gconfig=gcfg),
+                timeout=300,
+            ).output_tokens
+        )
+        flip_oracle = list(
+            ora.generate(
+                ModelRequest(rid="po", input_ids=flip_prompt, gconfig=gcfg),
+                timeout=300,
+            ).output_tokens
+        )
+    finally:
+        ora.destroy()
+
+    a_eng, a_cfg = mk_engine()
+    b_eng, b_cfg = mk_engine()
+
+    async def _post(addr, ep, payload, timeout=300):
+        return await arequest_with_retry(
+            addr, ep, payload=payload, max_retries=1, timeout=timeout
+        )
+
+    async def _mget(addr):
+        return await arequest_with_retry(
+            addr, "/metrics", method="GET", max_retries=1, timeout=30
+        )
+
+    async def scenario():
+        sa = DecodeServer(a_cfg, engine=a_eng, shutdown_grace=0.2)
+        sb = DecodeServer(b_cfg, engine=b_eng, shutdown_grace=0.2)
+        aa = await sa.start(host="127.0.0.1", port=0)
+        ba = await sb.start(host="127.0.0.1", port=0)
+        out: dict[str, object] = {}
+        try:
+            # populate A: the warm-start donors, the fetch run, the
+            # flip-leg run. CONCURRENTLY, so each session occupies its
+            # own slot — sequential requests would all reuse the lowest
+            # free slot and each admission would retire the previous
+            # donor's block registration
+            await asyncio.gather(
+                *[
+                    _post(aa, "/generate", dict(
+                        rid=f"hot{i}", input_ids=p, gconfig=ttft_gcfg,
+                    ))
+                    for i, p in enumerate(hot_prompts)
+                ],
+                _post(aa, "/generate", dict(
+                    rid="hotf", input_ids=fetch_prompt,
+                    gconfig=dict(max_new_tokens=new_tokens, greedy=True),
+                )),
+                _post(aa, "/generate", dict(
+                    rid="hotp", input_ids=flip_prompt, gconfig=ttft_gcfg,
+                )),
+            )
+
+            # remote fetch: B serves the request after pulling A's run
+            chain = _chain_of(a_eng, fetch_prompt[:-1])
+            r = await _post(ba, "/generate", dict(
+                rid="rf", input_ids=fetch_prompt,
+                gconfig=dict(max_new_tokens=new_tokens, greedy=True),
+                kv_fabric=dict(peer=aa, keys=kv_fabric.encode_digest(chain)),
+            ))
+            out["fetch_stream"] = list(r["output_tokens"])
+            out["m_fetch"] = await _mget(ba)
+
+            # cold TTFT: fresh same-length prompts, first rep untimed
+            # (pays the prefill compile), median of the rest
+            cold_ms = []
+            for i, p in enumerate(cold_prompts):
+                t0 = time.perf_counter()
+                await _post(ba, "/generate", dict(
+                    rid=f"cold{i}", input_ids=p, gconfig=ttft_gcfg,
+                ))
+                if i > 0:
+                    cold_ms.append((time.perf_counter() - t0) * 1e3)
+
+            # warm start B's pool from A, then TTFT over the warm-started
+            # prompts (first rep untimed: pays the suffix-prefill compile)
+            ws = await _post(ba, "/warm_start", dict(
+                peers=[aa], max_sessions=max_running,
+            ))
+            out["warm_start"] = ws
+            warm_ms = []
+            for i, p in enumerate(hot_prompts):
+                t0 = time.perf_counter()
+                await _post(ba, "/generate", dict(
+                    rid=f"warm{i}", input_ids=p, gconfig=ttft_gcfg,
+                ))
+                if i > 0:
+                    warm_ms.append((time.perf_counter() - t0) * 1e3)
+            out["cold_ms"] = cold_ms
+            out["warm_ms"] = warm_ms
+            out["m_warm"] = await _mget(ba)
+
+            # weight flip mid-trace on B: the real install sequence
+            # (parked-prefix invalidation, then the version bump)
+            b_eng.pause_generation()
+            with b_eng._sched_lock:
+                b_eng._invalidate_parked()
+            b_eng.continue_generation()
+            b_eng.set_version(int(b_eng._version) + 1)
+            # A pushes its old-version run: every block must be rejected
+            # by the version-salted keys, never committed
+            push = await _post(aa, "/kv_fetch", dict(
+                keys=kv_fabric.encode_digest(_chain_of(a_eng, flip_prompt[:-1])),
+                target=ba,
+            ))
+            out["flip_push"] = push
+            m0 = await _mget(ba)
+            r = await _post(ba, "/generate", dict(
+                rid="flip", input_ids=flip_prompt,
+                gconfig=dict(max_new_tokens=new_tokens, greedy=True),
+            ))
+            out["flip_stream"] = list(r["output_tokens"])
+            m1 = await _mget(ba)
+            out["flip_delta"] = {
+                k: m1[k] - m0[k]
+                for k in (
+                    "kv_fabric_local_hits_total",
+                    "kv_fabric_remote_hits_total",
+                    "kv_fabric_sessions_in_total",
+                    "prefills_total",
+                )
+            }
+            out["m_a"] = await _mget(aa)
+            out["m_b"] = m1
+            return out
+        finally:
+            await sa.stop()
+            await sb.stop()
+            await close_current_session()
+
+    def _run_async(coro, timeout=600):
+        result: dict[str, object] = {}
+
+        def go():
+            try:
+                result["v"] = asyncio.run(coro)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                result["e"] = e
+
+        t = threading.Thread(target=go, daemon=True)
+        t.start()
+        t.join(timeout)
+        assert not t.is_alive(), "kvfabric wire scenario timed out"
+        if "e" in result:
+            raise result["e"]
+        return result["v"]
+
+    try:
+        wire = _run_async(scenario())
+    finally:
+        a_eng.destroy()
+        b_eng.destroy()
+
+    # remote fetch: bit-identity + attribution
+    assert wire["fetch_stream"] == fetch_oracle, (
+        "remote-fetched stream diverged from the re-prefill oracle"
+    )
+    mf = wire["m_fetch"]
+    assert mf["kv_fabric"]["fetch_sessions"] >= 1, "the fetch never landed"
+    assert mf["kv_fabric"]["fetch_failures"] == 0
+    assert mf["kv_fabric_remote_hits_total"] >= 1, (
+        "the fetched run was never promoted into the request"
+    )
+    assert mf["kv_fabric_fetch_bytes_total"] > 0
+    assert mf["kv_migrated_in_sessions_total"] == 0, (
+        "fabric traffic leaked into the migration counters"
+    )
+
+    # warm start: sessions landed and the warm reps hit them
+    ws = wire["warm_start"]
+    assert ws["sessions"] >= 1 and ws["bytes"] > 0 and ws["failures"] == 0, (
+        f"warm start failed: {ws}"
+    )
+    mw = wire["m_warm"]
+    assert mw["kv_fabric_remote_hits_total"] >= 1 + n_ttft_reps, (
+        "warm-started prompts re-prefilled instead of hitting the pool"
+    )
+    cold_ttft_ms = float(np.median(wire["cold_ms"]))
+    warm_ttft_ms = float(np.median(wire["warm_ms"]))
+
+    # weight flip: zero stale-block serves, honest full prefill
+    fd = wire["flip_delta"]
+    stale_serves = (
+        fd["kv_fabric_local_hits_total"]
+        + fd["kv_fabric_remote_hits_total"]
+        + fd["kv_fabric_sessions_in_total"]
+    )
+    assert stale_serves == 0, (
+        f"stale blocks served across the weight flip: {fd}"
+    )
+    assert fd["prefills_total"] == 1, (
+        "the post-flip request did not pay an honest full prefill"
+    )
+    assert wire["flip_stream"] == flip_oracle, (
+        "post-flip stream diverged from the oracle"
+    )
+
+    # fleet aggregate (what the router's /metrics sums over pressure):
+    # remote fetches alone must account for avoided re-prefill tokens
+    ma, mb = wire["m_a"], wire["m_b"]
+    fleet_remote_avoided = (
+        ma["kv_fabric_remote_tokens_avoided_total"]
+        + mb["kv_fabric_remote_tokens_avoided_total"]
+    )
+    fleet_avoided = (
+        ma["reprefill_tokens_avoided_total"]
+        + mb["reprefill_tokens_avoided_total"]
+    )
+    assert fleet_remote_avoided > 0, (
+        "no re-prefill tokens were avoided by REMOTE fetches fleet-wide"
+    )
+
+    return dict(
+        kvfabric_dedup_requests=n_dedup,
+        kvfabric_dedup_local_hits=dedup_hits,
+        kvfabric_dedup_tokens_avoided=dedup_avoided,
+        kvfabric_dedup_frac_prompt_avoided=(
+            dedup_avoided / float(sum(len(p) for p in dedup_prompts))
+        ),
+        kvfabric_dedup_bitidentical=float(fabric_streams == oracle_streams),
+        kvfabric_dedup_wall_s=fabric_wall,
+        kvfabric_dedup_oracle_wall_s=oracle_wall,
+        kvfabric_remote_hits=mb["kv_fabric_remote_hits_total"],
+        kvfabric_remote_tokens_avoided=(
+            mb["kv_fabric_remote_tokens_avoided_total"]
+        ),
+        kvfabric_fetch_bytes=mb["kv_fabric_fetch_bytes_total"],
+        kvfabric_remote_bitidentical=float(
+            wire["fetch_stream"] == fetch_oracle
+        ),
+        kvfabric_warm_sessions=ws["sessions"],
+        kvfabric_warm_bytes=ws["bytes"],
+        kvfabric_cold_ttft_ms=cold_ttft_ms,
+        kvfabric_warm_ttft_ms=warm_ttft_ms,
+        kvfabric_warm_ttft_speedup=(
+            cold_ttft_ms / warm_ttft_ms if warm_ttft_ms > 0 else 0.0
+        ),
+        kvfabric_stale_serves_after_flip=stale_serves,
+        kvfabric_flip_bitidentical=float(wire["flip_stream"] == flip_oracle),
+        kvfabric_fleet_reprefill_tokens_avoided=fleet_avoided,
+        kvfabric_fleet_remote_tokens_avoided=fleet_remote_avoided,
+    )
+
+
 def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
                 new_tokens, max_running, chunk=None, turns=2, seed=123):
     """Chaos bench (ISSUE 9 tentpole proof): replay the fleet session-reuse
@@ -2818,6 +3202,177 @@ def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
         f"/supervisor reports {len(sup_alive_slots)} alive slots"
     )
 
+    # leg 4: the fabric fetch path under fire (ISSUE 17). Self-contained
+    # two-peer scenarios off the trace; the same kv.migrate.* seams that
+    # cover session migration cover fabric fetches (shared _stream_kv
+    # wire). TORN: the fetch's first /kv_recv frame is torn — the frame
+    # retry re-covers it and staging interval-merge + commit dedup land
+    # the run EXACTLY ONCE. ABORT: every send attempt dies (past the
+    # replay budget) — the serving side abandons the stream and the
+    # requesting replica DEGRADES to a local full prefill, bit-identical,
+    # with zero fabric sessions imported (no torn half-run ever serves).
+    from areal_tpu.core import kv_fabric
+
+    def mk_fabric_engine():
+        dcfg = JaxDecodeConfig(
+            context_length=ctx,
+            max_running_requests=max_running,
+            new_tokens_per_chunk=chunk or min(128, new_tokens),
+            dtype=model.dtype,
+            kv_cache_dtype=model.dtype,
+            kv_layout="paged",
+            page_size=16,  # 96-token smoke prompts span >= 5 complete
+            # blocks — past the 64-token fabric floor
+            paged_attn_impl="xla",
+            kv_migrate_chunk_mb=0.05,  # several frames per fetch: the
+            # seams land mid-stream
+        )
+        eng = JaxDecodeEngine(dcfg, InferenceEngineConfig())
+        eng.set_model(params, model)
+        eng.initialize()
+        return eng, dcfg
+
+    fab_prompt = rng.randint(1, model.vocab_size, (prompt_len,)).tolist()
+    fa_eng, fa_cfg = mk_fabric_engine()
+    fb_eng, fb_cfg = mk_fabric_engine()
+    fc_eng, fc_cfg = mk_fabric_engine()
+
+    torn_plan = FaultPlan(
+        seed=seed + 2,
+        points=[
+            FaultPoint(site="kv.migrate.recv", mode="torn",
+                       at=(0,), times=1),
+        ],
+    )
+    abort_plan = FaultPlan(
+        seed=seed + 3,
+        points=[
+            # all three send attempts (retries=2) die: past the budget
+            FaultPoint(site="kv.migrate.send", mode="abort",
+                       at=(0, 1, 2), times=3),
+        ],
+    )
+
+    async def fabric_scenario():
+        sa = DecodeServer(fa_cfg, engine=fa_eng, shutdown_grace=0.2)
+        sb = DecodeServer(fb_cfg, engine=fb_eng, shutdown_grace=0.2)
+        sc = DecodeServer(fc_cfg, engine=fc_eng, shutdown_grace=0.2)
+        aa = await sa.start(host="127.0.0.1", port=0)
+        ba = await sb.start(host="127.0.0.1", port=0)
+        ca = await sc.start(host="127.0.0.1", port=0)
+        out: dict[str, object] = {}
+        try:
+            gpayload = dict(max_new_tokens=new_tokens, greedy=True)
+            # A pays the one full prefill: its stream is the oracle
+            r = await arequest_with_retry(
+                aa, "/generate",
+                payload=dict(rid="fa", input_ids=fab_prompt,
+                             gconfig=gpayload),
+                max_retries=1, timeout=300,
+            )
+            out["oracle"] = list(r["output_tokens"])
+            hint = dict(
+                peer=aa,
+                keys=kv_fabric.encode_digest(kv_fabric.chain_keys(
+                    fab_prompt[:-1],
+                    fa_eng._alloc.block_size,
+                    int(fa_eng._version),
+                    str(fa_eng.config.kv_dtype),
+                )),
+            )
+            fault_injection.configure(torn_plan)
+            try:
+                r = await arequest_with_retry(
+                    ba, "/generate",
+                    payload=dict(rid="fb", input_ids=fab_prompt,
+                                 gconfig=gpayload, kv_fabric=hint),
+                    max_retries=1, timeout=300,
+                )
+            finally:
+                out["torn_counters"] = fault_injection.snapshot()
+                fault_injection.deactivate()
+            out["torn_stream"] = list(r["output_tokens"])
+            out["m_torn"] = await arequest_with_retry(
+                ba, "/metrics", method="GET", max_retries=1, timeout=30
+            )
+            fault_injection.configure(abort_plan)
+            try:
+                r = await arequest_with_retry(
+                    ca, "/generate",
+                    payload=dict(rid="fc", input_ids=fab_prompt,
+                                 gconfig=gpayload, kv_fabric=hint),
+                    max_retries=1, timeout=300,
+                )
+            finally:
+                out["abort_counters"] = fault_injection.snapshot()
+                fault_injection.deactivate()
+            out["abort_stream"] = list(r["output_tokens"])
+            out["m_abort"] = await arequest_with_retry(
+                ca, "/metrics", method="GET", max_retries=1, timeout=30
+            )
+            out["m_serve"] = await arequest_with_retry(
+                aa, "/metrics", method="GET", max_retries=1, timeout=30
+            )
+            return out
+        finally:
+            await sa.stop()
+            await sb.stop()
+            await sc.stop()
+            await close_current_session()
+
+    try:
+        fab = asyncio.run(fabric_scenario())
+    finally:
+        fa_eng.destroy()
+        fb_eng.destroy()
+        fc_eng.destroy()
+
+    torn_faults = {
+        k: int(v)
+        for k, v in fab["torn_counters"].items()
+        if k.startswith("kv.migrate")
+    }
+    abort_faults = {
+        k: int(v)
+        for k, v in fab["abort_counters"].items()
+        if k.startswith("kv.migrate")
+    }
+    assert torn_faults, "the torn fabric-fetch fault never fired"
+    assert sum(abort_faults.values()) >= 3, (
+        f"abort seam fired {abort_faults}: the fetch replay budget was "
+        "never exhausted"
+    )
+    mt, mab, msv = fab["m_torn"], fab["m_abort"], fab["m_serve"]
+    # torn frame -> replay -> exactly once: one committed fabric session,
+    # one remote hit, the stream bit-identical to the full-prefill oracle
+    assert fab["torn_stream"] == fab["oracle"], (
+        "torn-then-replayed fabric fetch corrupted the stream"
+    )
+    assert mt["kv_fabric_sessions_in_total"] == 1, (
+        f"torn fetch landed {mt['kv_fabric_sessions_in_total']} sessions "
+        "(exactly-once violated)"
+    )
+    assert mt["kv_fabric_remote_hits_total"] == 1
+    assert mt["kv_fabric"]["fetch_failures"] == 0
+    # aborted fetch -> degraded to a LOCAL full prefill: zero fabric
+    # sessions imported, zero fabric hits, one honest prefill, the
+    # stream still bit-identical
+    assert fab["abort_stream"] == fab["oracle"], (
+        "the degraded (aborted-fetch) request corrupted the stream"
+    )
+    assert mab["kv_fabric_sessions_in_total"] == 0, (
+        "an aborted fetch still imported a fabric session"
+    )
+    assert mab["kv_fabric_remote_hits_total"] == 0
+    assert mab["kv_fabric_local_hits_total"] == 0
+    assert mab["prefills_total"] == 1, (
+        f"{mab['prefills_total']} prefills on the degraded replica: the "
+        "re-prefill ran more (or less) than exactly once"
+    )
+    assert msv["kv_migrate"]["out_failures"] >= 1, (
+        "the serving side never recorded the abandoned fetch stream"
+    )
+
     rm = chaos["router_metrics"]
     return dict(
         chaos_replicas=n_replicas,
@@ -2857,6 +3412,23 @@ def bench_chaos(model, n_replicas, n_groups, group_size, prompt_len,
             k: int(v)
             for k, v in sorted(sup_counters.items())
             if k.startswith("supervisor.")
+        },
+        chaos_fabric_torn_sessions_in=mt["kv_fabric_sessions_in_total"],
+        chaos_fabric_torn_remote_hits=mt["kv_fabric_remote_hits_total"],
+        chaos_fabric_abort_sessions_in=mab["kv_fabric_sessions_in_total"],
+        chaos_fabric_abort_reprefills=mab["prefills_total"],
+        chaos_fabric_streams_bitidentical=float(
+            fab["torn_stream"] == fab["oracle"]
+            and fab["abort_stream"] == fab["oracle"]
+        ),
+        chaos_fabric_exactly_once=float(
+            mt["kv_fabric_sessions_in_total"] == 1
+            and mab["kv_fabric_sessions_in_total"] == 0
+            and mab["prefills_total"] == 1
+        ),
+        chaos_fabric_faults={
+            **{f"torn:{k}": v for k, v in sorted(torn_faults.items())},
+            **{f"abort:{k}": v for k, v in sorted(abort_faults.items())},
         },
     )
 
@@ -4407,6 +4979,7 @@ BENCH_MODE_FNS = {
     "chaos": bench_chaos,
     "chaostrain": bench_chaostrain,
     "disagg": bench_disagg,
+    "kvfabric": bench_kvfabric,
     "autoscale": bench_autoscale,
 }
 BENCH_MODES = ("all", *BENCH_MODE_FNS)
@@ -4426,6 +4999,7 @@ MODE_HEADLINES = {
     "chaos": ("chaos_exactly_once", "bool"),
     "chaostrain": ("chaostrain_exactly_once", "bool"),
     "disagg": ("disagg_decode_itl_p99_speedup", "x"),
+    "kvfabric": ("kvfabric_warm_ttft_speedup", "x"),
     "autoscale": ("autoscale_replica_seconds_ratio", "x"),
 }
 
@@ -4827,6 +5401,22 @@ def main() -> None:
                     base_delay=15.0,
                 )
             )
+        if want("kvfabric"):
+            decode.update(
+                _retry_transport(
+                    # long prompts so the avoided prefill dominates the
+                    # warm TTFT; default page size (128) keeps the kernel
+                    # attention path — 7 complete blocks per 1k prompt
+                    lambda: bench_kvfabric(
+                        model, prompt_len=1024, head_len=512, tail_len=128,
+                        new_tokens=64, n_dedup=8, max_running=24,
+                        chunk=8, n_ttft_reps=3,
+                    ),
+                    what="bench_kvfabric",
+                    attempts=2,
+                    base_delay=15.0,
+                )
+            )
         if want("autoscale"):
             decode.update(
                 _retry_transport(
@@ -5036,6 +5626,20 @@ def main() -> None:
                     prompt_short=48, prompt_long=1024, new_tokens=256,
                     max_running=16, chunk=4, drain_sessions=4,
                     drain_prompt=96, drain_tokens=48,
+                )
+            )
+        if want("kvfabric"):
+            # 32-token blocks (xla attention) and 1k prompts: the
+            # warm-started replica's suffix prefill runs 32 tokens where
+            # the cold one runs 1024 — long enough that the avoided
+            # prefill clears the scheduler-tick noise floor on CPU.
+            # Dedup leg: 4 requests sharing a 128-token head (4 complete
+            # blocks) with 32-token divergent tails
+            decode.update(
+                bench_kvfabric(
+                    model, prompt_len=1024, head_len=128, tail_len=32,
+                    new_tokens=16, n_dedup=4, max_running=16, chunk=8,
+                    n_ttft_reps=3, page_size=32, attn_impl="xla",
                 )
             )
         if want("autoscale"):
