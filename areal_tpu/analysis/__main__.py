@@ -24,7 +24,7 @@ DEFAULT_BASELINE = "tools/lint_baseline.json"
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m areal_tpu.analysis",
-        description="concurrency + JAX hot-path invariant analyzer",
+        description="concurrency + JAX hot-path + wire-contract analyzer",
     )
     p.add_argument("paths", nargs="*", default=["areal_tpu"])
     p.add_argument("--baseline", default=None, help="baseline JSON path")

@@ -5,6 +5,7 @@ runs in milliseconds over the whole tree and can gate tier-1. Rule families:
 
   AR1xx — concurrency invariants (analysis/concurrency.py)
   AR2xx — JAX hot-path hazards  (analysis/jax_rules.py)
+  AR3xx — cross-component wire contracts & observability (analysis/wire.py)
 
 Suppression surfaces, in priority order:
   1. inline pragma      `# areal-lint: disable=AR101[,AR203]` on the flagged
@@ -53,6 +54,16 @@ RULES: dict[str, str] = {
     "argument to a jit-compiled function",
     "AR106": "broad except swallows the failure without logging, "
     "re-raising, or preserving the exception",
+    "AR301": "HTTP route pairing: client path with no registration, or "
+    "registered endpoint no client reaches",
+    "AR302": "fault-seam validity: plan pattern matching no real seam, "
+    "or one seam name fired from two modules",
+    "AR303": "metrics contract drift between producers (get_metrics / "
+    "/metrics) and consumers (poll keys, counters)",
+    "AR304": "_GUARDED_BY registry entry naming an attribute the class "
+    "no longer has",
+    "AR305": "config-knob drift: argparse flag or /info field that "
+    "mirrors no config dataclass field",
 }
 
 _PRAGMA_RE = re.compile(r"#\s*areal-lint:\s*disable=([A-Z0-9,\s]+)")
@@ -218,8 +229,10 @@ def analyze_paths(
     )
     from areal_tpu.analysis.jax_rules import analyze_jax
     from areal_tpu.analysis.robustness import analyze_robustness
+    from areal_tpu.analysis.wire import WireState, analyze_wire
 
     state = ConcurrencyState()
+    wire_state = WireState()
     findings: list[Finding] = []
     for full, display in iter_py_files(paths):
         try:
@@ -232,6 +245,7 @@ def analyze_paths(
             analyze_concurrency(sf, state)
             + analyze_jax(sf)
             + analyze_robustness(sf)
+            + analyze_wire(sf, wire_state)
         )
         for f in per_file:
             if rules is not None and f.rule not in rules:
@@ -239,9 +253,10 @@ def analyze_paths(
             if sf.suppressed(f.rule, f.line):
                 continue
             findings.append(f)
-    # cross-file lock-order findings (AR102/AR103); pragma suppression is
-    # applied inside finalize via the retained SourceFiles
-    for f in state.finalize():
+    # cross-file findings (AR102/AR103 lock order, AR3xx wire contracts);
+    # pragma suppression is applied inside finalize via the retained
+    # SourceFiles
+    for f in state.finalize() + wire_state.finalize():
         if rules is None or f.rule in rules:
             findings.append(f)
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.key))

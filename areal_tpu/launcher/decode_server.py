@@ -1285,7 +1285,7 @@ async def _serve(args: argparse.Namespace) -> None:
         spec_k=args.spec_k,
         spec_ngram_max=args.spec_ngram_max,
         random_seed=args.seed,
-        tensor_parallel_size=args.tp_size,
+        tensor_parallel_size=args.tensor_parallel_size,
     )
     tokenizer = None
     if args.model_path and not args.skip_tokenizer_init and not args.scratch_model:
@@ -1468,6 +1468,7 @@ def main(argv: list[str] | None = None) -> None:
     )
     p.add_argument(
         "--tp-size",
+        dest="tensor_parallel_size",
         type=int,
         default=1,
         help="gen-side tensor parallelism (alloc grammar's server t dim)",
@@ -1477,14 +1478,17 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 0)))
     p.add_argument("--experiment-name", default=os.environ.get("AREAL_EXPERIMENT_NAME", ""))
     p.add_argument("--trial-name", default=os.environ.get("AREAL_TRIAL_NAME", ""))
+    # knob: launcher-only — discovery identity, not a JaxDecodeConfig mirror
     p.add_argument("--server-id", default="")
     p.add_argument("--skip-tokenizer-init", action="store_true")
+    # knob: launcher-only — smoke/E2E harness switch, not a config mirror
     p.add_argument(
         "--scratch-model",
         default="",
         help="JSON ModelConfig dict: serve a from-scratch tiny model "
              "(offline smoke / launcher E2E) instead of loading --model-path",
     )
+    # knob: launcher-only — boot-time compile hint, not a config mirror
     p.add_argument(
         "--prewarm-prompt-len",
         type=int,
@@ -1494,6 +1498,7 @@ def main(argv: list[str] | None = None) -> None:
              "router (JaxDecodeEngine.prewarm); production servers should "
              "set this to their typical prompt length",
     )
+    # knob: launcher-only — boot-time compile hint, not a config mirror
     p.add_argument(
         "--prewarm-new-tokens",
         type=int,

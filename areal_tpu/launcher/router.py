@@ -251,6 +251,7 @@ class DecodeRouter:
         # so stale charges are expired on poll after breaker_probe_ttl_s
         # — without that, the breaker stays half-open with a full probe
         # budget FOREVER and the replica never re-enters rotation.
+        # metrics-producer — per-server entries ride inside /metrics "breaker"
         self._breaker: dict[str, dict[str, Any]] = defaultdict(
             lambda: {"state": "closed", "bad": 0, "probes": 0, "probe_t": 0.0}
         )
@@ -287,6 +288,8 @@ class DecodeRouter:
             try:
                 servers = self._discover()
 
+                # metrics-consumer — poll keys must be produced by the
+                # decode-server /health + /metrics handlers (AR303)
                 async def probe(s: str):
                     """health + metrics for one server, with the since-poll
                     estimate snapshotted at fetch time — requests routed
@@ -1232,7 +1235,11 @@ class DecodeRouter:
         app.router.add_get("/health", self._health)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_post("/schedule_request", self._schedule_request)
+        # production clients gate locally (core/staleness_manager) and only
+        # route here; this is the reference-protocol server-side gate
+        # wire: external
         app.router.add_post("/allocate_rollout", self._allocate_rollout)
+        # wire: external — paired with /allocate_rollout for external clients
         app.router.add_post("/finish_rollout", self._finish_rollout)
         app.router.add_post("/finish_request", self._finish_request)
         return app
@@ -1268,6 +1275,7 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--experiment-name", default="")
     p.add_argument("--trial-name", default="")
+    # knob: launcher-only — seed list, not a RouterConfig mirror
     p.add_argument("--servers", default="", help="comma-separated host:port")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=0)

@@ -542,6 +542,8 @@ class FleetSupervisor:
                 slot.health_fails += 1
 
     # -- snapshot --------------------------------------------------------
+    # metrics-consumer — every key read here must be produced by the
+    # router /metrics surface (areal-lint AR303 checks the pairing)
     def _snapshot_locked(
         self, now: float, dt: float, router: dict[str, Any] | None
     ) -> FleetSnapshot:
@@ -787,6 +789,24 @@ class FleetSupervisor:
                 for s in self._slots.values()
                 if s.addr and s.addr != handle.addr and s.handle is not None
             ]
+        # boot-config surface: one /info fetch per spawn, logged so a
+        # mixed fleet (kv_dtype/weight_dtype drift makes replicas reject
+        # each other's KV migrations as honest misses) is visible at
+        # spawn time rather than at the first failed handoff
+        try:
+            info = await arequest_with_retry(
+                handle.addr, "/info", method="GET", max_retries=1, timeout=5
+            )
+            logger.info(
+                f"replica {handle.addr} booted: role={info.get('role')} "
+                f"kv_layout={info.get('kv_layout')} "
+                f"kv_dtype={info.get('kv_dtype')} "
+                f"weight_dtype={info.get('weight_dtype')} "
+                f"version={info.get('version')}"
+            )
+        except Exception as e:  # noqa: BLE001 — observability only; a
+            # replica that cannot answer /info still registers and serves
+            logger.debug(f"/info probe of {handle.addr} failed: {e!r}")
         if peers and getattr(cfg, "kv_fabric", True):
             # warm start: pull the siblings' hottest prefix blocks into
             # the new replica's host tier BEFORE the router sends traffic
@@ -833,6 +853,7 @@ class FleetSupervisor:
             # retried by a later tick's plan; it must not kill the loop
             logger.warning(f"{act.kind} of {slot.addr} failed: {e!r}")
 
+    # metrics-consumer — reads the router pressure map (AR303-paired)
     async def _refetchable_digest(
         self, survivors: list[str], victim: str | None
     ) -> str | None:
@@ -1027,6 +1048,7 @@ class FleetSupervisor:
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get("/health", self._health)
+        # wire: external — ops/bench surface (bench.py chaos report polls it)
         app.router.add_get("/supervisor", self._supervisor_metrics)
         return app
 
@@ -1073,6 +1095,7 @@ def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--experiment-name", required=True)
     p.add_argument("--trial-name", required=True)
+    # knob: launcher-only — wiring, not a SupervisorConfig mirror
     p.add_argument("--router", required=True, help="router host:port")
     p.add_argument("--model-path", required=True)
     p.add_argument("--fileroot", default="/tmp/areal_tpu")
@@ -1080,7 +1103,10 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--min-replicas", type=int, default=1)
     p.add_argument("--max-replicas", type=int, default=8)
-    p.add_argument("--tick-interval", type=float, default=1.0)
+    p.add_argument(
+        "--tick-interval", dest="tick_interval_s", type=float, default=1.0
+    )
+    # knob: launcher-only — forwarded verbatim to spawned decode servers
     p.add_argument(
         "--server-arg",
         action="append",
@@ -1104,7 +1130,7 @@ def main(argv: list[str] | None = None) -> None:
         enabled=True,
         min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
-        tick_interval_s=args.tick_interval,
+        tick_interval_s=args.tick_interval_s,
     )
     sup = FleetSupervisor(
         args.router,

@@ -1,0 +1,34 @@
+"""Seeded-bad fixture: AR301 — route pairing across server and client.
+
+Self-contained: registrations and client references live in one module so
+a standalone run can judge pairing (the analyzer skips AR301 entirely when
+a sweep harvests no registrations)."""
+
+GENERATE_ENDPOINT = "/paired"  # client ref via *_ENDPOINT constant
+
+
+async def handle_paired(request):
+    return None
+
+
+async def handle_dead(request):
+    return None
+
+
+async def handle_ops(request):
+    return None
+
+
+def build_app(app):
+    app.router.add_get("/paired", handle_paired)  # paired below: clean
+    app.router.add_post("/dead_route", handle_dead)  # AR301: no client
+    # wire: external
+    app.router.add_get("/ops_surface", handle_ops)  # annotated: clean
+
+
+async def poll(arequest_with_retry, addr, block):
+    await arequest_with_retry(addr, "/paired", method="GET")
+    # AR301: nothing registers /missing
+    await arequest_with_retry(addr, "/missing", method="POST")
+    # f-string with query string still pairs with /paired: clean
+    return await arequest_with_retry(addr, f"/paired?block={block}")

@@ -1,0 +1,25 @@
+"""Seeded-bad fixture: AR302 — fault-seam validity.
+
+Seams and plan patterns live in one module so a standalone run can judge
+matching (pattern checks are skipped when a sweep harvests no seams)."""
+
+from areal_tpu.core import fault_injection
+from areal_tpu.core.fault_injection import FaultPoint
+
+
+def transfer(payload):
+    fault_injection.fire("kv.send", payload=payload)
+    return payload
+
+
+async def receive(payload):
+    await fault_injection.afire("kv.recv", payload=payload)
+    return payload
+
+
+PLAN = [
+    FaultPoint(site="kv.*"),  # matches both seams: clean
+    FaultPoint(site="kv.sendd"),  # AR302: typo'd pattern, never fires
+]
+
+EMBEDDED = {"site": "weight.push.*"}  # AR302: no such seam anywhere

@@ -1,0 +1,33 @@
+"""Seeded-bad fixture: AR303 — metrics contract drift.
+
+Producers (get_metrics, stats initializers) and consumers (*_KEYS tuples,
+annotated readers) live in one module so a standalone run can judge the
+pairing (cross-file checks are skipped when no producer keys exist)."""
+
+
+class Server:
+    def __init__(self):
+        self._req_stats = {"completed": 0, "rejected": 0}
+
+    def finish(self):
+        self._req_stats["completed"] += 1  # declared in initializer: clean
+
+    def reject(self):
+        self._req_stats["rejectd"] += 1  # AR303: key not in initializer
+
+    def get_metrics(self):
+        return {
+            "active_tokens": 0,
+            "queue_depth": 0,
+            **self._req_stats,
+        }
+
+
+POLL_KEYS = ("active_tokens", "kv_occupancy")  # AR303: kv_occupancy unproduced
+
+
+# metrics-consumer
+def autoscale(snapshot):
+    depth = snapshot.get("queue_depth")  # produced: clean
+    stale = snapshot.get("prefill_lag")  # AR303: no producer exports it
+    return depth, stale

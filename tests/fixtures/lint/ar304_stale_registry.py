@@ -1,0 +1,21 @@
+"""Seeded-bad fixture: AR304 — stale _GUARDED_BY registry entry.
+
+Both entries name a real lock of a real class (so AR104 stays quiet);
+one names an attribute a refactor removed."""
+
+import threading
+
+_GUARDED_BY = {
+    "Tracker._inflight": "_lock",  # attr exists: clean
+    "Tracker._retired_attr": "_lock",  # AR304: attr refactored away
+}
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def bump(self):
+        with self._lock:
+            self._inflight += 1

@@ -1,0 +1,35 @@
+"""Seeded-bad fixture: AR305 — config-knob drift (argparse + /info)."""
+
+import argparse
+from dataclasses import dataclass
+
+
+@dataclass
+class ServeConfig:
+    max_tokens: int = 512
+    tensor_parallel_size: int = 1
+    tick_interval_s: float = 1.0
+
+
+class Server:
+    def __init__(self, config):
+        self.config = config
+
+    async def _info(self, request):
+        return {
+            "max_tokens": self.config.max_tokens,  # real field: clean
+            "legacy_knob": self.config.legacy_knob,  # AR305: no such field
+        }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--max-tokens", type=int, default=512)  # mirrors: clean
+    p.add_argument("--tp-size", type=int, default=1)  # AR305: dest drift
+    p.add_argument(  # explicit dest repairs the mirror: clean
+        "--tick-interval", dest="tick_interval_s", type=float, default=1.0
+    )
+    # knob: launcher-only
+    p.add_argument("--server-id", default="")
+    p.add_argument("--host", default="0.0.0.0")  # infra dest: clean
+    return p

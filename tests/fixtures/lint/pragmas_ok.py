@@ -46,3 +46,9 @@ def alias():
     d = jnp.asarray(h)  # areal-lint: disable=AR203
     h[0] = 1
     return d
+
+
+def wire(app, arequest_with_retry):
+    app.router.add_get("/pragma_dead", alias)  # areal-lint: disable=AR301
+    # areal-lint: disable=AR301
+    return arequest_with_retry("addr", "/pragma_missing")

@@ -157,6 +157,139 @@ def test_ar106_pragma_suppresses():
         assert not [f for f in analyze_paths([p]) if f.rule == "AR106"]
 
 
+def test_ar301_route_pairing():
+    fs = _run_fixture("ar301_routes.py")
+    assert _codes(fs) == {"AR301"}
+    assert {f.key for f in fs} == {"/missing", "/dead_route"}
+    # negative space: the paired route, the `# wire: external` route, and
+    # the f-string ref with a query string must all stay clean
+    assert not any("paired" in f.key or "ops_surface" in f.key for f in fs)
+
+
+def test_ar301_client_only_sweep_stays_quiet(tmp_path):
+    """No registrations harvested -> pairing cannot be judged; a bench.py
+    style client-only sweep must not drown in unregistered-path noise."""
+    mod = tmp_path / "client.py"
+    mod.write_text(
+        "async def poll(arequest_with_retry, addr):\n"
+        "    return await arequest_with_retry(addr, '/not_registered')\n"
+    )
+    assert not [f for f in analyze_paths([str(mod)]) if f.rule == "AR301"]
+
+
+def test_ar302_seam_validity():
+    fs = _run_fixture("ar302_seams.py")
+    assert _codes(fs) == {"AR302"}
+    # the typo'd FaultPoint AND the embedded {"site": ...} plan fire; the
+    # kv.* pattern that matches real seams must not
+    assert {f.key for f in fs} == {"kv.sendd", "weight.push.*"}
+
+
+def test_ar302_seam_collision(tmp_path):
+    """One seam name fired from two modules: a single fnmatch pattern now
+    perturbs two unrelated boundaries."""
+    for mod in ("a", "b"):
+        (tmp_path / f"{mod}.py").write_text(
+            "from areal_tpu.core import fault_injection\n"
+            "def go():\n"
+            "    fault_injection.fire('shared.seam')\n"
+        )
+    fs = [f for f in analyze_paths([str(tmp_path)]) if f.rule == "AR302"]
+    assert len(fs) == 1 and fs[0].key == "shared.seam"
+
+
+def test_ar303_metrics_contract():
+    fs = _run_fixture("ar303_metrics.py")
+    assert _codes(fs) == {"AR303"}
+    keys = {f.key for f in fs}
+    # counter drift + undeclared *_KEYS entry + unproduced consumer read;
+    # the declared counter, the produced poll key, and the produced
+    # consumer read must not fire
+    assert keys == {
+        "Server._req_stats[rejectd]",
+        "POLL_KEYS.kv_occupancy",
+        "autoscale.prefill_lag",
+    }
+
+
+def test_ar304_stale_registry():
+    fs = _run_fixture("ar304_stale_registry.py")
+    assert _codes(fs) == {"AR304"}
+    (f,) = fs
+    # the still-live entry must not fire
+    assert f.key == "Tracker._retired_attr"
+
+
+def test_ar305_knob_drift():
+    fs = _run_fixture("ar305_knob_drift.py")
+    assert _codes(fs) == {"AR305"}
+    # dest drift + phantom /info field; the mirrored flag, the explicit
+    # dest= repair, the launcher-only annotation, and --host must not fire
+    assert {f.key for f in fs} == {"tp_size", "info.legacy_knob"}
+
+
+def test_ar3xx_pragma_suppresses(tmp_path):
+    """Inline pragmas silence wire findings at their anchor site like any
+    other rule — including the cross-file ones emitted from finalize()."""
+    d = tmp_path / "fixtures"  # path keeps the registration checks scoped
+    d.mkdir()
+    mod = d / "wire_frag.py"
+    mod.write_text(
+        "def build(app, arequest_with_retry):\n"
+        "    app.router.add_get('/dead', None)"
+        "  # areal-lint: disable=AR301\n"
+        "    # areal-lint: disable=AR301\n"
+        "    return arequest_with_retry('a', '/missing')\n"
+    )
+    assert not [f for f in analyze_paths([str(mod)]) if f.rule == "AR301"]
+
+
+def test_ar3xx_baseline_round_trip(tmp_path):
+    """Baseline keys for the wire family are stable identifiers (paths,
+    seam names, dests) and survive a save/load cycle; stale-entry and
+    invalid-justification reporting applies to AR3xx unchanged."""
+    fs = _run_fixture("ar301_routes.py")
+    bl = Baseline.from_findings(fs)
+    assert all(bl.covers(f) for f in fs)
+    p = tmp_path / "bl.json"
+    bl.save(str(p))
+    bl2 = Baseline.load(str(p))
+    assert all(bl2.covers(f) for f in fs)
+    # stale reporting: fix the dead route -> its entry is reported unused
+    remaining = [f for f in fs if f.key != "/dead_route"]
+    stale = bl2.unused(remaining)
+    assert [e["key"] for e in stale] == ["/dead_route"]
+    # invalid(): the from_findings placeholders are flagged until justified
+    assert len(bl2.invalid()) == len(bl2.entries) > 0
+
+
+def test_cli_rules_family_filter_and_json():
+    """`--rules AR3XX` expands to the whole family and excludes the rest;
+    `--json` emits the stable schema CI and tools/lint.sh gate on."""
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "areal_tpu.analysis",
+            str(FIXTURES / "ar301_routes.py"),
+            str(FIXTURES / "ar201_host_sync.py"),
+            "--no-baseline",
+            "--rules",
+            "AR3XX",
+            "--json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 1
+    data = json.loads(r.stdout)
+    assert set(data) == {"findings", "baselined", "total", "invalid_baseline"}
+    assert {f["rule"] for f in data["findings"]} == {"AR301"}
+    for f in data["findings"]:
+        assert set(f) == {"rule", "file", "line", "key", "message"}
+
+
 # -- pragma + baseline semantics --------------------------------------------
 
 
@@ -263,6 +396,20 @@ def test_repo_clean_against_baseline():
     baseline = Baseline.load(str(REPO / "tools" / "lint_baseline.json"))
     new = [f.format() for f in findings if not baseline.covers(f)]
     assert not new, "\n".join(new)
+
+
+def test_repo_wire_contracts_clean_without_baseline():
+    """The AR3xx family gates STRICTER than the others: real wire-contract
+    violations get fixed, never baselined, so the tree must be clean for
+    the family even with the baseline ignored."""
+    findings = [
+        f
+        for f in analyze_paths([str(REPO / "areal_tpu")])
+        if f.rule.startswith("AR3")
+    ]
+    assert not findings, "\n".join(f.format() for f in findings)
+    data = json.loads((REPO / "tools" / "lint_baseline.json").read_text())
+    assert not [e for e in data["entries"] if e["rule"].startswith("AR3")]
 
 
 def test_baseline_entries_justified():
